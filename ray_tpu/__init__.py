@@ -1,0 +1,179 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+A from-scratch rebuild of the capabilities of the reference Ray tree
+(TJX2014/ray) designed TPU-first: tasks / actors / objects over a GCS +
+raylet + shared-memory-arena runtime on the host side, and JAX / XLA /
+pjit / pallas on the device side. The public API mirrors the reference's
+(`ray.init/remote/get/put/wait`, reference: python/ray/_private/worker.py)
+so users of the reference can switch without relearning the surface.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Optional, Sequence, Union
+
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker import global_worker
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "ObjectRef",
+    "ActorHandle",
+    "available_resources",
+    "cluster_resources",
+    "nodes",
+    "get_runtime_context",
+    "method",
+    "exceptions",
+]
+
+
+def init(address: Optional[str] = None, **kwargs):
+    """Start or connect to a cluster (reference: worker.py:1225 ray.init)."""
+    return global_worker.init(address=address, **kwargs)
+
+
+def shutdown():
+    global_worker.shutdown()
+
+
+def is_initialized() -> bool:
+    return global_worker.connected
+
+
+def remote(*args, **kwargs):
+    """@remote decorator for functions and classes
+    (reference: python/ray/_private/worker.py:3242)."""
+
+    def _make(target):
+        if inspect.isclass(target):
+            return ActorClass(target, **kwargs)
+        return RemoteFunction(target, **kwargs)
+
+    if len(args) == 1 and not kwargs and (inspect.isfunction(args[0]) or inspect.isclass(args[0])):
+        return _make(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+    return _make
+
+
+def method(num_returns: int = 1, **_):
+    """@method decorator marking per-method options (parity shim)."""
+
+    def deco(fn):
+        fn.__ray_num_returns__ = num_returns
+        return fn
+
+    return deco
+
+
+def get(refs, timeout: Optional[float] = None):
+    from ray_tpu._private.worker import get_global_core, _worker_process_core
+
+    if _worker_process_core[0] is not None:
+        core = _worker_process_core[0]
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        values = core.get_values(ref_list, timeout=timeout)
+        for v in values:
+            if isinstance(v, BaseException):
+                raise v
+        return values[0] if single else values
+    return global_worker.get(refs, timeout=timeout)
+
+
+def put(value) -> ObjectRef:
+    from ray_tpu._private.worker import _worker_process_core
+
+    if _worker_process_core[0] is not None:
+        return _worker_process_core[0].put(value)
+    return global_worker.put(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: Optional[float] = None, fetch_local: bool = True):
+    from ray_tpu._private.worker import _worker_process_core
+
+    if _worker_process_core[0] is not None:
+        return _worker_process_core[0].wait(list(refs), num_returns=num_returns, timeout=timeout)
+    return global_worker.wait(refs, num_returns=num_returns, timeout=timeout, fetch_local=fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    """reference: ray.kill (worker.py kill path → GcsActorManager)."""
+    from ray_tpu._private.worker import get_global_core
+
+    get_global_core().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    from ray_tpu._private.worker import get_global_core
+
+    get_global_core().cancel_task(ref, force=force)
+
+
+def available_resources() -> Dict[str, float]:
+    from ray_tpu._private.worker import get_global_core
+
+    return get_global_core().gcs_request("cluster.available_resources")
+
+
+def cluster_resources() -> Dict[str, float]:
+    from ray_tpu._private.worker import get_global_core
+
+    return get_global_core().gcs_request("cluster.resources")
+
+
+def nodes():
+    from ray_tpu._private.worker import get_global_core
+
+    return get_global_core().gcs_request("node.list")
+
+
+class RuntimeContext:
+    """reference: python/ray/runtime_context.py."""
+
+    def __init__(self, core):
+        self._core = core
+
+    @property
+    def node_id(self):
+        return self._core.node_id
+
+    @property
+    def job_id(self):
+        return self._core.job_id
+
+    @property
+    def worker_id(self):
+        return self._core.worker_id
+
+    @property
+    def current_actor_id(self):
+        ex = self._core.executor
+        return ex.actor_id if ex else None
+
+    def get_node_id(self):
+        return self.node_id
+
+    def get_job_id(self):
+        return self.job_id
+
+
+def get_runtime_context() -> RuntimeContext:
+    from ray_tpu._private.worker import get_global_core
+
+    return RuntimeContext(get_global_core())

@@ -1,0 +1,36 @@
+"""TPU chip autodetection used by node bootstrap.
+
+Equivalent of the reference's TPUAcceleratorManager detection path
+(reference: python/ray/_private/accelerators/tpu.py:101-120 — counts
+/dev/accel* and vfio devices, falls back to GCE/GKE metadata). Kept in a
+tiny import-light module because the raylet calls it at startup.
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+
+def detect_tpu_chips() -> int:
+    env = os.environ.get("TPU_CHIPS", os.environ.get("RAY_TPU_CHIPS"))
+    if env:
+        return int(env)
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    if visible:
+        return len([c for c in visible.split(",") if c.strip()])
+    accel = glob.glob("/dev/accel*")
+    if accel:
+        return len(accel)
+    vfio = glob.glob("/dev/vfio/[0-9]*")
+    if vfio:
+        return len(vfio)
+    # last resort: ask jax only if it is already imported (importing jax in
+    # the raylet would pin the TPU runtime to the wrong process)
+    import sys
+
+    if "jax" in sys.modules:
+        try:
+            return len([d for d in sys.modules["jax"].devices() if d.platform in ("tpu", "axon")])
+        except Exception:
+            return 0
+    return 0

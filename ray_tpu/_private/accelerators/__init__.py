@@ -1,0 +1,22 @@
+"""Pluggable accelerator managers.
+
+Equivalent of the reference's accelerator registry
+(reference: python/ray/_private/accelerators/__init__.py — one
+AcceleratorManager per vendor). TPU is the first-class citizen here;
+a CPU manager exists for tests and a GPU stub keeps the resource name
+valid on mixed clusters.
+"""
+from ray_tpu._private.accelerators.accelerator import AcceleratorManager
+from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+_MANAGERS = {
+    "TPU": TPUAcceleratorManager,
+}
+
+
+def get_accelerator_manager(resource_name: str):
+    return _MANAGERS.get(resource_name)
+
+
+def get_all_accelerator_managers():
+    return list(_MANAGERS.values())

@@ -1,0 +1,47 @@
+"""AcceleratorManager interface.
+
+Equivalent of the reference's abstract interface
+(reference: python/ray/_private/accelerators/accelerator.py:5 — a
+138-line ABC with detection, visibility env plumbing, and extra
+resource hooks).
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional
+
+
+class AcceleratorManager(ABC):
+    @staticmethod
+    @abstractmethod
+    def get_resource_name() -> str:
+        """e.g. 'TPU'."""
+
+    @staticmethod
+    @abstractmethod
+    def get_current_node_num_accelerators() -> int:
+        """Autodetect how many accelerators this node has."""
+
+    @staticmethod
+    @abstractmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        """e.g. 'TPU-v5p'."""
+
+    @staticmethod
+    @abstractmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        """Env var that restricts accelerator visibility for a worker."""
+
+    @staticmethod
+    @abstractmethod
+    def validate_resource_request_quantity(quantity: float) -> tuple:
+        """(ok, error_message)."""
+
+    @staticmethod
+    def get_current_node_additional_resources() -> Dict[str, float]:
+        """Extra custom resources this node should advertise."""
+        return {}
+
+    @staticmethod
+    def set_visible_accelerator_ids(ids: List[str]) -> None:
+        pass

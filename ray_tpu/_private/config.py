@@ -1,0 +1,89 @@
+"""Runtime configuration flag system.
+
+Equivalent of the reference's `RAY_CONFIG` X-macro table
+(reference: src/ray/common/ray_config_def.h — 218 entries, each
+overridable via a `RAY_<name>` env var, propagated cluster-wide via the
+GCS at node registration). Here the table is a plain dataclass-style
+registry; every entry is overridable via `RAY_TPU_<NAME>` env vars, and
+the head serializes the resolved config to all nodes at registration.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_DEFS: Dict[str, Any] = {
+    # --- object store ---
+    "object_store_memory_bytes": 2 * 1024**3,  # default shm arena size
+    "object_store_inline_max_bytes": 100 * 1024,  # small objects ride the control plane
+    "object_store_fallback_directory": "/tmp/ray_tpu/spill",
+    "object_spilling_threshold": 0.8,
+    "object_chunk_size_bytes": 4 * 1024**2,  # node-to-node transfer chunking
+    # --- scheduler ---
+    "worker_lease_timeout_s": 30.0,
+    "worker_pool_prestart": 2,
+    "worker_pool_max_idle": 8,
+    "scheduler_spread_threshold": 0.5,
+    "scheduler_top_k_fraction": 0.2,
+    # --- health / fault tolerance ---
+    "health_check_period_s": 5.0,
+    "health_check_timeout_s": 30.0,
+    "task_max_retries_default": 3,
+    "actor_max_restarts_default": 0,
+    # --- gcs ---
+    "gcs_port": 0,  # 0 = auto
+    "kv_namespace_default": "default",
+    # --- worker ---
+    "worker_register_timeout_s": 60.0,
+    "worker_startup_batch": 4,
+    "maximum_startup_concurrency": 8,
+    # --- logging/metrics ---
+    "event_buffer_flush_period_s": 1.0,
+    "metrics_report_period_s": 5.0,
+    "log_to_driver": True,
+    # --- tpu ---
+    "tpu_chips_per_host_default": 4,
+}
+
+
+class _Config:
+    """Resolved config: defaults < env (`RAY_TPU_<NAME>`) < explicit overrides."""
+
+    def __init__(self):
+        self._values = dict(_DEFS)
+        for key in _DEFS:
+            env = os.environ.get("RAY_TPU_" + key.upper())
+            if env is not None:
+                self._values[key] = _parse(env, _DEFS[key])
+
+    def __getattr__(self, key):
+        try:
+            return self.__dict__["_values"][key]
+        except KeyError:
+            raise AttributeError(key)
+
+    def update(self, overrides: Dict[str, Any]):
+        for k, v in overrides.items():
+            if k not in self._values:
+                raise KeyError(f"unknown config key: {k}")
+            self._values[k] = v
+
+    def to_json(self) -> str:
+        return json.dumps(self._values)
+
+    def load_json(self, s: str):
+        self._values.update(json.loads(s))
+
+
+def _parse(env: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return env.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(env)
+    if isinstance(default, float):
+        return float(env)
+    return env
+
+
+RayConfig = _Config()

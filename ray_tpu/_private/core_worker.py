@@ -1,0 +1,777 @@
+"""CoreWorker — the in-process runtime embedded in every driver and worker.
+
+Equivalent of the reference's C++ CoreWorker
+(reference: src/ray/core_worker/core_worker.h:290 — task submission,
+ownership, in-process memory store, direct actor transport) plus the
+Python-side global worker (reference: python/ray/_private/worker.py:411).
+
+Ownership model (reference: src/ray/core_worker/reference_count.h): the
+process that creates an ObjectRef (by `put` or by submitting the task
+that returns it) *owns* it. Small results live in the owner's in-process
+store; large results live in the node's shared-memory arena with their
+location registered in the GCS object directory. Foreign processes
+resolve a ref via the directory, falling back to a direct RPC to the
+owner (which blocks until the producing task finishes).
+
+Transport (reference: src/ray/core_worker/transport/):
+  - normal tasks  : owner → GCS scheduler → raylet → worker; the worker
+                    pushes results straight back to the owner.
+  - actor tasks   : owner → actor worker directly over a cached
+                    connection with per-caller sequencing (the
+                    equivalent of direct_actor_task_submitter.cc).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.ids import hex_id, new_id
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.shm_store import ShmStore
+
+logger = logging.getLogger("ray_tpu.core_worker")
+
+MODE_DRIVER = "driver"
+MODE_WORKER = "worker"
+
+
+def _env_inline(data: bytes):
+    return {"k": "i", "d": data}
+
+
+def _env_shm(node_id: str, size: int):
+    return {"k": "s", "n": node_id, "z": size}
+
+
+def _env_err(exc: BaseException, function_name: str = ""):
+    import traceback
+
+    try:
+        import cloudpickle
+
+        blob = cloudpickle.dumps(exc)
+    except Exception:
+        blob = None
+    return {
+        "k": "e",
+        "p": blob,
+        "t": type(exc).__name__,
+        "m": str(exc),
+        "tb": traceback.format_exc(),
+        "fn": function_name,
+    }
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        mode: str,
+        gcs_addr: str,
+        session_dir: str,
+        node_id: Optional[str] = None,
+        shm_path: Optional[str] = None,
+        worker_id: Optional[str] = None,
+    ):
+        self.mode = mode
+        self.gcs_addr = gcs_addr
+        self.session_dir = session_dir
+        self.node_id = node_id
+        self.worker_id = worker_id or hex_id(new_id())
+        self.client_id: Optional[str] = None
+        self.job_id: Optional[str] = None
+
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(target=self._run_loop, daemon=True, name="core-worker-io")
+        self._loop_ready = threading.Event()
+
+        self._gcs: Optional[protocol.Connection] = None
+        self._listen_addr: Optional[str] = None
+        self._peer_conns: Dict[str, protocol.Connection] = {}  # addr -> conn
+        self._peer_lock: Optional[asyncio.Lock] = None
+
+        # in-process store: oid -> envelope; pending: oid -> Future(envelope)
+        self._store: Dict[bytes, Dict[str, Any]] = {}
+        self._pending: Dict[bytes, asyncio.Future] = {}
+
+        self._shm: Optional[ShmStore] = ShmStore(shm_path) if shm_path else None
+        self._shm_path = shm_path
+        # Objects we've handed out zero-copy views of stay pinned (store
+        # refcount held) until free()/shutdown — eviction must never
+        # invalidate a live numpy view. One pin per (process, object).
+        self._pinned: Dict[bytes, Any] = {}
+
+        # function table cache
+        self._fn_cache: Dict[str, Any] = {}
+        self._exported_fns: set = set()
+
+        # task bookkeeping for owner-side retries
+        # task_id -> {"spec": .., "retries_left": int}
+        self._submitted: Dict[str, Dict[str, Any]] = {}
+
+        # actor transport: per-actor ordered sender queues
+        self._actor_addr_cache: Dict[str, str] = {}
+        self._actor_queues: Dict[str, "collections.deque"] = {}
+        self._actor_senders: Dict[str, asyncio.Task] = {}
+
+        self._subscriptions: Dict[str, List] = {}
+        self.executor = None  # set by worker_proc for executor workers
+        self._closed = False
+
+    # ------------------------------------------------------------- lifecycle
+    def _run_loop(self):
+        asyncio.set_event_loop(self._loop)
+        self._loop_ready.set()
+        self._loop.run_forever()
+
+    def start(self):
+        self._loop_thread.start()
+        self._loop_ready.wait()
+        self._call(self._astart())
+
+    def _call(self, coro, timeout=None):
+        """Run a coroutine on the IO loop from any thread."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout)
+
+    async def _astart(self):
+        self._peer_lock = asyncio.Lock()
+        sock = os.path.join(self.session_dir, f"client-{self.worker_id[:12]}.sock")
+        self._listen_server, _ = await protocol.serve(f"unix:{sock}", self._handle_peer, name=f"cw-{self.mode}")
+        # dual-listen: unix for same-host peers (fast path), tcp for
+        # cross-host owners/results (reference: every worker runs a gRPC
+        # server reachable cluster-wide)
+        node_ip = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
+        self._tcp_server, tcp_addr = await protocol.serve("tcp:0.0.0.0:0", self._handle_peer, name=f"cw-{self.mode}-tcp")
+        port = tcp_addr.rsplit(":", 1)[1]
+        self._listen_addr = f"unix:{sock};tcp:{node_ip}:{port}"
+        self._gcs = await protocol.connect(self.gcs_addr, self._handle_gcs, name="gcs-client")
+        reply = await self._gcs.request(
+            "register",
+            {
+                "kind": self.mode,
+                "pid": os.getpid(),
+                "addr": self._listen_addr,
+                "node_id": self.node_id,
+                "entrypoint": " ".join(os.sys.argv[:2]),
+            },
+        )
+        self.client_id = reply["client_id"]
+        self.job_id = reply.get("job_id")
+        RayConfig.load_json(reply["config"])
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+
+        async def _aclose():
+            for c in self._peer_conns.values():
+                await c.close()
+            if self._gcs:
+                await self._gcs.close()
+            self._listen_server.close()
+
+        try:
+            self._call(_aclose(), timeout=5)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._loop_thread.join(timeout=5)
+        if self._shm:
+            self._shm.close()
+
+    # ---------------------------------------------------------- connections
+    async def _peer(self, addr: str) -> protocol.Connection:
+        """addr may be multi-form 'unix:...;tcp:...': prefer the unix path
+        when it exists on this host, else tcp."""
+        async with self._peer_lock:
+            conn = self._peer_conns.get(addr)
+            if conn is None or conn.closed:
+                last_err: Optional[Exception] = None
+                conn = None
+                for cand in addr.split(";"):
+                    if cand.startswith("unix:") and not os.path.exists(cand[5:]):
+                        continue
+                    try:
+                        conn = await protocol.connect(cand, self._handle_peer, name=f"peer-{cand[-12:]}")
+                        break
+                    except OSError as e:
+                        last_err = e
+                if conn is None:
+                    raise last_err or ConnectionRefusedError(f"no reachable address in {addr}")
+                self._peer_conns[addr] = conn
+            return conn
+
+    # --------------------------------------------------- incoming (GCS push)
+    async def _handle_gcs(self, method: str, data, conn):
+        if method == "task.failed":
+            await self._on_task_failed(data)
+            return True
+        if method == "pubsub.message":
+            self._dispatch_pubsub(data)
+            return True
+        if method == "owner.resolve":
+            return await self._serve_owner_resolve(data)
+        raise ValueError(f"unexpected GCS push {method}")
+
+    # ----------------------------------------------- incoming (peer-to-peer)
+    async def _handle_peer(self, method: str, data, conn):
+        if method == "task.result":
+            for item in data["results"]:
+                self._deliver(bytes(item["oid"]), item["env"])
+            return True
+        if method == "owner.resolve":
+            return await self._serve_owner_resolve(data)
+        if method == "call.actor":
+            if self.executor is None:
+                raise RuntimeError("not an executor worker")
+            return await self.executor.handle_actor_call(data, conn)
+        if method == "exec.cancel":
+            if self.executor is not None:
+                self.executor.cancel(data["task_id"], data.get("force", False))
+            return True
+        if method == "ping":
+            return "pong"
+        raise ValueError(f"unexpected peer method {method}")
+
+    async def _serve_owner_resolve(self, data):
+        oid = bytes(data["oid"])
+        env = self._store.get(oid)
+        if env is not None:
+            return env
+        fut = self._pending.get(oid)
+        if fut is None:
+            return {"k": "lost"}
+        return await asyncio.wait_for(asyncio.shield(fut), data.get("timeout", 300.0))
+
+    def _deliver(self, oid: bytes, env: Dict[str, Any]):
+        self._store[oid] = env
+        fut = self._pending.pop(oid, None)
+        if fut is not None and not fut.done():
+            fut.set_result(env)
+
+    # -------------------------------------------------------------- objects
+    def put(self, value: Any, owner_inline_to_gcs: bool = True) -> ObjectRef:
+        """ray.put equivalent (reference: worker.py:2685 → CoreWorker::Put)."""
+        if isinstance(value, ObjectRef):
+            raise TypeError("put of an ObjectRef is not allowed")
+        oid = new_id()
+        pickled, buffers, _ = serialization.serialize(value)
+        total = serialization.serialized_size(pickled, buffers)
+        if total <= RayConfig.object_store_inline_max_bytes or self._shm is None:
+            data = bytearray(total)
+            n = serialization.write_to(memoryview(data), pickled, buffers)
+            env = _env_inline(bytes(data[:n]))
+            self._deliver(oid, env)
+            self._call(self._gcs.request("obj.put_inline", {"oid": oid, "data": env["d"]}))
+        else:
+            buf = self._shm.create_buffer(oid, total)
+            serialization.write_to(buf, pickled, buffers)
+            buf.release()
+            self._shm.seal(oid)
+            env = _env_shm(self.node_id, total)
+            self._deliver(oid, env)
+            self._call(
+                self._gcs.request("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": total})
+            )
+        return ObjectRef(oid)
+
+    def put_serialized_to_shm(self, oid: bytes, pickled, buffers) -> Dict[str, Any]:
+        """Write an already-serialized value into the node arena; returns env."""
+        total = serialization.serialized_size(pickled, buffers)
+        buf = self._shm.create_buffer(oid, total)
+        serialization.write_to(buf, pickled, buffers)
+        buf.release()
+        self._shm.seal(oid)
+        self._call(self._gcs.request("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": total}))
+        return _env_shm(self.node_id, total)
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        envs = self._call(self._aget_envs([r.binary() for r in refs], timeout))
+        return [self._decode(env) for env in envs]
+
+    async def _aget_envs(self, oids: List[bytes], timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for oid in oids:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            out.append(await self._aresolve(oid, remaining))
+        return out
+
+    async def _aresolve(self, oid: bytes, timeout: Optional[float]) -> Dict[str, Any]:
+        env = self._store.get(oid)
+        if env is not None:
+            return env
+        fut = self._pending.get(oid)
+        if fut is not None:
+            try:
+                return await asyncio.wait_for(asyncio.shield(fut), timeout)
+            except asyncio.TimeoutError:
+                raise exceptions.GetTimeoutError(f"get timed out on {oid.hex()}")
+        # not owned by us — consult the directory
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        while True:
+            reply = await self._gcs.request("obj.resolve", {"oid": oid, "node_id": self.node_id})
+            status = reply["status"]
+            if status == "inline":
+                env = _env_inline(reply["data"])
+                self._store[oid] = env
+                return env
+            if status == "local":
+                return _env_shm(self.node_id, reply["size"])
+            if status == "owner":
+                try:
+                    conn = await self._peer(reply["owner_addr"])
+                    t = None if deadline is None else max(0.0, deadline - time.monotonic())
+                    env = await conn.request("owner.resolve", {"oid": oid}, timeout=t)
+                except (protocol.ConnectionLost, asyncio.TimeoutError) as e:
+                    if isinstance(e, asyncio.TimeoutError):
+                        raise exceptions.GetTimeoutError(f"get timed out on {oid.hex()}")
+                    raise exceptions.ObjectLostError(oid.hex(), "owner died") from None
+                if env.get("k") == "lost":
+                    raise exceptions.ObjectLostError(oid.hex())
+                if env.get("k") == "s" and env["n"] != self.node_id and self.node_id is not None:
+                    # location registered now; loop so the directory transfers
+                    # it to our node — bounded by the caller's deadline
+                    if deadline is not None and time.monotonic() >= deadline:
+                        raise exceptions.GetTimeoutError(f"get timed out on {oid.hex()}")
+                    await asyncio.sleep(0.01)
+                    continue
+                self._store[oid] = env
+                return env
+            if status == "unknown" or status == "lost":
+                raise exceptions.ObjectLostError(oid.hex(), f"object {oid.hex()} {status}")
+            raise RuntimeError(f"bad resolve status {status}")
+
+    def _decode(self, env: Dict[str, Any]) -> Any:
+        kind = env["k"]
+        if kind == "i":
+            return serialization.from_buffer(memoryview(env["d"]), zero_copy=False)
+        if kind == "s":
+            if env["n"] == self.node_id and self._shm is not None:
+                raise RuntimeError("shm env should carry oid for local read")
+            raise exceptions.ObjectLostError("?", "cannot decode remote shm env")
+        if kind == "e":
+            raise self._rebuild_error(env)
+        raise RuntimeError(f"bad envelope {kind}")
+
+    def _decode_ref(self, oid: bytes, env: Dict[str, Any]) -> Any:
+        kind = env["k"]
+        if kind == "s":
+            if self._shm is not None and env["n"] == self.node_id:
+                buf = self._pinned.get(oid)
+                if buf is None:
+                    buf = self._shm.get(oid, timeout_ms=30000)
+                    if buf is None:
+                        raise exceptions.ObjectLostError(oid.hex(), "evicted from local store")
+                    # hold the store refcount for the life of this process
+                    # (or until free()) so zero-copy views stay valid
+                    self._pinned[oid] = buf
+                return serialization.from_buffer(buf.view, zero_copy=True)
+            # no local arena (remote driver) — chunk-fetch from the raylet
+            # that has it (reference: object_manager Pull into a client
+            # without a local store)
+            data = self._call(self._afetch_via_raylet(oid, env))
+            return serialization.from_buffer(memoryview(data), zero_copy=False)
+        return self._decode(env)
+
+    async def _afetch_via_raylet(self, oid: bytes, env: Dict[str, Any]) -> bytes:
+        nodes = await self._gcs.request("node.list")
+        node = next((n for n in nodes if n["node_id"] == env["n"] and n["state"] == "ALIVE"), None)
+        if node is None:
+            raise exceptions.ObjectLostError(oid.hex(), "holding node is gone")
+        conn = await self._peer(node["addr"])
+        meta = await conn.request("fetch.meta", {"oid": oid})
+        if not meta.get("found"):
+            raise exceptions.ObjectLostError(oid.hex(), "not at holding node")
+        size = meta["size"]
+        out = bytearray(size)
+        off = 0
+        chunk = 4 * 1024 * 1024
+        while off < size:
+            part = await conn.request("fetch.read", {"oid": oid, "off": off, "len": min(chunk, size - off)})
+            out[off : off + len(part)] = part
+            off += len(part)
+        return bytes(out)
+
+    def _rebuild_error(self, env) -> BaseException:
+        if env.get("p"):
+            try:
+                import cloudpickle
+
+                exc = cloudpickle.loads(env["p"])
+                if env.get("c"):  # cancelled
+                    return exc
+                return exc
+            except Exception:
+                pass
+        if env.get("t") == "TaskCancelledError":
+            return exceptions.TaskCancelledError(env.get("m", ""))
+        return exceptions.TaskError(env.get("fn", "?"), env.get("tb", env.get("m", "")), env.get("t", ""))
+
+    def get_values(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        """get() with local-shm decoding (the public path)."""
+        oids = [r.binary() for r in refs]
+        envs = self._call(self._aget_envs(oids, timeout))
+        out = []
+        for oid, env in zip(oids, envs):
+            val = self._decode_ref(oid, env)
+            out.append(val)
+        return out
+
+    def wait(
+        self,
+        refs: List[ObjectRef],
+        num_returns: int = 1,
+        timeout: Optional[float] = None,
+        fetch_local: bool = True,
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        ready_set = self._call(self._await_ready([r.binary() for r in refs], num_returns, timeout))
+        ready, not_ready = [], []
+        for r in refs:
+            (ready if r.binary() in ready_set and len(ready) < num_returns else not_ready).append(r)
+        return ready, not_ready
+
+    async def _await_ready(self, oids: List[bytes], num_returns: int, timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: set = set()
+        while True:
+            for oid in oids:
+                if oid in ready:
+                    continue
+                if oid in self._store:
+                    ready.add(oid)
+                    continue
+                if oid not in self._pending:
+                    # foreign ref — nonblocking directory probe
+                    reply = await self._gcs.request("obj.locations", {"oid": oid})
+                    if reply and (reply["has_inline"] or reply["locations"]):
+                        ready.add(oid)
+            if len(ready) >= num_returns:
+                return ready
+            if deadline is not None and time.monotonic() >= deadline:
+                return ready
+            waiters = [self._pending[oid] for oid in oids if oid in self._pending and oid not in ready]
+            t = 0.05 if not waiters else None
+            if waiters:
+                t = 0.25 if deadline is None else min(0.25, max(0.0, deadline - time.monotonic()))
+                await asyncio.wait(waiters, timeout=t, return_when=asyncio.FIRST_COMPLETED)
+            else:
+                await asyncio.sleep(0.05 if deadline is None else min(0.05, max(0.0, deadline - time.monotonic())))
+
+    def free(self, refs: List[ObjectRef]):
+        oids = [r.binary() for r in refs]
+        for oid in oids:
+            self._store.pop(oid, None)
+            buf = self._pinned.pop(oid, None)
+            if buf is not None:
+                buf.release()
+            if self._shm is not None:
+                self._shm.delete(oid)
+        self._call(self._gcs.request("obj.free", {"oids": oids}))
+
+    # ------------------------------------------------------------- functions
+    def export_function(self, fn) -> str:
+        import hashlib
+
+        blob = serialization.dumps_function(fn)
+        fn_id = hashlib.sha256(blob).hexdigest()[:32]
+        if fn_id not in self._exported_fns:
+            self._call(self._gcs.request("fn.put", {"fn_id": fn_id, "blob": blob}))
+            self._exported_fns.add(fn_id)
+        return fn_id
+
+    def load_function(self, fn_id: str):
+        fn = self._fn_cache.get(fn_id)
+        if fn is None:
+            blob = self._call(self._gcs.request("fn.get", {"fn_id": fn_id}))
+            fn = serialization.loads_function(blob)
+            self._fn_cache[fn_id] = fn
+        return fn
+
+    # ----------------------------------------------------------- serialization of args
+    def pack_args(self, args: tuple, kwargs: dict) -> Dict[str, Any]:
+        """Top-level ObjectRefs are passed by reference (resolved to values
+        by the executor); everything else is serialized inline or via shm
+        (reference: inline-small-args in dependency_resolver.cc)."""
+        packed = []
+        for a in args:
+            packed.append(self._pack_one(a))
+        packed_kw = {k: self._pack_one(v) for k, v in kwargs.items()}
+        return {"a": packed, "kw": packed_kw}
+
+    def _pack_one(self, value):
+        if isinstance(value, ObjectRef):
+            return {"r": value.binary()}
+        pickled, buffers, _ = serialization.serialize(value)
+        total = serialization.serialized_size(pickled, buffers)
+        if total <= RayConfig.object_store_inline_max_bytes or self._shm is None:
+            data = bytearray(total)
+            n = serialization.write_to(memoryview(data), pickled, buffers)
+            return {"v": bytes(data[:n])}
+        # large arg → promote to an owned shm object, pass by ref
+        oid = new_id()
+        env = self.put_serialized_to_shm(oid, pickled, buffers)
+        self._deliver(oid, env)
+        return {"r": oid}
+
+    def unpack_args(self, packed: Dict[str, Any]):
+        args = [self._unpack_one(p) for p in packed["a"]]
+        kwargs = {k: self._unpack_one(p) for k, p in packed["kw"].items()}
+        return args, kwargs
+
+    def _unpack_one(self, p):
+        if "v" in p:
+            return serialization.from_buffer(memoryview(p["v"]), zero_copy=False)
+        oid = bytes(p["r"])
+        env = self._call(self._aget_envs([oid], 300.0))[0]
+        return self._decode_ref(oid, env)
+
+    # ----------------------------------------------------------------- tasks
+    def submit_task(
+        self,
+        fn_id: str,
+        args: tuple,
+        kwargs: dict,
+        name: str,
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        max_retries: Optional[int] = None,
+        scheduling: Optional[Dict[str, Any]] = None,
+    ) -> List[ObjectRef]:
+        task_id = hex_id(new_id())
+        returns = [new_id() for _ in range(num_returns)]
+        spec = {
+            "task_id": task_id,
+            "fn_id": fn_id,
+            "name": name,
+            "args": self.pack_args(args, kwargs),
+            "returns": returns,
+            "resources": resources or {"CPU": 1.0},
+            "max_retries": RayConfig.task_max_retries_default if max_retries is None else max_retries,
+            "owner_addr": self._listen_addr,
+            **(scheduling or {}),
+        }
+        self._call(self._asubmit(spec))
+        return [ObjectRef(oid) for oid in returns]
+
+    async def _asubmit(self, spec):
+        for oid in spec["returns"]:
+            if oid not in self._pending:
+                self._pending[oid] = self._loop.create_future()
+        self._submitted[spec["task_id"]] = {"spec": spec, "retries_left": spec.get("max_retries", 0)}
+        await self._gcs.request("task.submit", {"spec": spec})
+
+    async def _on_task_failed(self, data):
+        rec = self._submitted.get(data["task_id"])
+        if rec is None:
+            return
+        if data.get("retriable") and rec["retries_left"] > 0 and not data.get("cancelled"):
+            rec["retries_left"] -= 1
+            logger.info("retrying task %s (%d retries left)", data["task_id"], rec["retries_left"])
+            await self._gcs.request("task.submit", {"spec": rec["spec"]})
+            return
+        self._submitted.pop(data["task_id"], None)
+        if data.get("cancelled"):
+            err = _env_err(exceptions.TaskCancelledError(rec["spec"].get("name", "")), rec["spec"].get("name", ""))
+            err["t"] = "TaskCancelledError"
+        else:
+            err = _env_err(
+                exceptions.WorkerCrashedError(f"task failed: {data.get('error')}"), rec["spec"].get("name", "")
+            )
+        for oid in rec["spec"]["returns"]:
+            self._deliver(oid, err)
+
+    def task_completed(self, task_id: str):
+        self._submitted.pop(task_id, None)
+
+    # ---------------------------------------------------------------- actors
+    def create_actor(self, spec: Dict[str, Any]):
+        self._call(self._gcs.request("actor.create", {"spec": spec}))
+
+    def actor_info(self, actor_id: str, wait_ready=False, timeout=60.0):
+        return self._call(
+            self._gcs.request("actor.get_info", {"actor_id": actor_id, "wait_ready": wait_ready, "timeout": timeout})
+        )
+
+    def submit_actor_task(
+        self,
+        actor_id: str,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        num_returns: int = 1,
+        max_task_retries: int = 0,
+    ) -> List[ObjectRef]:
+        task_id = hex_id(new_id())
+        returns = [new_id() for _ in range(num_returns)]
+        spec = {
+            "task_id": task_id,
+            "actor_id": actor_id,
+            "method": method_name,
+            "args": self.pack_args(args, kwargs),
+            "returns": returns,
+            "caller": self.client_id,
+        }
+        self._call(self._asubmit_actor(spec, max_task_retries))
+        return [ObjectRef(oid) for oid in returns]
+
+    async def _asubmit_actor(self, spec, retries_left: int):
+        import collections
+
+        for oid in spec["returns"]:
+            self._pending[oid] = self._loop.create_future()
+        actor_id = spec["actor_id"]
+        q = self._actor_queues.setdefault(actor_id, collections.deque())
+        q.append((spec, retries_left))
+        sender = self._actor_senders.get(actor_id)
+        if sender is None or sender.done():
+            self._actor_senders[actor_id] = asyncio.get_running_loop().create_task(
+                self._actor_sender_loop(actor_id)
+            )
+        await self._gcs.request("obj.register_owned", {"oids": spec["returns"]})
+
+    def _fail_call(self, spec, exc: BaseException):
+        err = _env_err(exc)
+        err["t"] = type(exc).__name__
+        for oid in spec["returns"]:
+            self._deliver(oid, err)
+
+    async def _actor_sender_loop(self, actor_id: str):
+        """Single sender per actor: sends calls strictly in submission order
+        over one connection (wire order = execution start order on the
+        actor), pipelined — replies are awaited out-of-band. Equivalent of
+        the reference's sequenced direct actor transport
+        (src/ray/core_worker/transport/direct_actor_task_submitter.cc +
+        actor_scheduling_queue.cc; here ordering rides the TCP stream).
+
+        Pre-send failures never consume `max_task_retries` (the call did
+        not execute; waiting out a restart is safe). In-flight failures may
+        have executed, so they retry only while `max_task_retries` allows.
+        """
+        q = self._actor_queues[actor_id]
+        while q:
+            spec, retries_left = q[0]
+            # resolve the actor address, waiting out restarts
+            try:
+                addr = self._actor_addr_cache.get(actor_id)
+                if addr is None:
+                    info = await self._gcs.request(
+                        "actor.get_info", {"actor_id": actor_id, "wait_ready": True, "timeout": 300.0}
+                    )
+                    if info["state"] == "DEAD":
+                        q.popleft()
+                        self._fail_call(
+                            spec,
+                            exceptions.ActorDiedError(
+                                f"actor is dead: {info.get('death_cause')}", actor_id=actor_id
+                            ),
+                        )
+                        continue
+                    addr = info["addr"]
+                    self._actor_addr_cache[actor_id] = addr
+                conn = await self._peer(addr)
+            except (protocol.ConnectionLost, OSError):
+                self._actor_addr_cache.pop(actor_id, None)
+                await asyncio.sleep(0.2)
+                continue
+            except (protocol.RpcError, asyncio.TimeoutError, TimeoutError) as e:
+                q.popleft()
+                self._fail_call(spec, exceptions.ActorUnavailableError(f"actor unavailable: {e}", actor_id=actor_id))
+                continue
+            except Exception as e:
+                q.popleft()
+                self._fail_call(spec, e)
+                continue
+
+            try:
+                reply_fut = await conn.request_send("call.actor", {"spec": spec})
+            except (protocol.ConnectionLost, OSError):
+                self._actor_addr_cache.pop(actor_id, None)
+                await asyncio.sleep(0.1)
+                continue
+            q.popleft()
+            asyncio.get_running_loop().create_task(self._await_actor_reply(actor_id, spec, retries_left, reply_fut))
+        self._actor_senders.pop(actor_id, None)
+
+    async def _await_actor_reply(self, actor_id: str, spec, retries_left: int, reply_fut):
+        try:
+            reply = await reply_fut
+            for item in reply["results"]:
+                self._deliver(bytes(item["oid"]), item["env"])
+            return
+        except protocol.RpcError as e:
+            self._fail_call(spec, exceptions.ActorError(f"actor call failed: {e}", actor_id=actor_id))
+            return
+        except (protocol.ConnectionLost, OSError):
+            self._actor_addr_cache.pop(actor_id, None)
+            try:
+                info = await self._gcs.request("actor.get_info", {"actor_id": actor_id, "wait_ready": False})
+            except Exception:
+                info = {"state": "DEAD", "death_cause": "gcs unreachable"}
+            if info["state"] == "DEAD" or retries_left <= 0:
+                self._fail_call(
+                    spec,
+                    exceptions.ActorDiedError(
+                        f"actor died: {info.get('death_cause', 'connection lost during call')}",
+                        actor_id=actor_id,
+                    ),
+                )
+                return
+            # re-enqueue for re-execution on the restarted actor
+            await self._asubmit_actor_requeue(spec, retries_left - 1)
+        except Exception as e:
+            self._fail_call(spec, e)
+
+    async def _asubmit_actor_requeue(self, spec, retries_left: int):
+        import collections
+
+        actor_id = spec["actor_id"]
+        q = self._actor_queues.setdefault(actor_id, collections.deque())
+        q.append((spec, retries_left))
+        sender = self._actor_senders.get(actor_id)
+        if sender is None or sender.done():
+            self._actor_senders[actor_id] = asyncio.get_running_loop().create_task(
+                self._actor_sender_loop(actor_id)
+            )
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        self._call(self._gcs.request("actor.kill", {"actor_id": actor_id, "no_restart": no_restart}))
+
+    def cancel_task(self, task_id_or_ref, force=False):
+        # map ref -> task id via submitted table
+        if isinstance(task_id_or_ref, ObjectRef):
+            oid = task_id_or_ref.binary()
+            task_id = None
+            for tid, rec in self._submitted.items():
+                if oid in rec["spec"].get("returns", []):
+                    task_id = tid
+                    break
+            if task_id is None:
+                return False
+        else:
+            task_id = task_id_or_ref
+        return self._call(self._gcs.request("task.cancel", {"task_id": task_id, "force": force}))
+
+    # ------------------------------------------------------------------ misc
+    def gcs_request(self, method: str, data=None, timeout=None):
+        return self._call(self._gcs.request(method, data), timeout=timeout)
+
+    def subscribe(self, channel: str, callback):
+        self._subscriptions.setdefault(channel, []).append(callback)
+        self._call(self._gcs.request("sub.subscribe", {"channel": channel}))
+
+    def _dispatch_pubsub(self, data):
+        for cb in self._subscriptions.get(data["channel"], []):
+            try:
+                cb(data["data"])
+            except Exception:
+                logger.exception("pubsub callback failed")

@@ -1,0 +1,992 @@
+"""GCS — the head-node control plane authority.
+
+Equivalent of the reference's `gcs_server`
+(reference: src/ray/gcs/gcs_server/gcs_server.cc:141-232 which wires
+KV → NodeManager → ClusterTaskManager → ResourceManager → HealthCheck →
+FunctionManager → Job → PlacementGroup → Actor → Worker → TaskManager).
+Same managers here, one asyncio process:
+
+  - NodeManager      — node registration, health, resource views
+  - KvManager        — namespaced KV store (function table, rendezvous,
+                       internal_kv; reference: gcs_kv_manager.cc)
+  - Scheduler        — cluster task queue + hybrid placement policy
+                       (reference: gcs_actor_scheduler.cc + raylet
+                       cluster_task_manager.cc; centralized here — on a
+                       TPU cluster the scheduling unit is a slice-sized
+                       gang, so the head can own the queue)
+  - ActorManager     — actor FT/registry (reference: gcs_actor_manager.cc)
+  - PlacementGroups  — bundle reservation incl. TPU slice gangs
+                       (reference: gcs_placement_group_manager.cc)
+  - ObjectDirectory  — ownership-based object metadata
+                       (reference: ownership_based_object_directory.cc)
+  - PubSub           — channels for logs/errors/events
+                       (reference: src/ray/pubsub/publisher.h)
+  - TaskEvents       — task state-transition sink for the state API
+                       (reference: gcs_task_manager.cc)
+
+Run: `python -m ray_tpu._private.gcs --session-dir ... [--port N]`
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import collections
+import json
+import logging
+import os
+import random
+import time
+from typing import Any, Dict, List, Optional, Set
+
+from ray_tpu._private import protocol
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.ids import hex_id, new_id
+
+logger = logging.getLogger("ray_tpu.gcs")
+
+# actor lifecycle states (reference: rpc::ActorTableData states)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class KvManager:
+    def __init__(self):
+        self._data: Dict[str, Dict[str, bytes]] = collections.defaultdict(dict)
+
+    def put(self, ns: str, key: str, value: bytes, overwrite: bool = True) -> bool:
+        d = self._data[ns]
+        if not overwrite and key in d:
+            return False
+        d[key] = value
+        return True
+
+    def get(self, ns: str, key: str):
+        return self._data[ns].get(key)
+
+    def delete(self, ns: str, key: str) -> bool:
+        return self._data[ns].pop(key, None) is not None
+
+    def keys(self, ns: str, prefix: str = "") -> List[str]:
+        return [k for k in self._data[ns] if k.startswith(prefix)]
+
+
+class PubSub:
+    def __init__(self):
+        self._subs: Dict[str, Set[protocol.Connection]] = collections.defaultdict(set)
+
+    def subscribe(self, channel: str, conn: protocol.Connection):
+        self._subs[channel].add(conn)
+
+    def unsubscribe_all(self, conn: protocol.Connection):
+        for subs in self._subs.values():
+            subs.discard(conn)
+
+    async def publish(self, channel: str, data: Any):
+        dead = []
+        for conn in self._subs[channel]:
+            if conn.closed:
+                dead.append(conn)
+                continue
+            try:
+                await conn.push("pubsub.message", {"channel": channel, "data": data})
+            except Exception:
+                dead.append(conn)
+        for c in dead:
+            self._subs[channel].discard(c)
+
+
+class GcsServer:
+    def __init__(self, session_dir: str, port: int = 0):
+        self.session_dir = session_dir
+        self.port = port
+        self.kv = KvManager()
+        self.pubsub = PubSub()
+
+        # client registry: client_id(hex) -> info dict (kind, addr, conn, node_id)
+        self.clients: Dict[str, Dict[str, Any]] = {}
+        self.conn_client: Dict[protocol.Connection, str] = {}
+
+        # node table: node_id(hex) -> {addr, resources_total, resources_available,
+        #   labels, shm_path, conn, state, last_heartbeat}
+        self.nodes: Dict[str, Dict[str, Any]] = {}
+
+        # object directory: oid(bytes) -> {owner (client hex), inline: bytes|None,
+        #   locations: set(node hex), size, spilled_path}
+        self.objects: Dict[bytes, Dict[str, Any]] = {}
+
+        # actors: actor_id(hex) -> record
+        self.actors: Dict[str, Dict[str, Any]] = {}
+        self.named_actors: Dict[tuple, str] = {}  # (ns, name) -> actor_id hex
+
+        # scheduler state
+        self.pending_tasks: collections.deque = collections.deque()
+        self.inflight: Dict[str, Dict[str, Any]] = {}  # task_id -> {spec, node, worker}
+        self._sched_wakeup = asyncio.Event()
+
+        # placement groups: pg_id hex -> record
+        self.placement_groups: Dict[str, Dict[str, Any]] = {}
+
+        # jobs + events (observability)
+        self.jobs: Dict[str, Dict[str, Any]] = {}
+        self.task_events: collections.deque = collections.deque(maxlen=100000)
+
+        self._server = None
+        self.address: Optional[str] = None
+
+    # ------------------------------------------------------------------ serve
+    async def start(self):
+        sock_path = os.path.join(self.session_dir, "gcs.sock")
+        self._unix_server, _ = await protocol.serve(f"unix:{sock_path}", self._handle, name="gcs")
+        self._tcp_server, tcp_addr = await protocol.serve(f"tcp:0.0.0.0:{self.port}", self._handle, name="gcs")
+        self.address = tcp_addr
+        with open(os.path.join(self.session_dir, "gcs_address"), "w") as f:
+            f.write(tcp_addr + "\n" + f"unix:{sock_path}")
+        asyncio.get_running_loop().create_task(self._scheduler_loop())
+        asyncio.get_running_loop().create_task(self._health_loop())
+        logger.info("GCS listening on %s and unix:%s", tcp_addr, sock_path)
+
+    async def _handle(self, method: str, data: Any, conn: protocol.Connection):
+        handler = getattr(self, "_rpc_" + method.replace(".", "_"), None)
+        if handler is None:
+            raise ValueError(f"unknown GCS method {method}")
+        return await handler(data or {}, conn)
+
+    # ---------------------------------------------------------------- clients
+    async def _rpc_register(self, d, conn):
+        kind = d["kind"]
+        client_id = hex_id(new_id())
+        info = {
+            "client_id": client_id,
+            "kind": kind,
+            "addr": d.get("addr"),
+            "pid": d.get("pid"),
+            "conn": conn,
+            "node_id": d.get("node_id"),
+            "job_id": d.get("job_id"),
+        }
+        self.clients[client_id] = info
+        self.conn_client[conn] = client_id
+        conn.on_close = self._on_conn_close
+
+        out = {"client_id": client_id, "config": RayConfig.to_json(), "session_dir": self.session_dir}
+        if kind == "raylet":
+            node_id = d.get("node_id") or hex_id(new_id())
+            info["node_id"] = node_id
+            self.nodes[node_id] = {
+                "node_id": node_id,
+                "addr": d["addr"],
+                "node_ip": d.get("node_ip", "127.0.0.1"),
+                "resources_total": dict(d.get("resources", {})),
+                "resources_available": dict(d.get("resources", {})),
+                "labels": d.get("labels", {}),
+                "shm_path": d.get("shm_path"),
+                "conn": conn,
+                "state": "ALIVE",
+                "last_heartbeat": time.time(),
+                "start_time": time.time(),
+            }
+            out["node_id"] = node_id
+            self._sched_wakeup.set()
+            await self.pubsub.publish("node", {"event": "added", "node_id": node_id})
+        elif kind == "driver":
+            job_id = hex_id(new_id())
+            info["job_id"] = job_id
+            self.jobs[job_id] = {
+                "job_id": job_id,
+                "driver_pid": d.get("pid"),
+                "start_time": time.time(),
+                "state": "RUNNING",
+                "entrypoint": d.get("entrypoint", ""),
+            }
+            out["job_id"] = job_id
+        return out
+
+    async def _on_conn_close(self, conn: protocol.Connection):
+        client_id = self.conn_client.pop(conn, None)
+        if client_id is None:
+            return
+        info = self.clients.pop(client_id, None)
+        self.pubsub.unsubscribe_all(conn)
+        if info is None:
+            return
+        if info["kind"] == "raylet" and info.get("node_id"):
+            await self._fail_node(info["node_id"], "raylet disconnected")
+        elif info["kind"] == "driver":
+            job = self.jobs.get(info.get("job_id") or "")
+            if job:
+                job["state"] = "FINISHED"
+                job["end_time"] = time.time()
+            await self._cleanup_driver(client_id, info)
+
+    async def _cleanup_driver(self, client_id: str, info):
+        """Kill non-detached actors owned by the exiting driver; drop owned objects."""
+        for actor_id, rec in list(self.actors.items()):
+            if rec.get("owner") == client_id and rec.get("lifetime") != "detached" and rec["state"] != DEAD:
+                await self._destroy_actor(actor_id, "owner driver exited", no_restart=True)
+        for oid, rec in list(self.objects.items()):
+            if rec.get("owner") == client_id and not rec.get("locations") and rec.get("inline") is None:
+                del self.objects[oid]
+
+    # ------------------------------------------------------------------- kv
+    async def _rpc_kv_put(self, d, conn):
+        return self.kv.put(d.get("ns", "default"), d["key"], d["value"], d.get("overwrite", True))
+
+    async def _rpc_kv_get(self, d, conn):
+        return self.kv.get(d.get("ns", "default"), d["key"])
+
+    async def _rpc_kv_del(self, d, conn):
+        return self.kv.delete(d.get("ns", "default"), d["key"])
+
+    async def _rpc_kv_keys(self, d, conn):
+        return self.kv.keys(d.get("ns", "default"), d.get("prefix", ""))
+
+    async def _rpc_kv_exists(self, d, conn):
+        return self.kv.get(d.get("ns", "default"), d["key"]) is not None
+
+    # ------------------------------------------------------------- functions
+    async def _rpc_fn_put(self, d, conn):
+        self.kv.put("fn", d["fn_id"], d["blob"], overwrite=False)
+        return True
+
+    async def _rpc_fn_get(self, d, conn):
+        blob = self.kv.get("fn", d["fn_id"])
+        if blob is None:
+            raise KeyError(f"function {d['fn_id']} not found")
+        return blob
+
+    # ----------------------------------------------------------------- nodes
+    async def _rpc_node_list(self, d, conn):
+        return [
+            {k: v for k, v in n.items() if k != "conn"}
+            for n in self.nodes.values()
+        ]
+
+    async def _rpc_cluster_resources(self, d, conn):
+        out: Dict[str, float] = collections.defaultdict(float)
+        for n in self.nodes.values():
+            if n["state"] != "ALIVE":
+                continue
+            for k, v in n["resources_total"].items():
+                out[k] += v
+        return dict(out)
+
+    async def _rpc_cluster_available_resources(self, d, conn):
+        out: Dict[str, float] = collections.defaultdict(float)
+        for n in self.nodes.values():
+            if n["state"] != "ALIVE":
+                continue
+            for k, v in n["resources_available"].items():
+                out[k] += v
+        return dict(out)
+
+    async def _rpc_heartbeat(self, d, conn):
+        node = self.nodes.get(d["node_id"])
+        if node:
+            node["last_heartbeat"] = time.time()
+            if "load" in d:
+                node["load"] = d["load"]
+        return True
+
+    async def _fail_node(self, node_id: str, reason: str):
+        node = self.nodes.get(node_id)
+        if not node or node["state"] == "DEAD":
+            return
+        node["state"] = "DEAD"
+        node["death_reason"] = reason
+        logger.warning("node %s failed: %s", node_id, reason)
+        await self.pubsub.publish("node", {"event": "removed", "node_id": node_id, "reason": reason})
+        # fail in-flight tasks on that node (owner-side retry decides what next)
+        for task_id, rec in list(self.inflight.items()):
+            if rec["node"] == node_id:
+                await self._task_failed(task_id, f"node died: {reason}", retriable=True)
+        # actors on that node die
+        for actor_id, rec in list(self.actors.items()):
+            if rec.get("node_id") == node_id and rec["state"] in (ALIVE, PENDING_CREATION):
+                await self._on_actor_death(actor_id, f"node died: {reason}")
+        # objects located only there are lost
+        for oid, rec in self.objects.items():
+            rec["locations"].discard(node_id)
+
+    async def _health_loop(self):
+        period = RayConfig.health_check_period_s
+        timeout = RayConfig.health_check_timeout_s
+        while True:
+            await asyncio.sleep(period)
+            now = time.time()
+            for node_id, node in list(self.nodes.items()):
+                if node["state"] == "ALIVE" and now - node["last_heartbeat"] > timeout:
+                    await self._fail_node(node_id, "health check timeout")
+
+    # ------------------------------------------------------------- scheduler
+    def _resources_fit(self, avail: Dict[str, float], req: Dict[str, float]) -> bool:
+        return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items() if v)
+
+    def _pick_node(self, spec: Dict[str, Any]) -> Optional[str]:
+        """Hybrid policy: pack onto busiest feasible node until the critical
+        utilization threshold, then spread (reference:
+        src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc:186).
+        Placement-group bundles and node-affinity override."""
+        req = dict(spec.get("resources") or {})
+        pg_id = spec.get("placement_group_id")
+        if pg_id:
+            pg = self.placement_groups.get(pg_id)
+            if not pg or pg["state"] != "CREATED":
+                return None
+            idx = spec.get("bundle_index", -1)
+            candidates = (
+                [pg["bundle_nodes"][idx]] if idx >= 0 else list(dict.fromkeys(pg["bundle_nodes"]))
+            )
+            for node_id in candidates:
+                node = self.nodes.get(node_id)
+                if node and node["state"] == "ALIVE" and self._resources_fit(node["resources_available"], req):
+                    return node_id
+            return None
+
+        affinity = spec.get("node_id_affinity")
+        if affinity:
+            node = self.nodes.get(affinity)
+            if node and node["state"] == "ALIVE" and self._resources_fit(node["resources_available"], req):
+                return affinity
+            if not spec.get("node_affinity_soft", False):
+                return None
+
+        alive = [n for n in self.nodes.values() if n["state"] == "ALIVE"]
+        hard_labels = spec.get("label_affinity_hard") or {}
+        if hard_labels:
+            alive = [n for n in alive if all(n["labels"].get(k) == v for k, v in hard_labels.items())]
+        feasible = [n for n in alive if self._resources_fit(n["resources_available"], req)]
+        if not feasible:
+            return None
+        strategy = spec.get("scheduling_strategy", "DEFAULT")
+        soft_labels = spec.get("label_affinity_soft") or {}
+        if soft_labels:
+            preferred = [
+                n for n in feasible if all(n["labels"].get(k) == v for k, v in soft_labels.items())
+            ]
+            feasible = preferred or feasible
+
+        def utilization(n):
+            tot = n["resources_total"]
+            used = 0.0
+            cnt = 0
+            for k, t in tot.items():
+                if t > 0:
+                    used += (t - n["resources_available"].get(k, 0.0)) / t
+                    cnt += 1
+            return used / max(cnt, 1)
+
+        if strategy == "SPREAD":
+            return min(feasible, key=utilization)["node_id"]
+        threshold = RayConfig.scheduler_spread_threshold
+        below = [n for n in feasible if utilization(n) < threshold]
+        pool = below or feasible
+        # pack: highest utilization first, with top-k randomization
+        pool.sort(key=utilization, reverse=True)
+        k = max(1, int(len(pool) * RayConfig.scheduler_top_k_fraction))
+        return random.choice(pool[:k])["node_id"]
+
+    async def _rpc_task_submit(self, d, conn):
+        spec = d["spec"]
+        spec["owner"] = self.conn_client.get(conn)
+        # register owned return objects as pending
+        for oid in spec.get("returns", []):
+            self.objects[oid] = {
+                "owner": spec["owner"],
+                "inline": None,
+                "locations": set(),
+                "size": 0,
+                "task_id": spec["task_id"],
+            }
+        self.pending_tasks.append(spec)
+        self._record_event(spec, "PENDING_NODE_ASSIGNMENT")
+        self._sched_wakeup.set()
+        return True
+
+    async def _scheduler_loop(self):
+        """Drains the pending queue whenever resources/nodes change
+        (reference: ClusterTaskManager::ScheduleAndDispatchTasks,
+        src/ray/raylet/scheduling/cluster_task_manager.cc:130)."""
+        while True:
+            await self._sched_wakeup.wait()
+            self._sched_wakeup.clear()
+            # pending placement groups first: node joins / freed resources
+            # may have made them feasible (reference: pending PG queue in
+            # gcs_placement_group_manager.cc SchedulePendingPlacementGroups)
+            for rec in self.placement_groups.values():
+                if rec["state"] == "PENDING":
+                    self._try_place_pg(rec)
+            unplaced: List[Dict[str, Any]] = []
+            while self.pending_tasks:
+                spec = self.pending_tasks.popleft()
+                if spec.get("cancelled"):
+                    continue
+                node_id = self._pick_node(spec)
+                if node_id is None:
+                    unplaced.append(spec)
+                    continue
+                await self._dispatch(spec, node_id)
+            self.pending_tasks.extend(unplaced)
+
+    async def _dispatch(self, spec: Dict[str, Any], node_id: str):
+        node = self.nodes[node_id]
+        req = spec.get("resources") or {}
+        for k, v in req.items():
+            node["resources_available"][k] = node["resources_available"].get(k, 0.0) - v
+        task_id = spec["task_id"]
+        self.inflight[task_id] = {"spec": spec, "node": node_id, "worker": None}
+        self._record_event(spec, "SUBMITTED_TO_WORKER", node_id=node_id)
+        if spec.get("actor_creation"):
+            actor = self.actors.get(spec["actor_id"])
+            if actor is not None:
+                actor["state"] = PENDING_CREATION
+                actor["node_id"] = node_id
+        try:
+            await node["conn"].push("raylet.dispatch", {"spec": spec})
+        except Exception:
+            await self._task_failed(task_id, "dispatch failed: raylet gone", retriable=True)
+
+    def _release_task_resources(self, task_id: str):
+        rec = self.inflight.pop(task_id, None)
+        if rec is None:
+            return None
+        node = self.nodes.get(rec["node"])
+        if node and node["state"] == "ALIVE":
+            for k, v in (rec["spec"].get("resources") or {}).items():
+                node["resources_available"][k] = node["resources_available"].get(k, 0.0) + v
+        self._sched_wakeup.set()
+        return rec
+
+    async def _rpc_task_finished(self, d, conn):
+        rec = self._release_task_resources(d["task_id"])
+        if rec is not None:
+            self._record_event(rec["spec"], "FINISHED")
+            if d.get("worker_id"):
+                rec["worker"] = d["worker_id"]
+        return True
+
+    async def _rpc_task_failed(self, d, conn):
+        await self._task_failed(d["task_id"], d.get("error", "unknown"), d.get("retriable", True))
+        return True
+
+    async def _task_failed(self, task_id: str, error: str, retriable: bool):
+        rec = self._release_task_resources(task_id)
+        if rec is None:
+            return
+        spec = rec["spec"]
+        self._record_event(spec, "FAILED", error=error)
+        if spec.get("actor_creation"):
+            await self._on_actor_creation_failed(spec, error, retriable)
+            return
+        # notify owner so it can retry or surface the error
+        owner = self.clients.get(spec.get("owner") or "")
+        if owner is not None:
+            try:
+                await owner["conn"].push(
+                    "task.failed", {"task_id": task_id, "error": error, "retriable": retriable}
+                )
+            except Exception:
+                pass
+
+    async def _rpc_task_cancel(self, d, conn):
+        task_id = d["task_id"]
+        for spec in self.pending_tasks:
+            if spec["task_id"] == task_id:
+                spec["cancelled"] = True
+                owner = self.clients.get(spec.get("owner") or "")
+                if owner:
+                    try:
+                        await owner["conn"].push(
+                            "task.failed",
+                            {"task_id": task_id, "error": "TaskCancelledError", "retriable": False, "cancelled": True},
+                        )
+                    except Exception:
+                        pass
+                return True
+        rec = self.inflight.get(task_id)
+        if rec and d.get("force"):
+            node = self.nodes.get(rec["node"])
+            if node and rec.get("worker"):
+                await node["conn"].push("raylet.kill_worker", {"worker_id": rec["worker"], "force": True})
+            return True
+        if rec:
+            node = self.nodes.get(rec["node"])
+            if node:
+                await node["conn"].push("raylet.cancel", {"task_id": task_id})
+            return True
+        return False
+
+    async def _rpc_task_worker_assigned(self, d, conn):
+        rec = self.inflight.get(d["task_id"])
+        if rec is not None:
+            rec["worker"] = d["worker_id"]
+            self._record_event(rec["spec"], "RUNNING", worker_id=d["worker_id"])
+        return True
+
+    # ---------------------------------------------------------------- actors
+    async def _rpc_actor_create(self, d, conn):
+        spec = d["spec"]
+        owner = self.conn_client.get(conn)
+        actor_id = spec["actor_id"]
+        name = spec.get("name")
+        ns = spec.get("namespace", "default")
+        if name:
+            key = (ns, name)
+            if key in self.named_actors and self.actors[self.named_actors[key]]["state"] != DEAD:
+                raise ValueError(f"actor name '{name}' already taken in namespace '{ns}'")
+            self.named_actors[key] = actor_id
+        self.actors[actor_id] = {
+            "actor_id": actor_id,
+            "owner": owner,
+            "name": name,
+            "namespace": ns,
+            "class_name": spec.get("class_name", ""),
+            "state": DEPENDENCIES_UNREADY,
+            "addr": None,
+            "node_id": None,
+            "worker_id": None,
+            "lifetime": spec.get("lifetime"),
+            "max_restarts": spec.get("max_restarts", 0),
+            "num_restarts": 0,
+            "creation_spec": spec,
+            "death_cause": None,
+            "waiters": [],
+            "start_time": time.time(),
+        }
+        spec["owner"] = owner
+        spec["actor_creation"] = True
+        self.pending_tasks.append(spec)
+        self._sched_wakeup.set()
+        return True
+
+    async def _rpc_actor_ready(self, d, conn):
+        """Raylet reports the actor instance is constructed and listening.
+
+        Explicitly-requested actor resources (num_tpus=4 etc.) stay held
+        for the actor's lifetime (reference semantics: actor resources are
+        lifetime resources); the default creation CPU is released here.
+        """
+        actor = self.actors.get(d["actor_id"])
+        rec = self.inflight.pop(d["task_id"], None)
+        if rec is not None:
+            spec = rec["spec"]
+            if spec.get("hold_resources") and actor is not None:
+                actor["held_resources"] = (rec["node"], dict(spec.get("resources") or {}))
+            else:
+                node = self.nodes.get(rec["node"])
+                if node and node["state"] == "ALIVE":
+                    for k, v in (spec.get("resources") or {}).items():
+                        node["resources_available"][k] = node["resources_available"].get(k, 0.0) + v
+            self._sched_wakeup.set()
+        if actor is None:
+            return False
+        actor["state"] = ALIVE
+        actor["addr"] = d["addr"]
+        actor["worker_id"] = d["worker_id"]
+        actor["node_id"] = d["node_id"]
+        for fut in actor["waiters"]:
+            if not fut.done():
+                fut.set_result(None)
+        actor["waiters"].clear()
+        await self.pubsub.publish("actor", {"event": "alive", "actor_id": d["actor_id"]})
+        return True
+
+    async def _on_actor_creation_failed(self, spec, error: str, retriable: bool):
+        actor = self.actors.get(spec["actor_id"])
+        if actor is None:
+            return
+        if retriable and actor["num_restarts"] < actor["max_restarts"]:
+            actor["num_restarts"] += 1
+            actor["state"] = RESTARTING
+            self.pending_tasks.append(actor["creation_spec"])
+            self._sched_wakeup.set()
+        else:
+            await self._destroy_actor(spec["actor_id"], f"creation failed: {error}", no_restart=True)
+
+    async def _on_actor_death(self, actor_id: str, reason: str):
+        actor = self.actors.get(actor_id)
+        if actor is None or actor["state"] == DEAD:
+            return
+        self._release_actor_held(actor)
+        if actor["num_restarts"] < actor["max_restarts"]:
+            actor["num_restarts"] += 1
+            actor["state"] = RESTARTING
+            actor["addr"] = None
+            logger.info("restarting actor %s (%d/%d): %s", actor_id, actor["num_restarts"], actor["max_restarts"], reason)
+            self.pending_tasks.append(actor["creation_spec"])
+            self._sched_wakeup.set()
+            await self.pubsub.publish("actor", {"event": "restarting", "actor_id": actor_id})
+        else:
+            await self._destroy_actor(actor_id, reason, no_restart=True)
+
+    def _release_actor_held(self, actor):
+        held = actor.pop("held_resources", None)
+        if held:
+            node_id, res = held
+            node = self.nodes.get(node_id)
+            if node and node["state"] == "ALIVE":
+                for k, v in res.items():
+                    node["resources_available"][k] = node["resources_available"].get(k, 0.0) + v
+            self._sched_wakeup.set()
+
+    async def _destroy_actor(self, actor_id: str, reason: str, no_restart: bool = False):
+        actor = self.actors.get(actor_id)
+        if actor is None or actor["state"] == DEAD:
+            return
+        self._release_actor_held(actor)
+        actor["state"] = DEAD
+        actor["death_cause"] = reason
+        actor["end_time"] = time.time()
+        for fut in actor["waiters"]:
+            if not fut.done():
+                fut.set_exception(RuntimeError(f"actor died: {reason}"))
+        actor["waiters"].clear()
+        if actor.get("name"):
+            self.named_actors.pop((actor["namespace"], actor["name"]), None)
+        # tell the raylet to kill the worker if it is still around
+        node = self.nodes.get(actor.get("node_id") or "")
+        if node and node["state"] == "ALIVE" and actor.get("worker_id"):
+            try:
+                await node["conn"].push("raylet.kill_worker", {"worker_id": actor["worker_id"], "force": True})
+            except Exception:
+                pass
+        await self.pubsub.publish("actor", {"event": "dead", "actor_id": actor_id, "reason": reason})
+
+    async def _rpc_actor_kill(self, d, conn):
+        actor = self.actors.get(d["actor_id"])
+        if actor is None:
+            return False
+        if d.get("no_restart", True):
+            actor["max_restarts"] = actor["num_restarts"]  # disable further restarts
+        await self._destroy_actor(d["actor_id"], "ray.kill", no_restart=d.get("no_restart", True))
+        return True
+
+    async def _rpc_actor_died(self, d, conn):
+        """Raylet reports an actor worker process exited."""
+        await self._on_actor_death(d["actor_id"], d.get("reason", "worker process died"))
+        return True
+
+    async def _rpc_actor_get_info(self, d, conn):
+        actor = self.actors.get(d["actor_id"])
+        if actor is None:
+            raise KeyError(f"actor {d['actor_id']} not found")
+        if d.get("wait_ready") and actor["state"] in (DEPENDENCIES_UNREADY, PENDING_CREATION, RESTARTING):
+            fut = asyncio.get_running_loop().create_future()
+            actor["waiters"].append(fut)
+            timeout = d.get("timeout", 60.0)
+            try:
+                await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                raise TimeoutError(f"actor {d['actor_id']} not ready in {timeout}s")
+        return {
+            "actor_id": actor["actor_id"],
+            "state": actor["state"],
+            "addr": actor["addr"],
+            "node_id": actor["node_id"],
+            "death_cause": actor["death_cause"],
+            "name": actor["name"],
+        }
+
+    async def _rpc_actor_get_by_name(self, d, conn):
+        key = (d.get("namespace", "default"), d["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None:
+            raise KeyError(f"no actor named {d['name']}")
+        return actor_id
+
+    async def _rpc_actor_list_named(self, d, conn):
+        ns = d.get("namespace")
+        return [
+            {"name": name, "namespace": n, "actor_id": aid}
+            for (n, name), aid in self.named_actors.items()
+            if ns is None or n == ns
+        ]
+
+    # --------------------------------------------------------------- objects
+    async def _rpc_obj_register_owned(self, d, conn):
+        owner = self.conn_client.get(conn)
+        for oid in d["oids"]:
+            if oid not in self.objects:
+                self.objects[oid] = {"owner": owner, "inline": None, "locations": set(), "size": 0}
+        return True
+
+    async def _rpc_obj_put_inline(self, d, conn):
+        owner = self.conn_client.get(conn)
+        rec = self.objects.setdefault(d["oid"], {"owner": owner, "inline": None, "locations": set(), "size": 0})
+        rec["inline"] = d["data"]
+        rec["size"] = len(d["data"])
+        return True
+
+    async def _rpc_obj_add_location(self, d, conn):
+        rec = self.objects.get(d["oid"])
+        if rec is None:
+            owner = self.conn_client.get(conn)
+            rec = self.objects[d["oid"]] = {"owner": owner, "inline": None, "locations": set(), "size": 0}
+        rec["locations"].add(d["node_id"])
+        rec["size"] = d.get("size", rec["size"])
+        return True
+
+    async def _rpc_obj_resolve(self, d, conn):
+        """Resolve an object for a requester: inline value, a node that has
+        it, or the owner's address for a direct owner fetch (reference:
+        ownership-based object directory + pull manager)."""
+        oid = d["oid"]
+        rec = self.objects.get(oid)
+        if rec is None:
+            return {"status": "unknown"}
+        if rec["inline"] is not None:
+            return {"status": "inline", "data": rec["inline"]}
+        requester_node = d.get("node_id")
+        if rec["locations"]:
+            if requester_node in rec["locations"]:
+                return {"status": "local", "size": rec["size"]}
+            # orchestrate a raylet-to-raylet transfer into the requester node
+            src = next((n for n in rec["locations"] if self.nodes.get(n, {}).get("state") == "ALIVE"), None)
+            if src is None:
+                rec["locations"].clear()
+            else:
+                if requester_node is None:
+                    # requester has no local store (edge driver); owner path below
+                    pass
+                else:
+                    src_node = self.nodes[src]
+                    dst_node = self.nodes.get(requester_node)
+                    if dst_node is None:
+                        return {"status": "unknown"}
+                    await dst_node["conn"].request(
+                        "raylet.fetch",
+                        {"oid": oid, "from_addr": src_node["addr"], "size": rec["size"]},
+                    )
+                    rec["locations"].add(requester_node)
+                    return {"status": "local", "size": rec["size"]}
+        owner = self.clients.get(rec.get("owner") or "")
+        if owner is None:
+            return {"status": "lost"}
+        return {"status": "owner", "owner_addr": owner["addr"]}
+
+    async def _rpc_obj_free(self, d, conn):
+        for oid in d["oids"]:
+            rec = self.objects.pop(oid, None)
+            if rec is None:
+                continue
+            for node_id in rec["locations"]:
+                node = self.nodes.get(node_id)
+                if node and node["state"] == "ALIVE":
+                    try:
+                        await node["conn"].push("raylet.delete_objects", {"oids": [oid]})
+                    except Exception:
+                        pass
+        return True
+
+    async def _rpc_obj_locations(self, d, conn):
+        rec = self.objects.get(d["oid"])
+        if rec is None:
+            return None
+        return {"locations": list(rec["locations"]), "size": rec["size"], "has_inline": rec["inline"] is not None}
+
+    # ------------------------------------------------------------ placement groups
+    async def _rpc_pg_create(self, d, conn):
+        """Reserve bundles across nodes (reference 2-phase commit:
+        gcs_placement_group_scheduler.cc; here reservation is atomic in the
+        GCS's single-threaded resource view, prepared against live nodes)."""
+        pg_id = hex_id(new_id())
+        bundles: List[Dict[str, float]] = d["bundles"]
+        strategy = d.get("strategy", "PACK")
+        rec = {
+            "pg_id": pg_id,
+            "name": d.get("name", ""),
+            "bundles": bundles,
+            "strategy": strategy,
+            "state": "PENDING",
+            "bundle_nodes": [],
+            "owner": self.conn_client.get(conn),
+            "waiters": [],
+            "lifetime": d.get("lifetime"),
+        }
+        self.placement_groups[pg_id] = rec
+        ok = self._try_place_pg(rec)
+        if not ok:
+            rec["state"] = "PENDING"
+        return pg_id
+
+    def _try_place_pg(self, rec) -> bool:
+        bundles = rec["bundles"]
+        strategy = rec["strategy"]
+        alive = [n for n in self.nodes.values() if n["state"] == "ALIVE"]
+        avail = {n["node_id"]: dict(n["resources_available"]) for n in alive}
+        assignment: List[str] = []
+
+        def fits(node_id, req):
+            a = avail[node_id]
+            return all(a.get(k, 0.0) + 1e-9 >= v for k, v in req.items() if v)
+
+        def take(node_id, req):
+            for k, v in req.items():
+                avail[node_id][k] = avail[node_id].get(k, 0.0) - v
+
+        if strategy in ("STRICT_PACK",):
+            for n in alive:
+                node_id = n["node_id"]
+                trial = dict(avail[node_id])
+                ok = True
+                for b in bundles:
+                    if all(trial.get(k, 0.0) + 1e-9 >= v for k, v in b.items() if v):
+                        for k, v in b.items():
+                            trial[k] = trial.get(k, 0.0) - v
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    assignment = [node_id] * len(bundles)
+                    break
+            if not assignment:
+                return False
+        elif strategy == "STRICT_SPREAD":
+            used_nodes: Set[str] = set()
+            for b in bundles:
+                cand = [n["node_id"] for n in alive if n["node_id"] not in used_nodes and fits(n["node_id"], b)]
+                if not cand:
+                    return False
+                assignment.append(cand[0])
+                used_nodes.add(cand[0])
+                take(cand[0], b)
+        else:  # PACK / SPREAD best-effort
+            reverse = strategy == "PACK"
+            for b in bundles:
+                cand = [n["node_id"] for n in alive if fits(n["node_id"], b)]
+                if not cand:
+                    return False
+                cand.sort(key=lambda nid: sum(avail[nid].values()), reverse=not reverse)
+                choice = cand[0]
+                assignment.append(choice)
+                take(choice, b)
+
+        # commit: deduct from the real resource view
+        for node_id, b in zip(assignment, bundles):
+            node = self.nodes[node_id]
+            for k, v in b.items():
+                node["resources_available"][k] = node["resources_available"].get(k, 0.0) - v
+        rec["bundle_nodes"] = assignment
+        rec["state"] = "CREATED"
+        for fut in rec["waiters"]:
+            if not fut.done():
+                fut.set_result(None)
+        rec["waiters"].clear()
+        self._sched_wakeup.set()
+        return True
+
+    async def _rpc_pg_ready(self, d, conn):
+        rec = self.placement_groups.get(d["pg_id"])
+        if rec is None:
+            raise KeyError("placement group not found")
+        if rec["state"] == "CREATED":
+            return True
+        # retry placement now (nodes may have joined)
+        if self._try_place_pg(rec):
+            return True
+        fut = asyncio.get_running_loop().create_future()
+        rec["waiters"].append(fut)
+        await asyncio.wait_for(fut, d.get("timeout", 60.0))
+        return True
+
+    async def _rpc_pg_remove(self, d, conn):
+        rec = self.placement_groups.pop(d["pg_id"], None)
+        if rec is None:
+            return False
+        if rec["state"] == "CREATED":
+            for node_id, b in zip(rec["bundle_nodes"], rec["bundles"]):
+                node = self.nodes.get(node_id)
+                if node and node["state"] == "ALIVE":
+                    for k, v in b.items():
+                        node["resources_available"][k] = node["resources_available"].get(k, 0.0) + v
+        rec["state"] = "REMOVED"
+        self._sched_wakeup.set()
+        return True
+
+    async def _rpc_pg_table(self, d, conn):
+        return [
+            {k: v for k, v in rec.items() if k not in ("waiters", "owner")}
+            for rec in self.placement_groups.values()
+        ]
+
+    # ---------------------------------------------------------------- pubsub
+    async def _rpc_sub_subscribe(self, d, conn):
+        self.pubsub.subscribe(d["channel"], conn)
+        return True
+
+    async def _rpc_pub_publish(self, d, conn):
+        await self.pubsub.publish(d["channel"], d["data"])
+        return True
+
+    # ----------------------------------------------------------- observability
+    def _record_event(self, spec, state: str, **extra):
+        self.task_events.append(
+            {
+                "task_id": spec.get("task_id"),
+                "name": spec.get("name", ""),
+                "state": state,
+                "time": time.time(),
+                "actor_id": spec.get("actor_id"),
+                **extra,
+            }
+        )
+
+    async def _rpc_events_report(self, d, conn):
+        self.task_events.extend(d["events"])
+        return True
+
+    async def _rpc_state_tasks(self, d, conn):
+        limit = d.get("limit", 1000)
+        return list(self.task_events)[-limit:]
+
+    async def _rpc_state_actors(self, d, conn):
+        return [
+            {k: v for k, v in a.items() if k not in ("waiters", "creation_spec", "conn")}
+            for a in self.actors.values()
+        ]
+
+    async def _rpc_state_objects(self, d, conn):
+        out = []
+        for oid, rec in list(self.objects.items())[: d.get("limit", 1000)]:
+            out.append(
+                {
+                    "object_id": oid.hex() if isinstance(oid, bytes) else oid,
+                    "owner": rec.get("owner"),
+                    "size": rec.get("size", 0),
+                    "locations": list(rec.get("locations", ())),
+                    "inline": rec.get("inline") is not None,
+                }
+            )
+        return out
+
+    async def _rpc_state_jobs(self, d, conn):
+        return list(self.jobs.values())
+
+    async def _rpc_state_nodes(self, d, conn):
+        return await self._rpc_node_list(d, conn)
+
+    async def _rpc_state_placement_groups(self, d, conn):
+        return await self._rpc_pg_table(d, conn)
+
+
+async def _amain(args):
+    logging.basicConfig(level=logging.INFO)
+    server = GcsServer(args.session_dir, port=args.port)
+    await server.start()
+    # signal readiness to the parent
+    print("GCS_READY " + server.address, flush=True)
+    await asyncio.Event().wait()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

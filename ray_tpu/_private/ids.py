@@ -1,0 +1,85 @@
+"""Unique identifiers for tasks, actors, objects, nodes, placement groups.
+
+TPU-native rework of the reference ID scheme (reference:
+src/ray/common/id.h — TaskID/ActorID/ObjectID/NodeID as fixed-width binary
+ids). We keep fixed-width random ids but drop the embedded lineage bit
+tricks; ownership is tracked explicitly in the GCS object directory.
+"""
+from __future__ import annotations
+
+import os
+import binascii
+
+ID_LENGTH = 16  # bytes
+
+
+def new_id() -> bytes:
+    return os.urandom(ID_LENGTH)
+
+
+def hex_id(b: bytes) -> str:
+    return binascii.hexlify(b).decode()
+
+
+class BaseID:
+    __slots__ = ("_bytes",)
+    NIL: "BaseID"
+
+    def __init__(self, id_bytes: bytes):
+        if not isinstance(id_bytes, bytes) or len(id_bytes) != ID_LENGTH:
+            raise ValueError(f"bad id: {id_bytes!r}")
+        self._bytes = id_bytes
+
+    @classmethod
+    def from_random(cls):
+        return cls(new_id())
+
+    @classmethod
+    def from_hex(cls, s: str):
+        return cls(binascii.unhexlify(s))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * ID_LENGTH)
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return hex_id(self._bytes)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * ID_LENGTH
+
+    def __hash__(self):
+        return hash(self._bytes)
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+
+class TaskID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class ObjectID(BaseID):
+    pass
+
+
+class NodeID(BaseID):
+    pass
+
+
+class PlacementGroupID(BaseID):
+    pass
+
+
+class JobID(BaseID):
+    pass

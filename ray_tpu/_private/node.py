@@ -1,0 +1,205 @@
+"""Node bootstrap — starts and supervises the cluster processes.
+
+Equivalent of the reference's node/services layer
+(reference: python/ray/_private/node.py:306 start_head_processes,
+python/ray/_private/services.py:1421 start_gcs_server / :1485
+start_raylet). `init()` on a fresh machine spawns a `gcs` process and a
+`raylet` process (which owns the shm arena and the worker pool), then
+connects the driver; `init(address=...)` just connects.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.config import RayConfig
+
+
+def _die_with_parent():
+    """PR_SET_PDEATHSIG: kill the child if the spawning driver dies (even by
+    SIGKILL), so `init()`-local clusters can never outlive their driver.
+    Standalone clusters started via the CLI skip this (they set
+    RAY_TPU_DETACHED=1)."""
+    if os.environ.get("RAY_TPU_DETACHED") == "1":
+        return
+    try:
+        import ctypes
+
+        PR_SET_PDEATHSIG = 1
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(PR_SET_PDEATHSIG, signal.SIGKILL)
+    except Exception:
+        pass
+
+
+class NodeProcesses:
+    def __init__(self, session_dir: str):
+        self.session_dir = session_dir
+        self.procs: List[subprocess.Popen] = []
+        self.gcs_address: Optional[str] = None
+        self.gcs_local_address: Optional[str] = None
+        self.head_node_info: Optional[Dict[str, Any]] = None
+
+    def _spawn(self, args: List[str], log_name: str, ready_token: str, timeout=30.0) -> subprocess.Popen:
+        log_path = os.path.join(self.session_dir, "logs", log_name)
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        logf = open(log_path, "ab", buffering=0)
+        # ensure children can import ray_tpu even when the driver put it on
+        # sys.path manually (reference: services.py propagates PYTHONPATH)
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        parts = [pkg_root] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+        proc = subprocess.Popen(
+            [sys.executable, "-u"] + args,
+            stdout=subprocess.PIPE,
+            stderr=logf,
+            text=True,
+            start_new_session=True,
+            env=env,
+            preexec_fn=_die_with_parent,
+        )
+        self.procs.append(proc)
+        deadline = time.time() + timeout
+        token_line = None
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"{log_name} exited with {proc.returncode}; see {log_path}"
+                    )
+                continue
+            logf.write(line.encode())
+            if line.startswith(ready_token):
+                token_line = line.strip()
+                break
+        if token_line is None:
+            raise RuntimeError(f"{log_name} did not become ready in {timeout}s; see {log_path}")
+        # drain stdout to the log in the background so the pipe never fills
+        import threading
+
+        def _drain():
+            for line in proc.stdout:
+                try:
+                    logf.write(line.encode())
+                except Exception:
+                    break
+
+        threading.Thread(target=_drain, daemon=True).start()
+        return proc, token_line
+
+    def start_head(
+        self,
+        resources: Dict[str, float],
+        object_store_memory: int,
+        labels: Optional[Dict[str, str]] = None,
+        port: int = 0,
+    ):
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        _, line = self._spawn(
+            ["-m", "ray_tpu._private.gcs", "--session-dir", self.session_dir, "--port", str(port)],
+            "gcs.log",
+            "GCS_READY",
+        )
+        self.gcs_address = line.split(" ", 1)[1]
+        self.gcs_local_address = f"unix:{os.path.join(self.session_dir, 'gcs.sock')}"
+        self.start_raylet(resources, object_store_memory, labels=labels, name="head")
+        with open(os.path.join(self.session_dir, f"node-head.json")) as f:
+            self.head_node_info = json.load(f)
+
+    def start_raylet(
+        self,
+        resources: Dict[str, float],
+        object_store_memory: int,
+        labels: Optional[Dict[str, str]] = None,
+        name: str = "",
+        gcs_address: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        name = name or f"n{len(self.procs)}"
+        _, line = self._spawn(
+            [
+                "-m",
+                "ray_tpu._private.raylet",
+                "--gcs",
+                gcs_address or self.gcs_local_address or self.gcs_address,
+                "--session-dir",
+                self.session_dir,
+                "--resources",
+                json.dumps(resources),
+                "--labels",
+                json.dumps(labels or {}),
+                "--shm-bytes",
+                str(object_store_memory),
+                "--name",
+                name,
+            ],
+            f"raylet-{name}.log",
+            "RAYLET_READY",
+        )
+        with open(os.path.join(self.session_dir, f"node-{name}.json")) as f:
+            return json.load(f)
+
+    def kill_all(self):
+        # SIGTERM first so raylets run their cleanup (unlink shm arena,
+        # kill workers), then escalate to SIGKILL on the process group.
+        for proc in reversed(self.procs):
+            if proc.poll() is None:
+                try:
+                    proc.terminate()
+                except Exception:
+                    pass
+        deadline = time.time() + 3.0
+        for proc in self.procs:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except Exception:
+                pass
+        for proc in reversed(self.procs):
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    try:
+                        proc.kill()
+                    except Exception:
+                        pass
+        for proc in self.procs:
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                pass
+        self.procs.clear()
+
+
+def new_session_dir() -> str:
+    base = "/tmp/ray_tpu"
+    session = os.path.join(base, f"session_{time.strftime('%Y%m%d-%H%M%S')}_{os.getpid()}")
+    os.makedirs(os.path.join(session, "logs"), exist_ok=True)
+    latest = os.path.join(base, "session_latest")
+    try:
+        if os.path.islink(latest):
+            os.unlink(latest)
+        os.symlink(session, latest)
+    except OSError:
+        pass
+    return session
+
+
+def default_resources(num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
+                      resources: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+    from ray_tpu._private.accelerator_detect import detect_tpu_chips
+
+    out: Dict[str, float] = dict(resources or {})
+    out["CPU"] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
+    tpus = num_tpus if num_tpus is not None else detect_tpu_chips()
+    if tpus:
+        out["TPU"] = float(tpus)
+    out.setdefault("memory", float(os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")))
+    return out

@@ -1,0 +1,71 @@
+"""ObjectRef — a future-like handle to an object in the cluster.
+
+Equivalent of the reference's `ray.ObjectRef`
+(reference: python/ray/_raylet.pyx ObjectRef, python/ray/includes/object_ref.pxi).
+The id is 16 random bytes; ownership metadata lives in the GCS object
+directory rather than being encoded into the id.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "__weakref__")
+
+    def __init__(self, object_id: bytes):
+        if isinstance(object_id, ObjectID):
+            object_id = object_id.binary()
+        self._id = object_id
+
+    def binary(self) -> bytes:
+        return self._id
+
+    def hex(self) -> str:
+        return ObjectID(self._id).hex()
+
+    def task_id(self):  # parity shim
+        return None
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self.hex()})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self._id,))
+
+    # Allow `await ref` in async actors / drivers with a running loop.
+    def __await__(self):
+        return self.as_future().__await__()
+
+    def as_future(self):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        fut: Any = loop.create_future()
+
+        def _resolve():
+            try:
+                # route through the process-appropriate core (driver's or,
+                # inside an executor worker, the worker's own)
+                from ray_tpu._private.worker import get_global_core
+
+                values = get_global_core().get_values([self], timeout=None)
+                val = values[0]
+                if isinstance(val, BaseException):
+                    raise val
+                loop.call_soon_threadsafe(lambda: fut.done() or fut.set_result(val))
+            except BaseException as e:
+                loop.call_soon_threadsafe(lambda: fut.done() or fut.set_exception(e))
+
+        import threading
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut

@@ -1,0 +1,240 @@
+"""Control-plane wire protocol: length-prefixed msgpack over asyncio streams.
+
+The reference uses gRPC services for every cross-process boundary
+(reference: src/ray/rpc/, src/ray/protobuf/*.proto). On TPU hosts the
+control plane is not the bottleneck (the data plane is XLA/ICI), so we
+use a leaner symmetric RPC: 4-byte length prefix + msgpack body, with
+bidirectional request/response and one-way pushes over a single
+connection. Either endpoint may issue requests (the GCS pushes leases to
+raylets, raylets push tasks to workers) — the same role the reference's
+per-service gRPC stubs play.
+
+Message shape:
+    {"t": "req",  "i": <int>, "m": <method>, "d": <payload>}
+    {"t": "res",  "i": <int>, "ok": <bool>,  "d": <payload-or-error>}
+    {"t": "push",             "m": <method>, "d": <payload>}
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import struct
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    """Remote handler raised; message carries the remote traceback string."""
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+def pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(b: bytes) -> Any:
+    return msgpack.unpackb(b, raw=False, strict_map_key=False)
+
+
+class Connection:
+    """A symmetric RPC connection. `handler(method, data, conn)` serves
+    incoming requests/pushes; `request()` issues outgoing ones."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        handler: Callable[[str, Any, "Connection"], Awaitable[Any]],
+        name: str = "?",
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.name = name
+        self._req_ids = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._write_lock = asyncio.Lock()
+        self.on_close: Optional[Callable[["Connection"], Awaitable[None]]] = None
+        self._loop_task: Optional[asyncio.Task] = None
+
+    def start(self):
+        self._loop_task = asyncio.get_running_loop().create_task(self._read_loop())
+        return self._loop_task
+
+    async def _send(self, obj: Any):
+        body = pack(obj)
+        async with self._write_lock:
+            self.writer.write(_LEN.pack(len(body)) + body)
+            await self.writer.drain()
+
+    async def request(self, method: str, data: Any = None, timeout: Optional[float] = None) -> Any:
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        rid = next(self._req_ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            await self._send({"t": "req", "i": rid, "m": method, "d": data})
+            if timeout:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(rid, None)
+
+    async def request_send(self, method: str, data: Any = None) -> asyncio.Future:
+        """Send a request and return the reply future without awaiting it.
+        Guarantees wire order between successive calls (pipelining)."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        rid = next(self._req_ids)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            await self._send({"t": "req", "i": rid, "m": method, "d": data})
+        except Exception:
+            self._pending.pop(rid, None)
+            raise
+
+        def _cleanup(_):
+            self._pending.pop(rid, None)
+
+        fut.add_done_callback(_cleanup)
+        return fut
+
+    async def push(self, method: str, data: Any = None):
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        await self._send({"t": "push", "m": method, "d": data})
+
+    async def _read_loop(self):
+        try:
+            while True:
+                hdr = await self.reader.readexactly(4)
+                (n,) = _LEN.unpack(hdr)
+                if n > MAX_FRAME:
+                    raise ConnectionLost(f"frame too large: {n}")
+                body = await self.reader.readexactly(n)
+                msg = unpack(body)
+                t = msg.get("t")
+                if t == "res":
+                    fut = self._pending.get(msg["i"])
+                    if fut is not None and not fut.done():
+                        if msg["ok"]:
+                            fut.set_result(msg.get("d"))
+                        else:
+                            fut.set_exception(RpcError(msg.get("d")))
+                elif t == "req":
+                    asyncio.get_running_loop().create_task(self._serve(msg))
+                elif t == "push":
+                    asyncio.get_running_loop().create_task(self._serve_push(msg))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
+            pass
+        except Exception:
+            logger.exception("connection %s read loop error", self.name)
+        finally:
+            await self._teardown()
+
+    async def _serve(self, msg):
+        rid = msg["i"]
+        try:
+            result = await self.handler(msg["m"], msg.get("d"), self)
+            await self._send({"t": "res", "i": rid, "ok": True, "d": result})
+        except (ConnectionResetError, BrokenPipeError, ConnectionLost):
+            pass
+        except Exception as e:
+            import traceback
+
+            err = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+            try:
+                await self._send({"t": "res", "i": rid, "ok": False, "d": err})
+            except Exception:
+                pass
+
+    async def _serve_push(self, msg):
+        try:
+            await self.handler(msg["m"], msg.get("d"), self)
+        except Exception:
+            logger.exception("push handler %s failed on %s", msg.get("m"), self.name)
+
+    async def _teardown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close is not None:
+            try:
+                await self.on_close(self)
+            except Exception:
+                logger.exception("on_close for %s failed", self.name)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def close(self):
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+        await self._teardown()
+
+
+async def connect(
+    addr: str,
+    handler: Callable[[str, Any, Connection], Awaitable[Any]],
+    name: str = "client",
+) -> Connection:
+    """addr is 'unix:<path>' or 'tcp:<host>:<port>'."""
+    if addr.startswith("unix:"):
+        reader, writer = await asyncio.open_unix_connection(addr[5:])
+    elif addr.startswith("tcp:"):
+        host, port = addr[4:].rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+    else:
+        raise ValueError(f"bad address: {addr}")
+    conn = Connection(reader, writer, handler, name=name)
+    conn.start()
+    return conn
+
+
+async def serve(
+    addr: str,
+    handler: Callable[[str, Any, Connection], Awaitable[Any]],
+    on_connect: Optional[Callable[[Connection], Awaitable[None]]] = None,
+    name: str = "server",
+):
+    """Start a server; returns (asyncio server, resolved address)."""
+
+    async def _client_connected(reader, writer):
+        conn = Connection(reader, writer, handler, name=f"{name}-peer")
+        if on_connect is not None:
+            await on_connect(conn)
+        conn.start()
+
+    if addr.startswith("unix:"):
+        server = await asyncio.start_unix_server(_client_connected, path=addr[5:])
+        resolved = addr
+    elif addr.startswith("tcp:"):
+        host, port = addr[4:].rsplit(":", 1)
+        server = await asyncio.start_server(_client_connected, host=host, port=int(port))
+        sock = server.sockets[0]
+        resolved = f"tcp:{host}:{sock.getsockname()[1]}"
+    else:
+        raise ValueError(f"bad address: {addr}")
+    return server, resolved

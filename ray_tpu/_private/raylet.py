@@ -1,0 +1,453 @@
+"""Raylet — per-node daemon: worker pool, task dispatch, object transfer.
+
+Equivalent of the reference's raylet binary
+(reference: src/ray/raylet/main.cc:119 — NodeManager + WorkerPool +
+embedded plasma store). Here the node-local shared-memory arena
+(shm_store.cc) is created by the raylet at startup (the reference embeds
+plasma in the raylet the same way, reference:
+src/ray/object_manager/plasma/store_runner.h:14).
+
+Responsibilities:
+  - WorkerPool (reference: src/ray/raylet/worker_pool.h:104): prestart,
+    on-demand spawn, idle cache, process-exit supervision.
+  - Dispatch: receive `raylet.dispatch` from the GCS scheduler, lease a
+    worker, push `exec.task`; report finish/failure back.
+  - Object transfer: serve chunked reads of local arena objects to other
+    raylets and fetch remote objects into the local arena (reference:
+    src/ray/object_manager/object_manager.h:130,139 Push/Pull).
+  - Heartbeats to the GCS health manager.
+
+Run: `python -m ray_tpu._private.raylet --gcs ... --session-dir ...`
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import collections
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import protocol
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.ids import hex_id, new_id
+from ray_tpu._private.shm_store import ShmStore
+
+logger = logging.getLogger("ray_tpu.raylet")
+
+CHUNK = 4 * 1024 * 1024
+
+
+def _gc_stale_arenas():
+    """Unlink /dev/shm arenas whose owning raylet pid is gone (defense
+    against SIGKILLed clusters; names embed the creator pid)."""
+    import glob
+    import re
+
+    for path in glob.glob("/dev/shm/ray_tpu_*"):
+        m = re.match(r".*/ray_tpu_(\d+)_", path)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        except PermissionError:
+            pass
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: str, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.conn: Optional[protocol.Connection] = None
+        self.addr: Optional[str] = None
+        self.current_task: Optional[Dict[str, Any]] = None
+        self.is_actor = False
+        self.actor_id: Optional[str] = None
+        self.registered = asyncio.Event()
+        self.idle_since = time.time()
+
+
+class Raylet:
+    def __init__(self, gcs_addr: str, session_dir: str, resources: Dict[str, float],
+                 shm_bytes: int, labels: Dict[str, str], node_ip: str = "127.0.0.1",
+                 node_name: str = ""):
+        self.gcs_addr = gcs_addr
+        self.session_dir = session_dir
+        self.resources = resources
+        self.labels = labels
+        self.node_ip = node_ip
+        self.node_id: Optional[str] = None
+        self.name = node_name or hex_id(new_id())[:8]
+
+        _gc_stale_arenas()
+        self.shm_path = f"/dev/shm/ray_tpu_{os.getpid()}_{self.name}"
+        ShmStore.create(self.shm_path, shm_bytes)
+        self.store = ShmStore(self.shm_path)
+        # the arena dies with the raylet (plasma does the same: the store
+        # lives inside the raylet process, store_runner.cc)
+        import atexit
+
+        atexit.register(self._cleanup)
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: (self._cleanup(), os._exit(0)))
+
+        self.workers: Dict[str, WorkerHandle] = {}
+        self.idle: collections.deque = collections.deque()
+        self.starting = 0
+        self.queued: collections.deque = collections.deque()
+        self.max_workers = int(max(resources.get("CPU", 1), 1)) + 64  # actors beyond pool
+
+        self._gcs: Optional[protocol.Connection] = None
+        self._peer_conns: Dict[str, protocol.Connection] = {}
+
+    def _cleanup(self):
+        for h in list(getattr(self, "workers", {}).values()):
+            try:
+                h.proc.kill()
+            except Exception:
+                pass
+        try:
+            os.unlink(self.shm_path)
+        except OSError:
+            pass
+
+    # ---------------------------------------------------------------- startup
+    async def start(self):
+        sock = os.path.join(self.session_dir, f"raylet-{self.name}.sock")
+        self._unix_server, _ = await protocol.serve(f"unix:{sock}", self._handle, name="raylet")
+        self._tcp_server, tcp_addr = await protocol.serve(f"tcp:0.0.0.0:0", self._handle, name="raylet-tcp")
+        self.worker_sock = f"unix:{sock}"
+        # advertise a reachable address, not the bind address
+        port = tcp_addr.rsplit(":", 1)[1]
+        self.addr = tcp_addr = f"tcp:{self.node_ip}:{port}"
+
+        self._gcs = await protocol.connect(self.gcs_addr, self._handle_gcs, name="raylet-gcs")
+        reply = await self._gcs.request(
+            "register",
+            {
+                "kind": "raylet",
+                "pid": os.getpid(),
+                "addr": tcp_addr,
+                "node_ip": self.node_ip,
+                "resources": self.resources,
+                "labels": self.labels,
+                "shm_path": self.shm_path,
+            },
+        )
+        self.node_id = reply["node_id"]
+        RayConfig.load_json(reply["config"])
+        # drop a discovery file so a colocated driver can find its node
+        with open(os.path.join(self.session_dir, f"node-{self.name}.json"), "w") as f:
+            import json
+
+            json.dump({"node_id": self.node_id, "shm_path": self.shm_path, "raylet_sock": self.worker_sock,
+                       "addr": tcp_addr}, f)
+        asyncio.get_running_loop().create_task(self._heartbeat_loop())
+        asyncio.get_running_loop().create_task(self._reap_loop())
+        for _ in range(min(RayConfig.worker_pool_prestart, self.max_workers)):
+            self._start_worker()
+        logger.info("raylet %s node=%s up, %d prestarted", self.name, self.node_id, RayConfig.worker_pool_prestart)
+
+    async def _heartbeat_loop(self):
+        while True:
+            await asyncio.sleep(RayConfig.health_check_period_s / 2)
+            try:
+                await self._gcs.request(
+                    "heartbeat",
+                    {"node_id": self.node_id, "load": {"num_workers": len(self.workers), "queued": len(self.queued)}},
+                )
+            except protocol.ConnectionLost:
+                logger.error("GCS connection lost; exiting")
+                os._exit(1)
+
+    # ------------------------------------------------------------ worker pool
+    def _start_worker(self) -> None:
+        worker_id = hex_id(new_id())
+        env = dict(os.environ)
+        env.update(
+            {
+                "RAY_TPU_SESSION_DIR": self.session_dir,
+                "RAY_TPU_GCS_ADDR": self.gcs_addr,
+                "RAY_TPU_RAYLET_SOCK": self.worker_sock,
+                "RAY_TPU_NODE_ID": self.node_id or "",
+                "RAY_TPU_NODE_IP": self.node_ip,
+                "RAY_TPU_SHM_PATH": self.shm_path,
+                "RAY_TPU_WORKER_ID": worker_id,
+                # workers must not grab the TPU; tasks that want it set this
+                # themselves via resources (reference: CUDA_VISIBLE_DEVICES
+                # plumbing in _private/accelerators; here JAX_PLATFORMS)
+                "JAX_PLATFORMS": env.get("RAY_TPU_WORKER_JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "cpu")),
+            }
+        )
+        log_path = os.path.join(self.session_dir, "logs", f"worker-{worker_id[:12]}.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        logf = open(log_path, "ab")
+        def _worker_dies_with_raylet():
+            # unconditional: workers never outlive their raylet
+            try:
+                import ctypes
+
+                libc = ctypes.CDLL("libc.so.6", use_errno=True)
+                libc.prctl(1, signal.SIGKILL)  # PR_SET_PDEATHSIG
+            except Exception:
+                pass
+
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-m", "ray_tpu._private.worker_proc"],
+            env=env,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+            preexec_fn=_worker_dies_with_raylet,
+        )
+        h = WorkerHandle(worker_id, proc)
+        self.workers[worker_id] = h
+        self.starting += 1
+
+    async def _reap_loop(self):
+        """Supervise worker processes (reference: worker_pool.cc exit
+        detection feeding NodeManager worker-failure handling)."""
+        while True:
+            await asyncio.sleep(0.5)
+            for worker_id, h in list(self.workers.items()):
+                code = h.proc.poll()
+                if code is None:
+                    continue
+                self.workers.pop(worker_id, None)
+                if not h.registered.is_set():
+                    # died before registering — undo the startup slot
+                    self.starting = max(0, self.starting - 1)
+                try:
+                    self.idle.remove(worker_id)
+                except ValueError:
+                    pass
+                if h.conn and not h.conn.closed:
+                    await h.conn.close()
+                if h.current_task is not None:
+                    spec = h.current_task
+                    if spec.get("actor_creation"):
+                        await self._gcs.request(
+                            "task.failed",
+                            {"task_id": spec["task_id"], "error": f"worker died (exit {code})", "retriable": True},
+                        )
+                    else:
+                        await self._gcs.request(
+                            "task.failed",
+                            {"task_id": spec["task_id"], "error": f"worker died (exit {code})", "retriable": True},
+                        )
+                elif h.is_actor and h.actor_id:
+                    await self._gcs.request(
+                        "actor.died", {"actor_id": h.actor_id, "reason": f"worker process exited ({code})"}
+                    )
+                self._pump()
+
+    def _pump(self):
+        """Dispatch queued specs onto idle workers; spawn when short."""
+        while self.queued:
+            worker = None
+            while self.idle:
+                wid = self.idle.popleft()
+                h = self.workers.get(wid)
+                if h is not None and h.proc.poll() is None:
+                    worker = h
+                    break
+            if worker is None:
+                if self.starting == 0 and len(self.workers) < self.max_workers:
+                    self._start_worker()
+                return
+            spec = self.queued.popleft()
+            asyncio.get_running_loop().create_task(self._run_on_worker(worker, spec))
+
+    async def _run_on_worker(self, h: WorkerHandle, spec: Dict[str, Any]):
+        h.current_task = spec
+        try:
+            await self._gcs.request("task.worker_assigned", {"task_id": spec["task_id"], "worker_id": h.worker_id})
+            reply = await h.conn.request("exec.task", {"spec": spec})
+        except protocol.ConnectionLost:
+            return  # reap loop reports the failure
+        except Exception as e:
+            h.current_task = None
+            await self._gcs.request(
+                "task.failed", {"task_id": spec["task_id"], "error": f"dispatch error: {e}", "retriable": True}
+            )
+            self._return_worker(h)
+            return
+        h.current_task = None
+        if spec.get("actor_creation"):
+            if reply.get("ok"):
+                h.is_actor = True
+                h.actor_id = spec["actor_id"]
+                await self._gcs.request(
+                    "actor.ready",
+                    {
+                        "actor_id": spec["actor_id"],
+                        "task_id": spec["task_id"],
+                        "worker_id": h.worker_id,
+                        "addr": reply["addr"],
+                        "node_id": self.node_id,
+                    },
+                )
+            else:
+                await self._gcs.request(
+                    "task.failed",
+                    {"task_id": spec["task_id"], "error": reply.get("error", "actor init failed"), "retriable": False},
+                )
+                self._return_worker(h)
+        else:
+            await self._gcs.request("task.finished", {"task_id": spec["task_id"], "worker_id": h.worker_id})
+            self._return_worker(h)
+
+    def _return_worker(self, h: WorkerHandle):
+        if h.worker_id in self.workers and not h.is_actor:
+            h.idle_since = time.time()
+            self.idle.append(h.worker_id)
+        self._pump()
+
+    # ----------------------------------------------------------- GCS handlers
+    async def _handle_gcs(self, method: str, data, conn):
+        if method == "raylet.dispatch":
+            self.queued.append(data["spec"])
+            self._pump()
+            return True
+        if method == "raylet.kill_worker":
+            h = self.workers.get(data["worker_id"])
+            if h is not None:
+                try:
+                    h.proc.send_signal(signal.SIGKILL if data.get("force") else signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+            return True
+        if method == "raylet.cancel":
+            for spec in self.queued:
+                if spec["task_id"] == data["task_id"]:
+                    spec["cancelled"] = True
+            # forward to the executing worker if any
+            for h in self.workers.values():
+                if h.current_task and h.current_task["task_id"] == data["task_id"] and h.conn:
+                    await h.conn.push("exec.cancel", {"task_id": data["task_id"]})
+            return True
+        if method == "raylet.fetch":
+            return await self._fetch(data)
+        if method == "raylet.delete_objects":
+            for oid in data["oids"]:
+                self.store.delete(bytes(oid))
+            return True
+        if method == "raylet.prestart":
+            for _ in range(data.get("n", 1)):
+                if len(self.workers) < self.max_workers:
+                    self._start_worker()
+            return True
+        raise ValueError(f"unknown raylet method {method}")
+
+    # -------------------------------------------- worker + peer-raylet server
+    async def _handle(self, method: str, data, conn):
+        if method == "worker.register":
+            h = self.workers.get(data["worker_id"])
+            if h is None:
+                raise ValueError("unknown worker")
+            h.conn = conn
+            h.addr = data["addr"]
+            self.starting = max(0, self.starting - 1)
+            h.registered.set()
+            self.idle.append(h.worker_id)
+            self._pump()
+            return {"node_id": self.node_id}
+        if method == "fetch.meta":
+            oid = bytes(data["oid"])
+            buf = self.store.get(oid, timeout_ms=0)
+            if buf is None:
+                return {"found": False}
+            size = len(buf)
+            buf.release()
+            return {"found": True, "size": size}
+        if method == "fetch.read":
+            oid = bytes(data["oid"])
+            buf = self.store.get(oid, timeout_ms=0)
+            if buf is None:
+                raise KeyError("object gone")
+            try:
+                off, ln = data["off"], data["len"]
+                return bytes(buf.view[off : off + ln])
+            finally:
+                buf.release()
+        raise ValueError(f"unknown method {method}")
+
+    async def _fetch(self, data) -> bool:
+        """Pull an object from a remote raylet into the local arena in
+        chunks (reference: PullManager + chunked object transfer,
+        src/ray/object_manager/object_manager.h:139)."""
+        oid = bytes(data["oid"])
+        if self.store.contains(oid):
+            return True
+        addr = data["from_addr"]
+        conn = self._peer_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await protocol.connect(addr, self._handle, name="raylet-peer")
+            self._peer_conns[addr] = conn
+        meta = await conn.request("fetch.meta", {"oid": oid})
+        if not meta["found"]:
+            raise KeyError(f"object {oid.hex()} not at source")
+        size = meta["size"]
+        try:
+            buf = self.store.create_buffer(oid, size)
+        except FileExistsError:
+            return True
+        off = 0
+        try:
+            while off < size:
+                n = min(CHUNK, size - off)
+                chunk = await conn.request("fetch.read", {"oid": oid, "off": off, "len": n})
+                buf[off : off + len(chunk)] = chunk
+                off += len(chunk)
+        except Exception:
+            self.store.abort(oid)
+            raise
+        finally:
+            buf.release()
+        self.store.seal(oid)
+        return True
+
+
+async def _amain(args):
+    logging.basicConfig(level=logging.INFO)
+    import json
+
+    resources = json.loads(args.resources)
+    labels = json.loads(args.labels)
+    raylet = Raylet(
+        gcs_addr=args.gcs,
+        session_dir=args.session_dir,
+        resources=resources,
+        shm_bytes=args.shm_bytes,
+        labels=labels,
+        node_name=args.name,
+    )
+    await raylet.start()
+    print("RAYLET_READY " + raylet.node_id, flush=True)
+    await asyncio.Event().wait()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--resources", default='{"CPU": 1}')
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--shm-bytes", type=int, default=RayConfig.object_store_memory_bytes)
+    parser.add_argument("--name", default="")
+    args = parser.parse_args()
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""Object serialization: cloudpickle + out-of-band zero-copy buffers.
+
+Equivalent of the reference's serialization context
+(reference: python/ray/_private/serialization.py — cloudpickle with
+pickle5 buffer callbacks so numpy arrays are written into plasma without
+a copy). Same scheme here: the pickle stream is small; large contiguous
+buffers (numpy arrays, jax host arrays, arrow buffers) are carried
+out-of-band and can be written straight into the shared-memory arena and
+mapped back zero-copy on read.
+
+Wire format of a serialized object:
+    u32 n_buffers
+    u32 pickle_len, then pickle bytes
+    per buffer: u64 length, then raw bytes (8-byte aligned start)
+"""
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+from ray_tpu._private.object_ref import ObjectRef
+
+_HDR = struct.Struct("<II")
+_BUF_HDR = struct.Struct("<Q")
+_ALIGN = 8
+
+
+class _Pickler(cloudpickle.Pickler):
+    """Tracks contained ObjectRefs (for dependency/refcount bookkeeping)."""
+
+    def __init__(self, file, buffer_callback):
+        super().__init__(file, protocol=5, buffer_callback=buffer_callback)
+        self.contained_refs: List[ObjectRef] = []
+
+    def persistent_id(self, obj):
+        if type(obj) is ObjectRef:
+            self.contained_refs.append(obj)
+            return ("objectref", obj.binary())
+        return None
+
+
+class _Unpickler(pickle.Unpickler):
+    def __init__(self, file, buffers):
+        super().__init__(file, buffers=buffers)
+
+    def persistent_load(self, pid):
+        kind, payload = pid
+        if kind == "objectref":
+            return ObjectRef(payload)
+        raise pickle.UnpicklingError(f"unknown persistent id {kind}")
+
+
+def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer], List[ObjectRef]]:
+    """Returns (pickle_bytes, oob_buffers, contained_refs)."""
+    import io
+
+    buffers: List[pickle.PickleBuffer] = []
+    f = io.BytesIO()
+    p = _Pickler(f, buffers.append)
+    p.dump(value)
+    return f.getvalue(), buffers, p.contained_refs
+
+
+def serialized_size(pickled: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    total = _HDR.size + len(pickled)
+    for b in buffers:
+        total = _aligned(total) + _BUF_HDR.size
+        total += memoryview(b).nbytes
+    return total
+
+
+def _aligned(off: int) -> int:
+    return (off + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def write_to(buf: memoryview, pickled: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    """Writes the wire format into `buf`; returns bytes written."""
+    _HDR.pack_into(buf, 0, len(buffers), len(pickled))
+    off = _HDR.size
+    buf[off : off + len(pickled)] = pickled
+    off += len(pickled)
+    for b in buffers:
+        off = _aligned(off)
+        mv = memoryview(b).cast("B")
+        _BUF_HDR.pack_into(buf, off, mv.nbytes)
+        off += _BUF_HDR.size
+        buf[off : off + mv.nbytes] = mv
+        off += mv.nbytes
+    return off
+
+
+def to_bytes(value: Any) -> Tuple[bytes, List[ObjectRef]]:
+    """One-shot serialize to contiguous bytes (inline / control-plane path)."""
+    pickled, buffers, refs = serialize(value)
+    out = bytearray(serialized_size(pickled, buffers))
+    n = write_to(memoryview(out), pickled, buffers)
+    return bytes(out[:n]), refs
+
+
+def from_buffer(buf: memoryview, zero_copy: bool = True) -> Any:
+    """Deserialize the wire format. With zero_copy=True the returned numpy
+    arrays alias `buf` (valid while the underlying mapping is pinned)."""
+    import io
+
+    n_buffers, pickle_len = _HDR.unpack_from(buf, 0)
+    off = _HDR.size
+    pickled = bytes(buf[off : off + pickle_len])
+    off += pickle_len
+    oob = []
+    for _ in range(n_buffers):
+        off = _aligned(off)
+        (blen,) = _BUF_HDR.unpack_from(buf, off)
+        off += _BUF_HDR.size
+        view = buf[off : off + blen]
+        oob.append(view if zero_copy else bytearray(view))
+        off += blen
+    return _Unpickler(io.BytesIO(pickled), oob).load()
+
+
+def from_bytes(data: bytes) -> Any:
+    return from_buffer(memoryview(data), zero_copy=False)
+
+
+def dumps_function(fn) -> bytes:
+    """Pickle a function/class for the GCS function table
+    (reference: python/ray/_private/function_manager.py export path)."""
+    return cloudpickle.dumps(fn)
+
+
+def loads_function(data: bytes):
+    return cloudpickle.loads(data)

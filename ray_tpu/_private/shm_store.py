@@ -1,0 +1,205 @@
+"""ctypes binding to the C++ shared-memory object store (src/shm_store.cc).
+
+Plays the role of the reference's plasma client
+(reference: python/ray/_private/worker.py plasma access via
+_raylet.pyx CoreWorker::Put/Get → PlasmaStoreProvider). Because our
+store is a directly-mapped arena, "client" means: map the arena file and
+call into the library; gets of sealed objects are a hash probe, not a
+socket round trip.
+
+The shared library is compiled on first use (g++ -O2 -shared) and cached
+next to the source. The build is also exposed via `python -m
+ray_tpu._private.shm_store build` for wheels/CI.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "src", "shm_store.cc")
+_LIB = os.path.join(os.path.dirname(_SRC), "libshm_store.so")
+
+ST_OK = 0
+ST_EXISTS = -1
+ST_FULL = -2
+ST_NOT_FOUND = -3
+ST_TIMEOUT = -4
+ST_ERR = -5
+
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build_library(force: bool = False) -> str:
+    with _build_lock:
+        if force or (not os.path.exists(_LIB)) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            tmp = _LIB + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC, "-lpthread"],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, _LIB)
+    return _LIB
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build_library())
+        lib.shm_store_init.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+        lib.shm_store_init.restype = ctypes.c_int
+        lib.shm_store_open.argtypes = [ctypes.c_char_p]
+        lib.shm_store_open.restype = ctypes.c_void_p
+        lib.shm_store_close.argtypes = [ctypes.c_void_p]
+        lib.shm_store_base.argtypes = [ctypes.c_void_p]
+        lib.shm_store_base.restype = ctypes.c_void_p
+        lib.shm_store_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)]
+        lib.shm_store_create.restype = ctypes.c_int
+        lib.shm_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_seal.restype = ctypes.c_int
+        lib.shm_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_abort.restype = ctypes.c_int
+        lib.shm_store_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+        ]
+        lib.shm_store_get.restype = ctypes.c_int
+        lib.shm_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_contains.restype = ctypes.c_int
+        lib.shm_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_release.restype = ctypes.c_int
+        lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_delete.restype = ctypes.c_int
+        lib.shm_store_usage.argtypes = [ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_uint64)] * 3
+        lib.shm_store_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.shm_store_list.restype = ctypes.c_int
+        _lib = lib
+    return _lib
+
+
+class ShmBuffer:
+    """A pinned view of a sealed object. Releases its store ref on close/GC."""
+
+    def __init__(self, store: "ShmStore", object_id: bytes, address: int, size: int):
+        self._store = store
+        self._object_id = object_id
+        self._released = False
+        self._raw = (ctypes.c_char * size).from_address(address)
+        self.view = memoryview(self._raw).cast("B")
+        self.size = size
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self.view.release()
+            self._store.release(self._object_id)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+    def __len__(self):
+        return self.size
+
+
+class ShmStore:
+    """One per node; every process opens the same arena file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lib = _load()
+        self._handle = self._lib.shm_store_open(path.encode())
+        if not self._handle:
+            raise RuntimeError(f"failed to open shm store at {path}")
+        self._base = self._lib.shm_store_base(self._handle)
+
+    @staticmethod
+    def create(path: str, size: int, table_capacity: int = 1 << 16) -> "ShmStore":
+        lib = _load()
+        rc = lib.shm_store_init(path.encode(), size, table_capacity)
+        if rc != ST_OK:
+            raise RuntimeError(f"shm_store_init({path}) failed: {rc}")
+        return ShmStore(path)
+
+    def close(self):
+        if self._handle:
+            self._lib.shm_store_close(self._handle)
+            self._handle = None
+
+    # --- write path ---
+    def create_buffer(self, object_id: bytes, size: int) -> memoryview:
+        off = ctypes.c_uint64()
+        rc = self._lib.shm_store_create(self._handle, object_id, size, ctypes.byref(off))
+        if rc == ST_EXISTS:
+            raise FileExistsError(object_id.hex())
+        if rc == ST_FULL:
+            from ray_tpu.exceptions import ObjectStoreFullError
+
+            raise ObjectStoreFullError(f"object store full creating {size} bytes")
+        if rc != ST_OK:
+            raise RuntimeError(f"shm create failed: {rc}")
+        raw = (ctypes.c_char * size).from_address(self._base + off.value)
+        return memoryview(raw).cast("B")
+
+    def seal(self, object_id: bytes):
+        rc = self._lib.shm_store_seal(self._handle, object_id)
+        if rc != ST_OK:
+            raise RuntimeError(f"seal failed: {rc}")
+
+    def abort(self, object_id: bytes):
+        self._lib.shm_store_abort(self._handle, object_id)
+
+    def put_bytes(self, object_id: bytes, data) -> None:
+        mv = memoryview(data).cast("B")
+        buf = self.create_buffer(object_id, mv.nbytes)
+        buf[:] = mv
+        self.seal(object_id)
+
+    # --- read path ---
+    def get(self, object_id: bytes, timeout_ms: int = -1) -> Optional[ShmBuffer]:
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.shm_store_get(self._handle, object_id, ctypes.byref(off), ctypes.byref(size), timeout_ms)
+        if rc in (ST_NOT_FOUND, ST_TIMEOUT):
+            return None
+        if rc != ST_OK:
+            raise RuntimeError(f"shm get failed: {rc}")
+        return ShmBuffer(self, object_id, self._base + off.value, size.value)
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(self._lib.shm_store_contains(self._handle, object_id))
+
+    def release(self, object_id: bytes):
+        if self._handle:
+            self._lib.shm_store_release(self._handle, object_id)
+
+    def delete(self, object_id: bytes):
+        self._lib.shm_store_delete(self._handle, object_id)
+
+    def usage(self):
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        n = ctypes.c_uint64()
+        self._lib.shm_store_usage(self._handle, ctypes.byref(used), ctypes.byref(cap), ctypes.byref(n))
+        return {"used_bytes": used.value, "capacity_bytes": cap.value, "num_objects": n.value}
+
+    def list_objects(self, max_n: int = 4096):
+        buf = ctypes.create_string_buffer(max_n * 16)
+        n = self._lib.shm_store_list(self._handle, buf, max_n)
+        return [buf.raw[i * 16 : (i + 1) * 16] for i in range(n)]
+
+
+if __name__ == "__main__":
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "build":
+        print(build_library(force=True))

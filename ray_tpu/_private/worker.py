@@ -1,0 +1,177 @@
+"""Driver-side global worker and the implementation behind the public API.
+
+Equivalent of the reference's worker singleton
+(reference: python/ray/_private/worker.py:411 class Worker; init at
+:1225, connect at :2183, get/put/wait at :2567/2685/2750).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu import exceptions
+from ray_tpu._private import node as node_mod
+from ray_tpu._private import serialization
+from ray_tpu._private.core_worker import CoreWorker
+from ray_tpu._private.object_ref import ObjectRef
+
+logger = logging.getLogger("ray_tpu")
+
+
+class Worker:
+    def __init__(self):
+        self.core: Optional[CoreWorker] = None
+        self.node_procs: Optional[node_mod.NodeProcesses] = None
+        self.mode: Optional[str] = None
+        self.session_dir: Optional[str] = None
+        self._lock = threading.RLock()
+        self.namespace: str = "default"
+
+    @property
+    def connected(self) -> bool:
+        return self.core is not None
+
+    def check_connected(self):
+        if not self.connected:
+            raise RuntimeError("ray_tpu.init() must be called before using the API")
+
+    # ------------------------------------------------------------------ init
+    def init(
+        self,
+        address: Optional[str] = None,
+        num_cpus: Optional[int] = None,
+        num_tpus: Optional[int] = None,
+        resources: Optional[Dict[str, float]] = None,
+        object_store_memory: Optional[int] = None,
+        labels: Optional[Dict[str, str]] = None,
+        namespace: Optional[str] = None,
+        ignore_reinit_error: bool = False,
+        **kwargs,
+    ):
+        with self._lock:
+            if self.connected:
+                if ignore_reinit_error:
+                    return self
+                raise RuntimeError("ray_tpu.init() called twice")
+            self.namespace = namespace or "default"
+            if address in (None, "local"):
+                session_dir = node_mod.new_session_dir()
+                procs = node_mod.NodeProcesses(session_dir)
+                res = node_mod.default_resources(num_cpus, num_tpus, resources)
+                from ray_tpu._private.config import RayConfig
+
+                store_bytes = object_store_memory or RayConfig.object_store_memory_bytes
+                procs.start_head(res, store_bytes, labels=labels)
+                self.node_procs = procs
+                self.session_dir = session_dir
+                gcs_addr = procs.gcs_local_address
+                node_info = procs.head_node_info
+            elif address == "auto" or address.startswith("session:"):
+                session_dir = (
+                    address.split(":", 1)[1]
+                    if address.startswith("session:")
+                    else "/tmp/ray_tpu/session_latest"
+                )
+                session_dir = os.path.realpath(session_dir)
+                with open(os.path.join(session_dir, "gcs_address")) as f:
+                    lines = f.read().splitlines()
+                gcs_addr = lines[1] if len(lines) > 1 and os.path.exists(lines[1][5:]) else lines[0]
+                self.session_dir = session_dir
+                node_info = self._discover_local_node(session_dir)
+            else:
+                # tcp address "host:port" of a remote GCS
+                gcs_addr = address if address.startswith("tcp:") else f"tcp:{address}"
+                self.session_dir = node_mod.new_session_dir()
+                node_info = None
+
+            self.core = CoreWorker(
+                mode="driver",
+                gcs_addr=gcs_addr,
+                session_dir=self.session_dir,
+                node_id=node_info["node_id"] if node_info else None,
+                shm_path=node_info["shm_path"] if node_info else None,
+            )
+            self.core.start()
+            # publish the driver's sys.path so workers can import its modules
+            # (reference: runtime_env working_dir; round-1 equivalent)
+            blob, _ = serialization.to_bytes([p for p in sys.path if p])
+            self.core.gcs_request("kv.put", {"ns": "session", "key": "driver_sys_path", "value": blob})
+            self.mode = "driver"
+            import atexit
+
+            atexit.register(self.shutdown)
+            return self
+
+    def _discover_local_node(self, session_dir: str) -> Optional[Dict[str, Any]]:
+        for name in os.listdir(session_dir):
+            if name.startswith("node-") and name.endswith(".json"):
+                with open(os.path.join(session_dir, name)) as f:
+                    info = json.load(f)
+                if os.path.exists(info["shm_path"]):
+                    return info
+        return None
+
+    def shutdown(self):
+        with self._lock:
+            if self.core is not None:
+                self.core.shutdown()
+                self.core = None
+            if self.node_procs is not None:
+                self.node_procs.kill_all()
+                self.node_procs = None
+            self.mode = None
+
+    # ------------------------------------------------------------------- api
+    def put(self, value: Any) -> ObjectRef:
+        self.check_connected()
+        return self.core.put(value)
+
+    def get(self, refs: Union[ObjectRef, Sequence[ObjectRef]], timeout: Optional[float] = None):
+        self.check_connected()
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        for r in ref_list:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+        values = self.core.get_values(ref_list, timeout=timeout)
+        for v in values:
+            if isinstance(v, BaseException):
+                raise v
+        return values[0] if single else values
+
+    def wait(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int = 1,
+        timeout: Optional[float] = None,
+        fetch_local: bool = True,
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        self.check_connected()
+        if isinstance(refs, ObjectRef):
+            raise TypeError("wait() expects a list of ObjectRefs")
+        if num_returns > len(refs):
+            raise ValueError("num_returns > number of refs")
+        return self.core.wait(list(refs), num_returns=num_returns, timeout=timeout, fetch_local=fetch_local)
+
+
+global_worker = Worker()
+
+
+def get_global_core() -> CoreWorker:
+    """The CoreWorker for the current process — the driver's, or, inside an
+    executor worker, the worker's own (set by worker_proc)."""
+    if _worker_process_core[0] is not None:
+        return _worker_process_core[0]
+    global_worker.check_connected()
+    return global_worker.core
+
+
+_worker_process_core: List[Optional[CoreWorker]] = [None]
+
+
+def set_worker_process_core(core: CoreWorker):
+    _worker_process_core[0] = core

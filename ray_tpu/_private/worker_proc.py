@@ -1,0 +1,259 @@
+"""Executor worker process.
+
+Equivalent of the reference's default_worker.py + the C++ task execution
+loop (reference: python/ray/_private/workers/default_worker.py and
+core_worker_process.h:100 RunTaskExecutionLoop; the Python execution
+callback is _raylet.pyx:2177 task_execution_handler).
+
+One worker executes one normal task at a time, or hosts one actor
+instance for its lifetime (actor workers serve `call.actor` directly —
+the reference's direct actor transport). Actor calls from a given caller
+run in submission order (reference:
+src/ray/core_worker/transport/actor_scheduling_queue.cc); async actors
+interleave up to max_concurrency like the reference's asyncio actors.
+"""
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import ctypes
+import inspect
+import logging
+import os
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+from ray_tpu import exceptions
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.core_worker import CoreWorker, _env_err, _env_inline
+
+logger = logging.getLogger("ray_tpu.worker")
+
+
+class Executor:
+    def __init__(self, core: CoreWorker):
+        self.core = core
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=1, thread_name_prefix="exec")
+        self.actor_instance = None
+        self.actor_is_async = False
+        self.actor_semaphore: Optional[asyncio.Semaphore] = None
+        self.actor_id: Optional[str] = None
+        # per-caller ordering state
+        self._order: Dict[str, Dict[str, Any]] = {}
+        self._current_task_id: Optional[str] = None
+        self._current_thread: Optional[threading.Thread] = None
+        self._cancelled: set = set()
+
+    # ------------------------------------------------------------- execution
+    async def execute_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        """Normal task or actor-creation task pushed by the raylet."""
+        if spec.get("cancelled") or spec["task_id"] in self._cancelled:
+            await self._send_error(spec, exceptions.TaskCancelledError(spec.get("name", "")))
+            return {"ok": True}
+        if spec.get("actor_creation"):
+            return await self._create_actor(spec)
+        self._current_task_id = spec["task_id"]
+        envs = await self._run_user_function(spec)
+        self._current_task_id = None
+        await self._push_results(spec, envs)
+        return {"ok": True}
+
+    async def _create_actor(self, spec) -> Dict[str, Any]:
+        try:
+            def _construct():
+                cls = self.core.load_function(spec["fn_id"])
+                args, kwargs = self.core.unpack_args(spec["args"])
+                return cls(*args, **kwargs)
+
+            instance = await asyncio.get_running_loop().run_in_executor(self.pool, _construct)
+        except Exception as e:
+            logger.exception("actor creation failed")
+            return {"ok": False, "error": f"{type(e).__name__}: {e}\n{traceback.format_exc()}"}
+        self.actor_instance = instance
+        self.actor_id = spec["actor_id"]
+        methods = [m for _, m in inspect.getmembers(type(instance), predicate=inspect.isfunction)]
+        self.actor_is_async = any(inspect.iscoroutinefunction(m) for m in methods)
+        max_conc = spec.get("max_concurrency") or (1000 if self.actor_is_async else 1)
+        if not self.actor_is_async and max_conc > 1:
+            self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_conc, thread_name_prefix="actor")
+        self.actor_semaphore = asyncio.Semaphore(max_conc)
+        return {"ok": True, "addr": self.core._listen_addr}
+
+    async def handle_actor_call(self, data, conn) -> Dict[str, Any]:
+        """Direct actor invocation. Calls from one caller arrive in
+        submission order on a single connection; the FIFO semaphore
+        preserves that as execution start order (reference:
+        actor_scheduling_queue.cc — ordering by sequence numbers there,
+        by stream order here)."""
+        spec = data["spec"]
+        async with self.actor_semaphore:
+            envs = await self._run_user_function(spec, actor=True)
+        return {"results": [{"oid": oid, "env": env} for oid, env in zip(spec["returns"], envs)]}
+
+    async def _run_user_function(self, spec, actor: bool = False):
+        name = spec.get("name") or spec.get("method", "?")
+        try:
+            loop = asyncio.get_running_loop()
+
+            def _prepare():
+                # runs off the IO loop: load_function/unpack_args may issue
+                # blocking round-trips through the CoreWorker loop
+                if actor:
+                    fn = getattr(self.actor_instance, spec["method"])
+                else:
+                    fn = self.core.load_function(spec["fn_id"])
+                args, kwargs = self.core.unpack_args(spec["args"])
+                return fn, args, kwargs
+
+            fn, args, kwargs = await loop.run_in_executor(self.pool, _prepare)
+            if inspect.iscoroutinefunction(fn):
+                result = await fn(*args, **kwargs)
+            else:
+                def _invoke():
+                    self._current_thread = threading.current_thread()
+                    try:
+                        return fn(*args, **kwargs)
+                    finally:
+                        self._current_thread = None
+
+                result = await loop.run_in_executor(self.pool, _invoke)
+        except Exception as e:
+            tb = traceback.format_exc()
+            logger.info("task %s failed: %s", name, tb)
+            err = _env_err(e, name)
+            if isinstance(e, (KeyboardInterrupt,)) or spec["task_id"] in self._cancelled:
+                err = _env_err(exceptions.TaskCancelledError(name), name)
+                err["t"] = "TaskCancelledError"
+            return [err] * len(spec["returns"])
+
+        n = len(spec["returns"])
+        if n == 1:
+            values = [result]
+        else:
+            values = list(result) if isinstance(result, (tuple, list)) else [result] * n
+            if len(values) != n:
+                err = _env_err(
+                    ValueError(f"task returned {len(values)} values, expected {n}"), name
+                )
+                return [err] * n
+        return [await self._to_env(oid, v) for oid, v in zip(spec["returns"], values)]
+
+    async def _to_env(self, oid: bytes, value: Any):
+        loop = asyncio.get_running_loop()
+
+        def _ser():
+            pickled, buffers, _ = serialization.serialize(value)
+            total = serialization.serialized_size(pickled, buffers)
+            if total <= RayConfig.object_store_inline_max_bytes or self.core._shm is None:
+                data = bytearray(total)
+                n = serialization.write_to(memoryview(data), pickled, buffers)
+                return _env_inline(bytes(data[:n]))
+            return self.core.put_serialized_to_shm(bytes(oid), pickled, buffers)
+
+        try:
+            return await loop.run_in_executor(self.pool, _ser)
+        except Exception as e:
+            return _env_err(e, "serialize-result")
+
+    async def _push_results(self, spec, envs):
+        msg = {
+            "task_id": spec["task_id"],
+            "results": [{"oid": oid, "env": env} for oid, env in zip(spec["returns"], envs)],
+        }
+        owner_addr = spec.get("owner_addr")
+        try:
+            conn = await self.core._peer(owner_addr)
+            await conn.push("task.result", msg)
+        except Exception:
+            logger.warning("owner %s unreachable for task %s results", owner_addr, spec["task_id"])
+
+    async def _send_error(self, spec, exc):
+        envs = [_env_err(exc, spec.get("name", ""))] * len(spec["returns"])
+        for e in envs:
+            e["t"] = type(exc).__name__
+        await self._push_results(spec, envs)
+
+    def cancel(self, task_id: str, force: bool):
+        self._cancelled.add(task_id)
+        if task_id == self._current_task_id and self._current_thread is not None:
+            # cooperative interrupt of the running user thread (reference:
+            # ray cancels running normal tasks by raising KeyboardInterrupt)
+            tid = self._current_thread.ident
+            if tid is not None:
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_long(tid), ctypes.py_object(KeyboardInterrupt)
+                )
+
+
+async def _amain():
+    session_dir = os.environ["RAY_TPU_SESSION_DIR"]
+    gcs_addr = os.environ["RAY_TPU_GCS_ADDR"]
+    raylet_sock = os.environ["RAY_TPU_RAYLET_SOCK"]
+    node_id = os.environ["RAY_TPU_NODE_ID"]
+    shm_path = os.environ["RAY_TPU_SHM_PATH"]
+    worker_id = os.environ["RAY_TPU_WORKER_ID"]
+
+    # extend sys.path with driver-provided entries (reference: working_dir /
+    # py_modules runtime_env; the driver publishes its sys.path via GCS KV)
+    core = CoreWorker(
+        mode="worker",
+        gcs_addr=gcs_addr,
+        session_dir=session_dir,
+        node_id=node_id,
+        shm_path=shm_path,
+        worker_id=worker_id,
+    )
+    # CoreWorker.start spins its own loop thread; we are already in asyncio —
+    # run start() in a thread to avoid blocking this loop.
+    await asyncio.get_running_loop().run_in_executor(None, core.start)
+
+    import sys
+
+    extra_path = core.gcs_request("kv.get", {"ns": "session", "key": "driver_sys_path"})
+    if extra_path:
+        for p in reversed(serialization.from_bytes(extra_path)):
+            if p and p not in sys.path:
+                sys.path.insert(0, p)
+
+    executor = Executor(core)
+    core.executor = executor
+    # route ray_tpu.get/put/remote inside tasks through this worker's core
+    from ray_tpu._private.worker import set_worker_process_core
+
+    set_worker_process_core(core)
+
+    # Bridge: the executor's async handlers must run on the CoreWorker IO
+    # loop (where peer connections live).
+    done = asyncio.Event()
+
+    async def on_core_loop():
+        conn = await protocol.connect(raylet_sock, _handle_raylet, name="worker-raylet")
+        await conn.request("worker.register", {"worker_id": worker_id, "addr": core._listen_addr})
+        return conn
+
+    async def _handle_raylet(method, data, conn):
+        if method == "exec.task":
+            return await executor.execute_task(data["spec"])
+        if method == "exec.cancel":
+            executor.cancel(data["task_id"], data.get("force", False))
+            return True
+        if method == "exec.shutdown":
+            os._exit(0)
+        raise ValueError(f"unknown method {method}")
+
+    fut = asyncio.run_coroutine_threadsafe(on_core_loop(), core._loop)
+    fut.result(timeout=RayConfig.worker_register_timeout_s)
+    logger.info("worker %s registered", worker_id[:12])
+    await done.wait()  # forever
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(_amain())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""Actor classes and handles.
+
+Equivalent of the reference's actor machinery
+(reference: python/ray/actor.py — ActorClass:544, ActorClass._remote:830,
+ActorHandle:1193, ActorMethod). Actor method calls go directly
+worker-to-worker over a cached connection (the reference's direct actor
+transport, src/ray/core_worker/transport/direct_actor_task_submitter.cc)
+with per-caller ordering.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.ids import hex_id, new_id
+from ray_tpu.remote_function import _normalize_resources, _scheduling_fields
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._method_name, args, kwargs, self._num_returns)
+
+    def options(self, num_returns: int = 1, **_):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import ActorMethodNode
+
+        return ActorMethodNode(self._handle, self._method_name, args, kwargs)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._method_name}' cannot be called directly; use .remote()."
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str, class_name: str, method_meta: Dict[str, int], max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._method_meta = method_meta
+        self._max_task_retries = max_task_retries
+
+    @property
+    def _id(self):
+        return self._actor_id
+
+    def _invoke(self, method_name, args, kwargs, num_returns):
+        from ray_tpu._private.worker import get_global_core
+
+        core = get_global_core()
+        refs = core.submit_actor_task(
+            self._actor_id,
+            method_name,
+            args,
+            kwargs,
+            num_returns=num_returns,
+            max_task_retries=self._max_task_retries,
+        )
+        return refs[0] if num_returns == 1 else refs
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name, self._method_meta.get(name, 1))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id[:12]})"
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id, self._class_name, self._method_meta, self._max_task_retries),
+        )
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+
+class ActorClass:
+    def __init__(self, cls, **default_opts):
+        self._cls = cls
+        self._opts = default_opts
+        self._fn_id: Optional[str] = None
+        self._exported_by: Optional[int] = None
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote()."
+        )
+
+    def options(self, **opts) -> "ActorClass":
+        merged = {**self._opts, **opts}
+        ac = ActorClass(self._cls, **merged)
+        ac._fn_id = self._fn_id
+        ac._exported_by = self._exported_by
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu._private.worker import get_global_core, global_worker
+
+        core = get_global_core()
+        if self._fn_id is None or self._exported_by != id(core):
+            self._fn_id = core.export_function(self._cls)
+            self._exported_by = id(core)
+        actor_id = hex_id(new_id())
+        opts = self._opts
+        explicit = (
+            opts.get("num_cpus") is not None
+            or opts.get("num_tpus") is not None
+            or opts.get("num_gpus") is not None
+            or bool(opts.get("resources"))
+        )
+        # explicit resources are held for the actor's lifetime; the default
+        # 1-CPU request only gates creation (reference: actor resource
+        # semantics in ray_option_utils / core worker actor creation)
+        resources = _normalize_resources(opts) if explicit else {"CPU": 1.0}
+        spec = {
+            "task_id": hex_id(new_id()),
+            "actor_id": actor_id,
+            "fn_id": self._fn_id,
+            "name": opts.get("name"),
+            "namespace": opts.get("namespace") or getattr(global_worker, "namespace", "default"),
+            "class_name": self._cls.__name__,
+            "args": core.pack_args(args, kwargs),
+            "returns": [],
+            "resources": resources,
+            "max_restarts": opts.get("max_restarts", 0),
+            "max_concurrency": opts.get("max_concurrency"),
+            "hold_resources": explicit,
+            "lifetime": opts.get("lifetime"),
+            "actor_creation": True,
+            "owner_addr": core._listen_addr,
+            **_scheduling_fields(opts),
+        }
+        core.create_actor(spec)
+        method_meta = {}
+        for name in dir(self._cls):
+            if not name.startswith("_") and callable(getattr(self._cls, name, None)):
+                method_meta[name] = 1
+        return ActorHandle(actor_id, self._cls.__name__, method_meta, opts.get("max_task_retries", 0))
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    """reference: python/ray/_private/worker.py:2896 get_actor."""
+    from ray_tpu._private.worker import get_global_core, global_worker
+
+    core = get_global_core()
+    ns = namespace or getattr(global_worker, "namespace", "default")
+    try:
+        actor_id = core.gcs_request("actor.get_by_name", {"name": name, "namespace": ns})
+    except Exception:
+        raise ValueError(f"Failed to look up actor '{name}' in namespace '{ns}'")
+    info = core.actor_info(actor_id)
+    return ActorHandle(actor_id, info.get("name") or name, {})

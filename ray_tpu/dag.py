@@ -1,0 +1,55 @@
+"""Lazy DAG API — `.bind()` builds a DAG of remote calls, `.execute()` runs it.
+
+Equivalent of the reference's ray.dag
+(reference: python/ray/dag/dag_node.py; compiled DAGs at
+python/ray/dag/compiled_dag_node.py:141 are the reference's experimental
+channel-based execution — here execution lowers onto the normal task
+path; a compiled/fused path over device channels is the planned TPU
+equivalent).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+
+class DAGNode:
+    def _resolve_args(self, args, kwargs):
+        ra = [a.execute() if isinstance(a, DAGNode) else a for a in args]
+        rk = {k: (v.execute() if isinstance(v, DAGNode) else v) for k, v in kwargs.items()}
+        return ra, rk
+
+    def execute(self):
+        raise NotImplementedError
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args: Tuple, kwargs: Dict[str, Any]):
+        self._remote_fn = remote_fn
+        self._args = args
+        self._kwargs = kwargs
+
+    def execute(self):
+        args, kwargs = self._resolve_args(self._args, self._kwargs)
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ActorMethodNode(DAGNode):
+    def __init__(self, handle, method_name: str, args: Tuple, kwargs: Dict[str, Any]):
+        self._handle = handle
+        self._method = method_name
+        self._args = args
+        self._kwargs = kwargs
+
+    def execute(self):
+        args, kwargs = self._resolve_args(self._args, self._kwargs)
+        return self._handle._invoke(self._method, args, kwargs, 1)
+
+
+class InputNode(DAGNode):
+    """Placeholder for runtime input (reference: dag/input_node.py)."""
+
+    def __init__(self):
+        self._value = None
+
+    def execute(self):
+        return self._value

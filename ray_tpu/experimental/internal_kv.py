@@ -1,0 +1,52 @@
+"""GCS KV access (reference: python/ray/experimental/internal_kv.py)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def _core():
+    from ray_tpu._private.worker import get_global_core
+
+    return get_global_core()
+
+
+def _internal_kv_initialized() -> bool:
+    from ray_tpu._private.worker import _worker_process_core, global_worker
+
+    return _worker_process_core[0] is not None or global_worker.connected
+
+
+def _internal_kv_put(key, value, overwrite: bool = True, namespace: Optional[str] = None) -> bool:
+    key = key.decode() if isinstance(key, bytes) else key
+    value = value if isinstance(value, bytes) else str(value).encode()
+    return _core().gcs_request(
+        "kv.put", {"ns": namespace or "default", "key": key, "value": value, "overwrite": overwrite}
+    )
+
+
+def _internal_kv_get(key, namespace: Optional[str] = None) -> Optional[bytes]:
+    key = key.decode() if isinstance(key, bytes) else key
+    return _core().gcs_request("kv.get", {"ns": namespace or "default", "key": key})
+
+
+def _internal_kv_del(key, namespace: Optional[str] = None) -> bool:
+    key = key.decode() if isinstance(key, bytes) else key
+    return _core().gcs_request("kv.del", {"ns": namespace or "default", "key": key})
+
+
+def _internal_kv_list(prefix, namespace: Optional[str] = None) -> List[str]:
+    prefix = prefix.decode() if isinstance(prefix, bytes) else prefix
+    return _core().gcs_request("kv.keys", {"ns": namespace or "default", "prefix": prefix})
+
+
+def _internal_kv_exists(key, namespace: Optional[str] = None) -> bool:
+    key = key.decode() if isinstance(key, bytes) else key
+    return _core().gcs_request("kv.exists", {"ns": namespace or "default", "key": key})
+
+
+# public aliases
+kv_put = _internal_kv_put
+kv_get = _internal_kv_get
+kv_del = _internal_kv_del
+kv_list = _internal_kv_list
+kv_exists = _internal_kv_exists

@@ -1,0 +1,9 @@
+"""TPU parallelism: meshes, sharding rules, ring attention, pipelining.
+
+The device-plane replacement for the reference's NCCL/process-group
+machinery (SURVEY.md §2.4): parallel strategies are GSPMD sharding rules
+over a named mesh, long-context is ring attention over the ICI torus,
+and pipeline parallelism is a shard_map/ppermute schedule.
+"""
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh, initialize_multihost  # noqa: F401
+from ray_tpu.parallel.sharding import LogicalAxisRules, constraint, shard_params  # noqa: F401

@@ -1,0 +1,126 @@
+"""Device mesh construction and multi-host initialization.
+
+This is the TPU-native replacement for the reference's process-group
+bootstrap (reference: python/ray/train/torch/config.py:47-99
+_setup_torch_process_group — TCP rendezvous + NCCL). Here there are no
+process groups: a `MeshSpec` names the parallelism axes
+(dp/fsdp/tp/sp/ep/pp), `build_mesh` lays them onto the device grid, and
+XLA emits ICI collectives from sharding annotations. Multi-host
+rendezvous goes through the GCS KV store instead of a TCP store
+(reference NCCL-UID rendezvous through GCS KV:
+python/ray/util/collective/collective_group/nccl_collective_group.py:28-100).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named parallelism degrees. -1 on at most one axis = absorb the rest.
+
+    Axis meanings (each maps to a mesh axis usable in PartitionSpecs):
+      dp    — pure data parallel (replicated params)
+      fsdp  — data parallel with fully-sharded params (GSPMD zero-3)
+      tp    — tensor/model parallel
+      sp    — sequence/context parallel (ring attention)
+      ep    — expert parallel (MoE)
+      pp    — pipeline stages
+    """
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    def degrees(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        d = self.degrees()
+        unknown = [a for a, v in d.items() if v == -1]
+        if len(unknown) > 1:
+            raise ValueError("at most one axis may be -1")
+        known = math.prod(v for v in d.values() if v != -1)
+        if unknown:
+            if n_devices % known:
+                raise ValueError(f"{n_devices} devices not divisible by {known}")
+            d[unknown[0]] = n_devices // known
+        elif known != n_devices:
+            raise ValueError(f"mesh {d} needs {known} devices, have {n_devices}")
+        return MeshSpec(**{k: d[k] for k in AXIS_ORDER})
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return AXIS_ORDER
+
+    def nontrivial_axes(self) -> Tuple[str, ...]:
+        return tuple(a for a in AXIS_ORDER if getattr(self, a) > 1)
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+    """Build a jax Mesh with all six named axes (size-1 axes are free).
+
+    Axis order puts `tp` (and `sp`) innermost so tensor-parallel
+    collectives ride the fastest ICI hops, `pp`/`dp` outermost so their
+    (rare, large) transfers tolerate DCN — the standard TPU layout from
+    the scaling playbook.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    spec = spec.resolve(len(devices))
+    shape = tuple(getattr(spec, a) for a in AXIS_ORDER)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    rendezvous_key: str = "jax_coordinator",
+    timeout_s: float = 120.0,
+):
+    """jax.distributed.initialize with GCS-KV rendezvous.
+
+    Host 0 publishes its coordinator address under `rendezvous_key` in the
+    GCS KV; other hosts poll for it (the reference does the same dance
+    with the NCCL unique id). No-op on single-host."""
+    import jax
+
+    if num_processes is None or num_processes <= 1:
+        return
+    from ray_tpu.experimental import internal_kv
+
+    if process_id == 0:
+        if coordinator_address is None:
+            coordinator_address = f"{os.environ.get('RAY_TPU_NODE_IP', '127.0.0.1')}:9876"
+        internal_kv.kv_put(rendezvous_key, coordinator_address.encode(), namespace="collective")
+    else:
+        deadline = time.time() + timeout_s
+        addr = None
+        while time.time() < deadline:
+            addr = internal_kv.kv_get(rendezvous_key, namespace="collective")
+            if addr:
+                break
+            time.sleep(0.25)
+        if not addr:
+            raise TimeoutError("coordinator rendezvous timed out")
+        coordinator_address = addr.decode()
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

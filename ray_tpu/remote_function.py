@@ -1,0 +1,94 @@
+"""@remote functions.
+
+Equivalent of the reference's RemoteFunction
+(reference: python/ray/remote_function.py:138 _remote_proxy/_remote and
+the @ray.remote decorator at python/ray/_private/worker.py:3242).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.object_ref import ObjectRef
+
+
+def _normalize_resources(opts: Dict[str, Any]) -> Dict[str, float]:
+    res: Dict[str, float] = dict(opts.get("resources") or {})
+    if opts.get("num_cpus") is not None:
+        res["CPU"] = float(opts["num_cpus"])
+    else:
+        res.setdefault("CPU", 1.0)
+    if opts.get("num_tpus") is not None:
+        res["TPU"] = float(opts["num_tpus"])
+    if opts.get("num_gpus") is not None:  # parity shim: GPU as a plain resource
+        res["GPU"] = float(opts["num_gpus"])
+    if opts.get("memory") is not None:
+        res["memory"] = float(opts["memory"])
+    return {k: v for k, v in res.items() if v}
+
+
+def _scheduling_fields(opts: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    strategy = opts.get("scheduling_strategy")
+    if strategy is not None:
+        if isinstance(strategy, str):
+            out["scheduling_strategy"] = strategy
+        else:
+            # strategy objects from ray_tpu.util.scheduling_strategies
+            out.update(strategy.to_spec_fields())
+    pg = opts.get("placement_group")
+    if pg is not None:
+        out["placement_group_id"] = pg.id if hasattr(pg, "id") else pg
+        out["bundle_index"] = opts.get("placement_group_bundle_index", -1)
+    return out
+
+
+class RemoteFunction:
+    def __init__(self, fn, **default_opts):
+        self._fn = fn
+        self._opts = default_opts
+        self._fn_id: Optional[str] = None
+        self._exported_by: Optional[int] = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._fn.__name__}' cannot be called directly; "
+            f"use {self._fn.__name__}.remote()."
+        )
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = {**self._opts, **opts}
+        rf = RemoteFunction(self._fn, **merged)
+        rf._fn_id = self._fn_id
+        rf._exported_by = self._exported_by
+        return rf
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu._private.worker import get_global_core
+
+        core = get_global_core()
+        if self._fn_id is None or self._exported_by != id(core):
+            self._fn_id = core.export_function(self._fn)
+            self._exported_by = id(core)
+        num_returns = self._opts.get("num_returns", 1)
+        refs = core.submit_task(
+            fn_id=self._fn_id,
+            args=args,
+            kwargs=kwargs,
+            name=self._opts.get("name", self._fn.__name__),
+            num_returns=num_returns,
+            resources=_normalize_resources(self._opts),
+            max_retries=self._opts.get("max_retries"),
+            scheduling=_scheduling_fields(self._opts),
+        )
+        return refs[0] if num_returns == 1 else refs
+
+    @property
+    def bind(self):
+        from ray_tpu.dag import FunctionNode
+
+        def _bind(*args, **kwargs):
+            return FunctionNode(self, args, kwargs)
+
+        return _bind

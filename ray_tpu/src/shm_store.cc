@@ -1,0 +1,537 @@
+// Shared-memory object store — the node-local data plane.
+//
+// TPU-native rework of the reference's plasma store
+// (reference: src/ray/object_manager/plasma/{store_runner.cc, store.cc,
+// dlmalloc.cc, shared_memory.cc}; client protocol plasma.fbs over a unix
+// socket with fd passing, reference: src/ray/object_manager/plasma/protocol.cc,
+// fling.cc).
+//
+// Design difference, deliberately: plasma is a *server* process that clients
+// talk to over a socket and receive fds from. Here the store is a single
+// shared-memory arena (file in /dev/shm) that every process on the node maps
+// directly; the object index, allocator metadata, and a process-shared
+// robust mutex + condvar live inside the arena itself. Reads after seal are
+// lock-free; create/seal/get take one futex-backed mutex. This removes the
+// per-object socket round-trip entirely — on a TPU host the store's job is
+// to stage host-side Arrow blocks and checkpoints, and to hand zero-copy
+// buffers to numpy/jax, and the common op is get() of an already-sealed
+// object, which here is a hash probe + refcount increment.
+//
+// Layout:
+//   [Header | ObjectTable entries | data region ...]
+// Allocator: first-fit free list with boundary-tag coalescing (equivalent
+// role to plasma's dlmalloc-over-shm, reference:
+// src/ray/object_manager/plasma/dlmalloc.cc).
+//
+// All cross-process references are *offsets* from the arena base (each
+// process maps the arena at a different address).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5250554153544f52ULL;  // "RPUASTOR"
+constexpr uint32_t kIdLen = 16;
+constexpr uint64_t kAlign = 64;  // cacheline-align object payloads
+
+// object states
+constexpr uint32_t kEmpty = 0;
+constexpr uint32_t kCreated = 1;
+constexpr uint32_t kSealed = 2;
+constexpr uint32_t kTombstone = 3;
+
+struct Entry {
+  uint8_t id[kIdLen];
+  uint64_t offset;  // payload offset from arena base
+  uint64_t size;
+  uint32_t state;
+  int32_t refcount;
+  uint64_t lru_tick;
+  uint32_t pending_delete;
+  uint32_t pad;
+};
+
+// Free/used block header (boundary-tagged).
+struct Block {
+  uint64_t size;       // total block size incl. header, low bit = used
+  uint64_t prev_size;  // size of physically-previous block (0 if first)
+  uint64_t next_free;  // offset of next free block (0 = none); valid when free
+  uint64_t prev_free;  // offset of prev free block; valid when free
+};
+
+constexpr uint64_t kUsedBit = 1ULL;
+
+struct Header {
+  uint64_t magic;
+  uint64_t arena_size;
+  uint64_t table_capacity;
+  uint64_t table_offset;
+  uint64_t data_offset;
+  uint64_t data_size;
+  uint64_t free_head;  // offset of first free block (0 = none)
+  uint64_t used_bytes;
+  uint64_t num_objects;
+  uint64_t lru_counter;
+  pthread_mutex_t mutex;
+  pthread_cond_t cond;
+};
+
+struct Store {
+  int fd;
+  uint8_t* base;
+  uint64_t size;
+  Header* hdr;
+};
+
+inline uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+inline Entry* table(Store* s) {
+  return reinterpret_cast<Entry*>(s->base + s->hdr->table_offset);
+}
+
+inline Block* block_at(Store* s, uint64_t off) {
+  return reinterpret_cast<Block*>(s->base + off);
+}
+
+inline uint64_t bsize(Block* b) { return b->size & ~kUsedBit; }
+inline bool bused(Block* b) { return b->size & kUsedBit; }
+
+uint64_t hash_id(const uint8_t* id) {
+  // FNV-1a over the 16-byte id
+  uint64_t h = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < kIdLen; i++) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+void lock(Store* s) {
+  int rc = pthread_mutex_lock(&s->hdr->mutex);
+  if (rc == EOWNERDEAD) {
+    // A process died holding the lock; metadata may be mid-update but all
+    // mutations below are crash-tolerant enough for a best-effort recover.
+    pthread_mutex_consistent(&s->hdr->mutex);
+  }
+}
+
+void unlock(Store* s) { pthread_mutex_unlock(&s->hdr->mutex); }
+
+Entry* find_entry(Store* s, const uint8_t* id) {
+  Entry* t = table(s);
+  uint64_t cap = s->hdr->table_capacity;
+  uint64_t i = hash_id(id) & (cap - 1);
+  for (uint64_t probe = 0; probe < cap; probe++, i = (i + 1) & (cap - 1)) {
+    Entry* e = &t[i];
+    if (e->state == kEmpty) return nullptr;
+    if (e->state != kTombstone && memcmp(e->id, id, kIdLen) == 0) return e;
+  }
+  return nullptr;
+}
+
+Entry* insert_entry(Store* s, const uint8_t* id) {
+  Entry* t = table(s);
+  uint64_t cap = s->hdr->table_capacity;
+  uint64_t i = hash_id(id) & (cap - 1);
+  Entry* first_tomb = nullptr;
+  for (uint64_t probe = 0; probe < cap; probe++, i = (i + 1) & (cap - 1)) {
+    Entry* e = &t[i];
+    if (e->state == kEmpty) {
+      Entry* slot = first_tomb ? first_tomb : e;
+      memcpy(slot->id, id, kIdLen);
+      return slot;
+    }
+    if (e->state == kTombstone) {
+      if (!first_tomb) first_tomb = e;
+    } else if (memcmp(e->id, id, kIdLen) == 0) {
+      return nullptr;  // exists
+    }
+  }
+  if (first_tomb) {
+    memcpy(first_tomb->id, id, kIdLen);
+    return first_tomb;
+  }
+  return nullptr;  // table full
+}
+
+// --- allocator ---
+
+void freelist_remove(Store* s, uint64_t off) {
+  Block* b = block_at(s, off);
+  if (b->prev_free)
+    block_at(s, b->prev_free)->next_free = b->next_free;
+  else
+    s->hdr->free_head = b->next_free;
+  if (b->next_free) block_at(s, b->next_free)->prev_free = b->prev_free;
+}
+
+void freelist_push(Store* s, uint64_t off) {
+  Block* b = block_at(s, off);
+  b->next_free = s->hdr->free_head;
+  b->prev_free = 0;
+  if (s->hdr->free_head) block_at(s, s->hdr->free_head)->prev_free = off;
+  s->hdr->free_head = off;
+}
+
+// Allocate a payload of `payload_size`; returns payload offset or 0.
+uint64_t alloc(Store* s, uint64_t payload_size) {
+  uint64_t need = align_up(sizeof(Block) + payload_size, kAlign);
+  uint64_t off = s->hdr->free_head;
+  while (off) {
+    Block* b = block_at(s, off);
+    uint64_t sz = bsize(b);
+    if (sz >= need) {
+      freelist_remove(s, off);
+      uint64_t rem = sz - need;
+      if (rem >= sizeof(Block) + kAlign) {
+        // split
+        b->size = need | kUsedBit;
+        uint64_t noff = off + need;
+        Block* nb = block_at(s, noff);
+        nb->size = rem;
+        nb->prev_size = need;
+        freelist_push(s, noff);
+        // fix the block after the remainder
+        uint64_t after = noff + rem;
+        if (after < s->hdr->data_offset + s->hdr->data_size)
+          block_at(s, after)->prev_size = rem;
+      } else {
+        b->size = sz | kUsedBit;
+      }
+      s->hdr->used_bytes += bsize(b);
+      return off + sizeof(Block);
+    }
+    off = b->next_free;
+  }
+  return 0;
+}
+
+void dealloc(Store* s, uint64_t payload_off) {
+  uint64_t off = payload_off - sizeof(Block);
+  Block* b = block_at(s, off);
+  s->hdr->used_bytes -= bsize(b);
+  uint64_t sz = bsize(b);
+  uint64_t data_end = s->hdr->data_offset + s->hdr->data_size;
+  // coalesce with next
+  uint64_t next = off + sz;
+  if (next < data_end) {
+    Block* nb = block_at(s, next);
+    if (!bused(nb)) {
+      freelist_remove(s, next);
+      sz += bsize(nb);
+    }
+  }
+  // coalesce with prev
+  if (b->prev_size && off > s->hdr->data_offset) {
+    uint64_t prev = off - b->prev_size;
+    Block* pb = block_at(s, prev);
+    if (!bused(pb)) {
+      freelist_remove(s, prev);
+      off = prev;
+      sz += bsize(pb);
+      b = pb;
+    }
+  }
+  b->size = sz;  // used bit cleared
+  uint64_t after = off + sz;
+  if (after < data_end) block_at(s, after)->prev_size = sz;
+  freelist_push(s, off);
+}
+
+void free_entry_payload(Store* s, Entry* e) {
+  dealloc(s, e->offset);
+  e->state = kTombstone;
+  e->refcount = 0;
+  e->pending_delete = 0;
+  s->hdr->num_objects--;
+}
+
+// Evict the oldest sealed refcount-0 object. Equivalent role to plasma's
+// LRU EvictionPolicy (reference:
+// src/ray/object_manager/plasma/eviction_policy.cc). Returns false when
+// nothing is evictable.
+bool evict_one(Store* s) {
+  Entry* t = table(s);
+  uint64_t cap = s->hdr->table_capacity;
+  Entry* victim = nullptr;
+  for (uint64_t i = 0; i < cap; i++) {
+    Entry* e = &t[i];
+    if (e->state == kSealed && e->refcount == 0 &&
+        (!victim || e->lru_tick < victim->lru_tick))
+      victim = e;
+  }
+  if (!victim) return false;
+  free_entry_payload(s, victim);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// status codes
+#define ST_OK 0
+#define ST_EXISTS -1
+#define ST_FULL -2
+#define ST_NOT_FOUND -3
+#define ST_TIMEOUT -4
+#define ST_ERR -5
+
+int shm_store_init(const char* path, uint64_t arena_size, uint64_t table_capacity) {
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return ST_ERR;
+  if (ftruncate(fd, (off_t)arena_size) != 0) {
+    close(fd);
+    return ST_ERR;
+  }
+  void* base = mmap(nullptr, arena_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return ST_ERR;
+  }
+  Header* h = reinterpret_cast<Header*>(base);
+  memset(h, 0, sizeof(Header));
+  h->arena_size = arena_size;
+  h->table_capacity = table_capacity;  // must be power of two
+  h->table_offset = align_up(sizeof(Header), kAlign);
+  uint64_t table_bytes = table_capacity * sizeof(Entry);
+  memset((uint8_t*)base + h->table_offset, 0, table_bytes);
+  h->data_offset = align_up(h->table_offset + table_bytes, kAlign);
+  h->data_size = arena_size - h->data_offset;
+  // one giant free block
+  Block* b = reinterpret_cast<Block*>((uint8_t*)base + h->data_offset);
+  b->size = h->data_size & ~kUsedBit;
+  b->prev_size = 0;
+  b->next_free = 0;
+  b->prev_free = 0;
+  h->free_head = h->data_offset;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+  pthread_cond_init(&h->cond, &ca);
+  h->magic = kMagic;
+  msync(base, sizeof(Header), MS_SYNC);
+  munmap(base, arena_size);
+  close(fd);
+  return ST_OK;
+}
+
+void* shm_store_open(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* h = reinterpret_cast<Header*>(base);
+  if (h->magic != kMagic) {
+    munmap(base, st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->fd = fd;
+  s->base = reinterpret_cast<uint8_t*>(base);
+  s->size = st.st_size;
+  s->hdr = h;
+  return s;
+}
+
+void shm_store_close(void* handle) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  munmap(s->base, s->size);
+  close(s->fd);
+  delete s;
+}
+
+uint8_t* shm_store_base(void* handle) {
+  return reinterpret_cast<Store*>(handle)->base;
+}
+
+int shm_store_create(void* handle, const uint8_t* id, uint64_t size, uint64_t* offset_out) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  if (find_entry(s, id)) {
+    unlock(s);
+    return ST_EXISTS;
+  }
+  uint64_t off = alloc(s, size);
+  while (!off) {
+    if (!evict_one(s)) break;
+    off = alloc(s, size);
+  }
+  if (!off) {
+    unlock(s);
+    return ST_FULL;
+  }
+  Entry* e = insert_entry(s, id);
+  if (!e) {
+    dealloc(s, off);
+    unlock(s);
+    return ST_FULL;  // table full
+  }
+  e->offset = off;
+  e->size = size;
+  e->state = kCreated;
+  e->refcount = 1;  // creator holds a ref until seal+release
+  e->lru_tick = ++s->hdr->lru_counter;
+  e->pending_delete = 0;
+  s->hdr->num_objects++;
+  unlock(s);
+  *offset_out = off;
+  return ST_OK;
+}
+
+int shm_store_seal(void* handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e || e->state != kCreated) {
+    unlock(s);
+    return ST_NOT_FOUND;
+  }
+  e->state = kSealed;
+  e->refcount -= 1;  // drop creator ref
+  pthread_cond_broadcast(&s->hdr->cond);
+  unlock(s);
+  return ST_OK;
+}
+
+int shm_store_abort(void* handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e || e->state != kCreated) {
+    unlock(s);
+    return ST_NOT_FOUND;
+  }
+  free_entry_payload(s, e);
+  unlock(s);
+  return ST_OK;
+}
+
+// Blocks until sealed or timeout. timeout_ms < 0 → no wait (immediate).
+int shm_store_get(void* handle, const uint8_t* id, uint64_t* offset_out,
+                  uint64_t* size_out, int64_t timeout_ms) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  for (;;) {
+    Entry* e = find_entry(s, id);
+    if (e && e->state == kSealed) {
+      e->refcount++;
+      e->lru_tick = ++s->hdr->lru_counter;
+      *offset_out = e->offset;
+      *size_out = e->size;
+      unlock(s);
+      return ST_OK;
+    }
+    if (timeout_ms < 0) {
+      unlock(s);
+      return ST_NOT_FOUND;
+    }
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    ts.tv_sec += timeout_ms / 1000;
+    ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (ts.tv_nsec >= 1000000000L) {
+      ts.tv_sec++;
+      ts.tv_nsec -= 1000000000L;
+    }
+    int rc = pthread_cond_timedwait(&s->hdr->cond, &s->hdr->mutex, &ts);
+    if (rc == ETIMEDOUT) {
+      Entry* e2 = find_entry(s, id);
+      if (e2 && e2->state == kSealed) continue;  // sealed at the wire
+      unlock(s);
+      return ST_TIMEOUT;
+    }
+  }
+}
+
+int shm_store_contains(void* handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* e = find_entry(s, id);
+  int r = (e && e->state == kSealed) ? 1 : 0;
+  unlock(s);
+  return r;
+}
+
+int shm_store_release(void* handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e || e->state != kSealed) {
+    unlock(s);
+    return ST_NOT_FOUND;
+  }
+  if (e->refcount > 0) e->refcount--;
+  if (e->refcount == 0 && e->pending_delete) free_entry_payload(s, e);
+  unlock(s);
+  return ST_OK;
+}
+
+int shm_store_delete(void* handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* e = find_entry(s, id);
+  if (!e || e->state == kTombstone) {
+    unlock(s);
+    return ST_NOT_FOUND;
+  }
+  if (e->refcount > 0) {
+    e->pending_delete = 1;
+  } else {
+    free_entry_payload(s, e);
+  }
+  unlock(s);
+  return ST_OK;
+}
+
+void shm_store_usage(void* handle, uint64_t* used, uint64_t* capacity, uint64_t* num_objects) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  *used = s->hdr->used_bytes;
+  *capacity = s->hdr->data_size;
+  *num_objects = s->hdr->num_objects;
+  unlock(s);
+}
+
+// List up to max_n sealed object ids into out (16 bytes each); returns count.
+int shm_store_list(void* handle, uint8_t* out, int max_n) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* t = table(s);
+  int n = 0;
+  for (uint64_t i = 0; i < s->hdr->table_capacity && n < max_n; i++) {
+    if (t[i].state == kSealed) {
+      memcpy(out + n * kIdLen, t[i].id, kIdLen);
+      n++;
+    }
+  }
+  unlock(s);
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,1 @@
+from ray_tpu.util.accelerators import tpu  # noqa: F401

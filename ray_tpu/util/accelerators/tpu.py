@@ -1,0 +1,33 @@
+"""User-facing TPU helpers.
+
+Equivalent of the reference's python/ray/util/accelerators/tpu.py
+(get_current_pod_name / get_current_pod_worker_count / chips-per-host).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ray_tpu._private.accelerators.tpu import (
+    TPUAcceleratorManager,
+    infer_slice_shape,
+)
+
+
+def get_current_pod_name() -> Optional[str]:
+    return os.environ.get("TPU_NAME")
+
+
+def get_current_pod_worker_count() -> int:
+    pod_type = TPUAcceleratorManager.get_current_pod_type()
+    if not pod_type:
+        return 1
+    return infer_slice_shape(pod_type)["hosts"]
+
+
+def get_num_tpu_chips_on_node() -> int:
+    return TPUAcceleratorManager.get_current_node_num_accelerators()
+
+
+def pod_slice_chip_count(pod_type: str) -> int:
+    return infer_slice_shape(pod_type)["chips"]

@@ -1,0 +1,126 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py).
+
+Bookkeeping model: every submitted task has an index; `_index_to_future`
+maps unconsumed indexes to futures; an actor is recycled exactly once per
+future, when that future completes (observed via ray_tpu.wait), whether
+or not the result has been consumed yet.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}  # future -> actor (not yet recycled)
+        self._index_to_future = {}  # task index -> future (not yet consumed)
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._consumed: set = set()  # indexes consumed out of order
+        self._pending_submits: List[tuple] = []
+
+    # ------------------------------------------------------------ submission
+    def submit(self, fn: Callable, value: Any):
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = actor
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    # ------------------------------------------------------------- recycling
+    def _recycle(self, future):
+        """Return the actor behind a completed future to the idle set and
+        flush one pending submit."""
+        actor = self._future_to_actor.pop(future, None)
+        if actor is None:
+            return
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def _wait_any(self, timeout=None):
+        """Block until at least one in-flight future completes; recycle it."""
+        in_flight = list(self._future_to_actor.keys())
+        if not in_flight:
+            return
+        ready, _ = ray_tpu.wait(in_flight, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("ActorPool wait timed out")
+        for fut in ready:
+            self._recycle(fut)
+
+    # -------------------------------------------------------------- results
+    def get_next(self, timeout=None) -> Any:
+        """Next result in submission order."""
+        if not self.has_next():
+            raise StopIteration("no more results")
+        while self._next_return_index in self._consumed:
+            self._consumed.discard(self._next_return_index)
+            self._next_return_index += 1
+        while self._next_return_index not in self._index_to_future:
+            if self._next_return_index >= self._next_task_index and not self._pending_submits:
+                raise StopIteration("no more results")
+            self._wait_any(timeout)
+            while self._next_return_index in self._consumed:
+                self._consumed.discard(self._next_return_index)
+                self._next_return_index += 1
+        future = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        result = ray_tpu.get(future, timeout=timeout)
+        self._recycle(future)
+        return result
+
+    def get_next_unordered(self, timeout=None) -> Any:
+        """Next result in completion order."""
+        while True:
+            if not self._index_to_future:
+                if self._pending_submits:
+                    self._wait_any(timeout)
+                    continue
+                raise StopIteration("no more results")
+            ready, _ = ray_tpu.wait(list(self._index_to_future.values()), num_returns=1, timeout=timeout)
+            if not ready:
+                raise TimeoutError("get_next_unordered timed out")
+            future = ready[0]
+            idx = next(i for i, f in self._index_to_future.items() if f == future)
+            del self._index_to_future[idx]
+            self._consumed.add(idx)
+            result = ray_tpu.get(future)
+            self._recycle(future)
+            return result
+
+    # ------------------------------------------------------------------ map
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # --------------------------------------------------------------- manage
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None
+
+    def push(self, actor):
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)

@@ -1,0 +1,195 @@
+"""Collective communication API.
+
+Equivalent of the reference's `ray.util.collective`
+(reference: python/ray/util/collective/collective.py —
+init_collective_group:120, create_collective_group:151, allreduce:258;
+NCCL group with GCS-KV UID rendezvous in
+collective_group/nccl_collective_group.py:28-100,127; Gloo at
+gloo_collective_group.py).
+
+TPU-native design: there is no NCCL and no process group. Two regimes:
+
+1. **Intra-program** (the hot path): collectives inside a jitted SPMD
+   program are `jax.lax.psum/all_gather/ppermute` over mesh axes —
+   use `ray_tpu.parallel`, not this module. XLA emits ICI ops.
+
+2. **Inter-actor host collectives** (this module): the reference's
+   actor-to-actor collective API, re-implemented over the GCS KV store
+   as the rendezvous + a reduce tree through the object store. This is
+   the control-plane / CPU-tensor path (parameter broadcast, metric
+   reduction across hosts) — bandwidth rides DCN either way.
+
+API parity: groups are named; each participant declares (world_size,
+rank); verbs are allreduce/allgather/reducescatter/broadcast/send/recv/
+barrier.
+"""
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_GROUPS: Dict[str, "HostGroup"] = {}
+_NS = "collective"
+
+
+class HostGroup:
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+        self._round = 0
+
+    # -- kv helpers -----------------------------------------------------
+    def _kv(self):
+        from ray_tpu.experimental import internal_kv
+
+        return internal_kv
+
+    def _put(self, key: str, value: Any):
+        self._kv().kv_put(f"{self.group_name}/{key}", pickle.dumps(value), namespace=_NS)
+
+    def _get_blocking(self, key: str, timeout: float = 120.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            v = self._kv().kv_get(f"{self.group_name}/{key}", namespace=_NS)
+            if v is not None:
+                return pickle.loads(v)
+            time.sleep(0.005)
+        raise TimeoutError(f"collective {self.group_name}:{key} timed out")
+
+    # -- verbs ----------------------------------------------------------
+    def allreduce(self, tensor, op: str = "SUM"):
+        """Gather-to-all then local reduce (flat tree; host tensors are
+        control-plane sized — device tensors belong in jax collectives)."""
+        r = self._round
+        self._round += 1
+        self._put(f"ar/{r}/{self.rank}", np.asarray(tensor))
+        parts = [self._get_blocking(f"ar/{r}/{i}") for i in range(self.world_size)]
+        out = np.stack(parts)
+        if op == "SUM":
+            return out.sum(axis=0)
+        if op == "PRODUCT":
+            return out.prod(axis=0)
+        if op == "MAX":
+            return out.max(axis=0)
+        if op == "MIN":
+            return out.min(axis=0)
+        if op == "MEAN":
+            return out.mean(axis=0)
+        raise ValueError(f"bad op {op}")
+
+    def allgather(self, tensor) -> List[np.ndarray]:
+        r = self._round
+        self._round += 1
+        self._put(f"ag/{r}/{self.rank}", np.asarray(tensor))
+        return [self._get_blocking(f"ag/{r}/{i}") for i in range(self.world_size)]
+
+    def reducescatter(self, tensor, op: str = "SUM"):
+        full = self.allreduce(tensor, op)
+        chunks = np.array_split(full, self.world_size)
+        return chunks[self.rank]
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        r = self._round
+        self._round += 1
+        if self.rank == src_rank:
+            self._put(f"bc/{r}", np.asarray(tensor))
+            return np.asarray(tensor)
+        return self._get_blocking(f"bc/{r}")
+
+    def send(self, tensor, dst_rank: int):
+        r = self._round
+        self._round += 1
+        self._put(f"p2p/{r}/{self.rank}->{dst_rank}", np.asarray(tensor))
+
+    def recv(self, src_rank: int):
+        r = self._round
+        self._round += 1
+        return self._get_blocking(f"p2p/{r}/{src_rank}->{self.rank}")
+
+    def barrier(self):
+        r = self._round
+        self._round += 1
+        self._put(f"bar/{r}/{self.rank}", 1)
+        for i in range(self.world_size):
+            self._get_blocking(f"bar/{r}/{i}")
+
+
+def init_collective_group(
+    world_size: int, rank: int, backend: str = "host", group_name: str = "default"
+) -> HostGroup:
+    """Declare this process's membership (reference: collective.py:120)."""
+    if backend not in ("host", "gloo", "nccl", "xla"):
+        raise ValueError(f"unknown backend {backend}")
+    g = HostGroup(world_size, rank, group_name)
+    _GROUPS[group_name] = g
+    return g
+
+
+def create_collective_group(actors, world_size: int, ranks: List[int], backend="host", group_name="default"):
+    """Declarative form (reference: collective.py:151): tell each actor its
+    rank; the actor must call init_collective_group inside."""
+    import ray_tpu
+
+    refs = [
+        a.__ray_call__.remote(_remote_init_group, world_size, r, backend, group_name)
+        if hasattr(a, "__ray_call__")
+        else a.init_collective_group.remote(world_size, r, backend, group_name)
+        for a, r in zip(actors, ranks)
+    ]
+    return ray_tpu.get(refs)
+
+
+def _remote_init_group(self, world_size, rank, backend, group_name):
+    init_collective_group(world_size, rank, backend, group_name)
+    return True
+
+
+def _group(group_name: str) -> HostGroup:
+    g = _GROUPS.get(group_name)
+    if g is None:
+        raise RuntimeError(f"collective group '{group_name}' not initialized in this process")
+    return g
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "SUM"):
+    return _group(group_name).allreduce(tensor, op)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _group(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "SUM"):
+    return _group(group_name).reducescatter(tensor, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _group(group_name).broadcast(tensor, src_rank)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    return _group(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return _group(group_name).recv(src_rank)
+
+
+def barrier(group_name: str = "default"):
+    return _group(group_name).barrier()
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _GROUPS.pop(group_name, None)

@@ -1,0 +1,110 @@
+"""Placement groups — gang resource reservation.
+
+Equivalent of the reference's placement group API
+(reference: python/ray/util/placement_group.py:146 placement_group();
+GCS-side 2-phase bundle commit in
+src/ray/gcs/gcs_server/gcs_placement_group_scheduler.cc, bundle policies
+STRICT_PACK/PACK/STRICT_SPREAD/SPREAD in
+src/ray/raylet/scheduling/policy/bundle_scheduling_policy.cc).
+
+On TPU clusters the canonical bundle is a pod slice: use
+`tpu_slice_bundles()` to build bundles whose TPU counts and labels match
+an ICI topology so a whole slice is reserved as one gang.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.object_ref import ObjectRef
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]], strategy: str):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    def ready(self) -> ObjectRef:
+        """Returns a ref that resolves when the group is placed (parity
+        with the reference's pg.ready())."""
+        from ray_tpu._private.worker import get_global_core
+        import ray_tpu
+
+        pg_id = self.id
+
+        @ray_tpu.remote(num_cpus=0)
+        def _pg_ready_probe():
+            return True
+
+        core = get_global_core()
+        core.gcs_request("pg.ready", {"pg_id": pg_id, "timeout": 300.0}, timeout=310.0)
+        return _pg_ready_probe.remote()
+
+    def wait(self, timeout_seconds: float = 60.0) -> bool:
+        from ray_tpu._private.worker import get_global_core
+
+        try:
+            get_global_core().gcs_request(
+                "pg.ready", {"pg_id": self.id, "timeout": timeout_seconds}, timeout=timeout_seconds + 5
+            )
+            return True
+        except Exception:
+            return False
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return self.bundles
+
+    def __repr__(self):
+        return f"PlacementGroup({self.id[:12]}, {self.strategy}, {len(self.bundles)} bundles)"
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    from ray_tpu._private.worker import get_global_core
+
+    if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+        raise ValueError(f"bad strategy {strategy}")
+    for b in bundles:
+        if not b or any(v < 0 for v in b.values()):
+            raise ValueError(f"bad bundle {b}")
+    core = get_global_core()
+    pg_id = core.gcs_request(
+        "pg.create", {"bundles": bundles, "strategy": strategy, "name": name, "lifetime": lifetime}
+    )
+    return PlacementGroup(pg_id, bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    from ray_tpu._private.worker import get_global_core
+
+    get_global_core().gcs_request("pg.remove", {"pg_id": pg.id})
+
+
+def placement_group_table() -> List[Dict]:
+    from ray_tpu._private.worker import get_global_core
+
+    return get_global_core().gcs_request("pg.table")
+
+
+def tpu_slice_bundles(topology: str, chips_per_host: int = 4) -> List[Dict[str, float]]:
+    """Bundles for a TPU pod slice, one per host.
+
+    Generalizes the reference's `TPU-<pod_type>-head` gang-scheduling
+    trick (reference: python/ray/_private/accelerators/tpu.py:335-398)
+    into first-class bundles: `topology` like "2x2x2" (v4/v5p 3-D torus)
+    or "4x4" (v5e/v6e 2-D). Every host bundle carries its slice's chip
+    count so STRICT_SPREAD over them reserves the whole slice.
+    """
+    dims = [int(x) for x in topology.lower().split("x")]
+    chips = 1
+    for d in dims:
+        chips *= d
+    hosts = max(1, chips // chips_per_host)
+    per_host = chips // hosts
+    return [{"TPU": float(per_host), "CPU": 1.0} for _ in range(hosts)]

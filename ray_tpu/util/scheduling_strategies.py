@@ -1,0 +1,49 @@
+"""Scheduling strategy objects.
+
+Equivalent of the reference's
+python/ray/util/scheduling_strategies.py (PlacementGroupSchedulingStrategy
+:15, NodeAffinitySchedulingStrategy :41, NodeLabelSchedulingStrategy :135).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(
+        self,
+        placement_group,
+        placement_group_bundle_index: int = -1,
+        placement_group_capture_child_tasks: bool = False,
+    ):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = placement_group_capture_child_tasks
+
+    def to_spec_fields(self) -> Dict[str, Any]:
+        pg = self.placement_group
+        return {
+            "placement_group_id": pg.id if hasattr(pg, "id") else pg,
+            "bundle_index": self.placement_group_bundle_index,
+        }
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id: str, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+    def to_spec_fields(self) -> Dict[str, Any]:
+        return {"node_id_affinity": self.node_id, "node_affinity_soft": self.soft}
+
+
+class NodeLabelSchedulingStrategy:
+    def __init__(self, hard: Optional[Dict[str, Any]] = None, soft: Optional[Dict[str, Any]] = None):
+        self.hard = hard or {}
+        self.soft = soft or {}
+
+    def to_spec_fields(self) -> Dict[str, Any]:
+        return {"label_affinity_hard": self.hard, "label_affinity_soft": self.soft}
+
+
+# plain-string strategies pass through: "DEFAULT" | "SPREAD"

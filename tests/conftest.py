@@ -1,0 +1,34 @@
+"""Shared test fixtures.
+
+Equivalent of the reference's python/ray/tests/conftest.py: the
+`ray_start_regular` fixture boots a real local cluster (GCS + raylet +
+workers as separate processes) per test module. JAX tests run on a
+virtual 8-device CPU mesh (reference test strategy: SURVEY.md §4 —
+multi-raylet-on-one-machine plus fake accelerator topology).
+"""
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_isolated():
+    """Fresh cluster per test (slower; for lifecycle/failure tests)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()

@@ -1,0 +1,153 @@
+"""Core API tests: tasks, objects, errors.
+
+Models the reference's python/ray/tests/test_basic.py coverage.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def echo(x):
+    return x
+
+
+@ray_tpu.remote
+def fail(msg):
+    raise ValueError(msg)
+
+
+def test_simple_task(ray_start_regular):
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_many_parallel_tasks(ray_start_regular):
+    refs = [add.remote(i, i) for i in range(50)]
+    assert ray_tpu.get(refs) == [2 * i for i in range(50)]
+
+
+def test_task_args_by_ref(ray_start_regular):
+    a = ray_tpu.put(10)
+    b = add.remote(a, 5)
+    # refs chain through tasks
+    c = add.remote(b, ray_tpu.put(1))
+    assert ray_tpu.get(c) == 16
+
+
+def test_large_object_roundtrip(ray_start_regular):
+    arr = np.random.rand(512, 512)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_large_task_return(ray_start_regular):
+    ref = echo.remote(np.ones((2000, 500), dtype=np.float32))
+    out = ray_tpu.get(ref)
+    assert out.shape == (2000, 500)
+    assert out.sum() == 1000000.0
+
+
+def test_large_task_arg(ray_start_regular):
+    big = np.arange(1_000_000, dtype=np.int64)
+
+    @ray_tpu.remote
+    def total(x):
+        return int(x.sum())
+
+    assert ray_tpu.get(total.remote(big)) == int(big.sum())
+
+
+def test_error_propagation_preserves_type(ray_start_regular):
+    with pytest.raises(ValueError, match="boom"):
+        ray_tpu.get(fail.remote("boom"))
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def outer(n):
+        return sum(ray_tpu.get([add.remote(i, i) for i in range(n)]))
+
+    assert ray_tpu.get(outer.remote(5)) == 20
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3]) == [1, 2, 3]
+
+
+def test_wait_basics(ray_start_regular):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.01)
+    slow = sleepy.remote(5.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=3.0)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_wait_timeout_returns_partial(ray_start_regular):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    slow = sleepy.remote(10.0)
+    ready, not_ready = ray_tpu.wait([slow], timeout=0.2)
+    assert ready == []
+    assert not_ready == [slow]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(60)
+
+    ref = hang.remote()
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(ref, timeout=0.5)
+    ray_tpu.cancel(ref, force=True)
+
+
+def test_options_name_and_resources(ray_start_regular):
+    assert ray_tpu.get(add.options(name="custom", num_cpus=2).remote(3, 4)) == 7
+
+
+def test_put_of_ref_rejected(ray_start_regular):
+    with pytest.raises(TypeError):
+        ray_tpu.put(ray_tpu.put(1))
+
+
+def test_cluster_resources_reported(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= res["CPU"]
+
+
+def test_runtime_context(ray_start_regular):
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.job_id is not None
+
+    @ray_tpu.remote
+    def whoami():
+        c = ray_tpu.get_runtime_context()
+        return c.worker_id
+
+    w = ray_tpu.get(whoami.remote())
+    assert isinstance(w, str) and len(w) == 32

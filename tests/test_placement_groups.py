@@ -1,0 +1,102 @@
+"""Placement group + scheduling strategy + util tests.
+
+Models the reference's python/ray/tests/test_placement_group.py and
+test_scheduling_strategies coverage.
+"""
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+    tpu_slice_bundles,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+def test_create_and_use_pg(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().node_id
+
+    strategy = PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    n = ray_tpu.get(where.options(scheduling_strategy=strategy).remote())
+    assert n is not None
+    remove_placement_group(pg)
+
+
+def test_pg_reserves_resources(ray_start_regular):
+    before = ray_tpu.available_resources()
+    pg = placement_group([{"CPU": 2}], strategy="STRICT_PACK")
+    assert pg.wait(30)
+    after = ray_tpu.available_resources()
+    assert after.get("CPU", 0) == before.get("CPU", 0) - 2
+    remove_placement_group(pg)
+    released = ray_tpu.available_resources()
+    assert released.get("CPU", 0) == before.get("CPU", 0)
+
+
+def test_infeasible_pg_pending(ray_start_regular):
+    pg = placement_group([{"CPU": 1000}], strategy="STRICT_PACK")
+    assert not pg.wait(1.0)
+    remove_placement_group(pg)
+
+
+def test_pg_table(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK", name="table_pg")
+    pg.wait(30)
+    table = placement_group_table()
+    assert any(rec["name"] == "table_pg" for rec in table)
+    remove_placement_group(pg)
+
+
+def test_strict_spread_infeasible_on_one_node(ray_start_regular):
+    # two bundles cannot strict-spread on a single-node cluster
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert not pg.wait(1.0)
+    remove_placement_group(pg)
+
+
+def test_node_affinity(ray_start_regular):
+    node_id = ray_tpu.nodes()[0]["node_id"]
+
+    @ray_tpu.remote
+    def where():
+        return ray_tpu.get_runtime_context().node_id
+
+    got = ray_tpu.get(
+        where.options(scheduling_strategy=NodeAffinitySchedulingStrategy(node_id)).remote()
+    )
+    assert got == node_id
+
+
+def test_tpu_slice_bundles():
+    bundles = tpu_slice_bundles("2x2x2", chips_per_host=4)
+    assert len(bundles) == 2
+    assert bundles[0]["TPU"] == 4.0
+    assert tpu_slice_bundles("4x4", chips_per_host=4) == [{"TPU": 4.0, "CPU": 1.0}] * 4
+
+
+def test_actor_in_pg(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote(num_cpus=1)
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    ).remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    ray_tpu.kill(a)
+    remove_placement_group(pg)

@@ -1,0 +1,114 @@
+"""Tests for the C++ shared-memory object store.
+
+Covers the same ground as the reference's plasma tests
+(reference: src/ray/object_manager/plasma/test/ and
+python/ray/tests/test_object_store.py): create/seal/get roundtrip,
+cross-process visibility, blocking get, LRU eviction, pinning, delete.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.shm_store import ShmStore
+
+
+@pytest.fixture
+def store(tmp_path):
+    path = "/dev/shm/ray_tpu_test_%d_%f" % (os.getpid(), time.time())
+    s = ShmStore.create(path, 64 * 1024 * 1024)
+    yield s
+    s.close()
+    os.unlink(path)
+
+
+def test_roundtrip_zero_copy(store):
+    oid = os.urandom(16)
+    data = np.arange(4096, dtype=np.float64)
+    store.put_bytes(oid, data.tobytes())
+    buf = store.get(oid, timeout_ms=0)
+    got = np.frombuffer(buf.view, dtype=np.float64)
+    np.testing.assert_array_equal(got, data)
+    buf.release()
+
+
+def test_missing_returns_none(store):
+    assert store.get(os.urandom(16), timeout_ms=-1) is None
+    assert store.get(os.urandom(16), timeout_ms=50) is None
+
+
+def test_duplicate_create_raises(store):
+    oid = os.urandom(16)
+    store.put_bytes(oid, b"a")
+    with pytest.raises(FileExistsError):
+        store.create_buffer(oid, 10)
+
+
+def test_unsealed_not_gettable(store):
+    oid = os.urandom(16)
+    store.create_buffer(oid, 128)
+    assert store.get(oid, timeout_ms=-1) is None
+    store.seal(oid)
+    assert store.get(oid, timeout_ms=-1) is not None
+
+
+def test_abort(store):
+    oid = os.urandom(16)
+    store.create_buffer(oid, 128)
+    store.abort(oid)
+    # id is reusable after abort
+    store.put_bytes(oid, b"ok")
+    assert bytes(store.get(oid, timeout_ms=0).view) == b"ok"
+
+
+def test_lru_eviction_under_pressure(store):
+    ids = []
+    for _ in range(100):  # 100 MB into a 64 MB store
+        oid = os.urandom(16)
+        store.put_bytes(oid, b"x" * (1024 * 1024))
+        ids.append(oid)
+    u = store.usage()
+    assert u["used_bytes"] <= u["capacity_bytes"]
+    # oldest were evicted, newest survive
+    assert store.get(ids[0], timeout_ms=-1) is None
+    assert store.get(ids[-1], timeout_ms=-1) is not None
+
+
+def test_pinned_objects_survive_eviction(store):
+    pinned_id = os.urandom(16)
+    store.put_bytes(pinned_id, b"p" * (1024 * 1024))
+    pin = store.get(pinned_id, timeout_ms=0)
+    for _ in range(100):
+        store.put_bytes(os.urandom(16), b"x" * (1024 * 1024))
+    assert store.contains(pinned_id)
+    assert bytes(pin.view[:1]) == b"p"
+    pin.release()
+
+
+def test_delete_deferred_until_released(store):
+    oid = os.urandom(16)
+    store.put_bytes(oid, b"d" * 100)
+    buf = store.get(oid, timeout_ms=0)
+    store.delete(oid)
+    # still readable through the pinned buffer
+    assert bytes(buf.view[:1]) == b"d"
+    buf.release()
+    assert store.get(oid, timeout_ms=-1) is None
+
+
+def test_cross_process_blocking_get(store):
+    oid = os.urandom(16)
+    code = (
+        "from ray_tpu._private.shm_store import ShmStore\n"
+        f"s = ShmStore({store.path!r})\n"
+        f"b = s.get(bytes.fromhex({oid.hex()!r}), timeout_ms=10000)\n"
+        "print('LEN', len(b))\n"
+    )
+    p = subprocess.Popen([sys.executable, "-c", code], stdout=subprocess.PIPE, text=True, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    time.sleep(0.3)
+    store.put_bytes(oid, b"z" * 12345)
+    out, _ = p.communicate(timeout=30)
+    assert "LEN 12345" in out

@@ -1,0 +1,68 @@
+"""Tests for util components: queue, actor pool, internal kv, dag."""
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import internal_kv
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Queue
+
+
+def test_internal_kv(ray_start_regular):
+    internal_kv.kv_put("k1", b"v1")
+    assert internal_kv.kv_get("k1") == b"v1"
+    assert internal_kv.kv_exists("k1")
+    assert "k1" in internal_kv.kv_list("k")
+    internal_kv.kv_del("k1")
+    assert internal_kv.kv_get("k1") is None
+
+
+def test_queue(ray_start_regular):
+    q = Queue(maxsize=4)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get(block=False)
+    q.shutdown()
+
+
+def test_queue_blocking_get(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(queue):
+        import time
+
+        time.sleep(0.3)
+        queue.put("hello")
+        return True
+
+    producer.remote(q)
+    assert q.get(timeout=10) == "hello"
+    q.shutdown()
+
+
+def test_actor_pool_map(ray_start_regular):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return 2 * x
+
+    pool = ActorPool([Doubler.remote(), Doubler.remote()])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * i for i in range(8)]
+
+
+def test_dag_bind_execute(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    dag = mul.bind(inc.bind(1), inc.bind(2))
+    assert ray_tpu.get(dag.execute()) == 6
