@@ -1,0 +1,126 @@
+"""Benchmark — prints ONE JSON line to stdout.
+
+Headline metric: 1:1 sync actor call throughput, directly comparable to
+the reference's release microbenchmark
+(reference: python/ray/_private/ray_perf.py "1:1 actor calls sync";
+recorded baseline 2,138 calls/s in release_logs/2.9.2/microbenchmark.json
+— see BASELINE.md). vs_baseline > 1.0 means faster than the reference.
+
+Side metrics (TPU train-step throughput/MFU on the flagship model, async
+actor calls, task throughput) go to stderr so the stdout contract stays
+a single JSON line.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_SYNC_ACTOR_CALLS = 2138.0  # reference release rig
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def bench_runtime():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, object_store_memory=256 * 1024 * 1024)
+
+    @ray_tpu.remote
+    class Echo:
+        def ping(self, x=None):
+            return x
+
+    a = Echo.remote()
+    ray_tpu.get(a.ping.remote())
+    # warmup
+    for _ in range(200):
+        ray_tpu.get(a.ping.remote())
+
+    N = 3000
+    t0 = time.time()
+    for _ in range(N):
+        ray_tpu.get(a.ping.remote())
+    sync_rate = N / (time.time() - t0)
+    log(f"[bench] 1:1 sync actor calls: {sync_rate:.0f}/s (baseline {BASELINE_SYNC_ACTOR_CALLS:.0f})")
+
+    t0 = time.time()
+    ray_tpu.get([a.ping.remote() for _ in range(N)])
+    log(f"[bench] 1:1 async actor calls: {N / (time.time() - t0):.0f}/s (baseline 9183)")
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ray_tpu.get(noop.remote())
+    t0 = time.time()
+    ray_tpu.get([noop.remote() for _ in range(500)])
+    log(f"[bench] async tasks: {500 / (time.time() - t0):.0f}/s")
+
+    ray_tpu.shutdown()
+    return sync_rate
+
+
+def bench_tpu_train():
+    """Flagship-model train step on the real chip (side metric)."""
+    try:
+        import jax
+
+        if jax.default_backend() not in ("tpu",):
+            log(f"[bench] no TPU backend ({jax.default_backend()}); skipping train bench")
+            return
+        import jax.numpy as jnp
+
+        from ray_tpu.models.llama import LlamaConfig, flops_per_token
+        from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+        from ray_tpu.train.step import build_sharded_train_step
+
+        cfg = LlamaConfig.nano_tpu()
+        B, T = 8, 1024
+        mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+        init_fn, step_fn, shard_batch, _ = build_sharded_train_step(cfg, mesh, strategy="dp")
+        state = init_fn(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T + 1), 0, cfg.vocab_size)
+        batch = shard_batch({"tokens": tokens})
+        t0 = time.time()
+        state, m = step_fn(state, batch)
+        jax.block_until_ready(m["loss"])
+        log(f"[bench] train step compile: {time.time() - t0:.1f}s, loss {float(m['loss']):.3f}")
+
+        steps = 10
+        t0 = time.time()
+        for _ in range(steps):
+            state, m = step_fn(state, batch)
+        jax.block_until_ready(m["loss"])
+        dt = (time.time() - t0) / steps
+        tokens_per_s = B * T / dt
+        flops = flops_per_token(cfg, T) * B * T
+        # v5e peak ≈ 197 TFLOP/s bf16
+        mfu = flops / dt / 197e12
+        log(
+            f"[bench] llama-nano train: {dt * 1e3:.1f} ms/step, "
+            f"{tokens_per_s:,.0f} tok/s/chip, ~{mfu * 100:.1f}% MFU (v5e peak)"
+        )
+    except Exception as e:
+        log(f"[bench] tpu train bench failed: {type(e).__name__}: {e}")
+
+
+def main():
+    sync_rate = bench_runtime()
+    bench_tpu_train()
+    print(
+        json.dumps(
+            {
+                "metric": "actor_calls_sync_1to1",
+                "value": round(sync_rate, 1),
+                "unit": "calls/s",
+                "vs_baseline": round(sync_rate / BASELINE_SYNC_ACTOR_CALLS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

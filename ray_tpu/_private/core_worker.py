@@ -42,6 +42,17 @@ MODE_DRIVER = "driver"
 MODE_WORKER = "worker"
 
 
+class _Cell:
+    """A pending object slot, waitable from both worlds."""
+
+    __slots__ = ("env", "event", "waiters")
+
+    def __init__(self):
+        self.env = None
+        self.event = threading.Event()
+        self.waiters: List[asyncio.Future] = []
+
+
 def _env_inline(data: bytes):
     return {"k": "i", "d": data}
 
@@ -96,9 +107,14 @@ class CoreWorker:
         self._peer_conns: Dict[str, protocol.Connection] = {}  # addr -> conn
         self._peer_lock: Optional[asyncio.Lock] = None
 
-        # in-process store: oid -> envelope; pending: oid -> Future(envelope)
+        # in-process store: oid -> envelope; pending: oid -> _Cell. Cells
+        # are waitable from user threads (threading.Event) AND from the IO
+        # loop (futures) — the sync hot path never ping-pongs through the
+        # loop (reference analogue: CoreWorker's in-process memory store,
+        # src/ray/core_worker/store_provider/memory_store/).
         self._store: Dict[bytes, Dict[str, Any]] = {}
-        self._pending: Dict[bytes, asyncio.Future] = {}
+        self._pending: Dict[bytes, "_Cell"] = {}
+        self._store_lock = threading.Lock()
 
         self._shm: Optional[ShmStore] = ShmStore(shm_path) if shm_path else None
         self._shm_path = shm_path
@@ -241,21 +257,51 @@ class CoreWorker:
             return "pong"
         raise ValueError(f"unexpected peer method {method}")
 
+    def _awaitable_for(self, oid: bytes) -> Optional[asyncio.Future]:
+        """Loop-side: a future resolving when the pending oid delivers, or
+        None if not pending."""
+        with self._store_lock:
+            env = self._store.get(oid)
+            if env is not None:
+                fut = asyncio.get_running_loop().create_future()
+                fut.set_result(env)
+                return fut
+            cell = self._pending.get(oid)
+            if cell is None:
+                return None
+            fut = asyncio.get_running_loop().create_future()
+            cell.waiters.append(fut)
+            return fut
+
     async def _serve_owner_resolve(self, data):
         oid = bytes(data["oid"])
-        env = self._store.get(oid)
-        if env is not None:
-            return env
-        fut = self._pending.get(oid)
+        fut = self._awaitable_for(oid)
         if fut is None:
             return {"k": "lost"}
-        return await asyncio.wait_for(asyncio.shield(fut), data.get("timeout", 300.0))
+        return await asyncio.wait_for(fut, data.get("timeout", 300.0))
+
+    def _make_pending(self, oid: bytes) -> "_Cell":
+        with self._store_lock:
+            cell = self._pending.get(oid)
+            if cell is None:
+                cell = _Cell()
+                self._pending[oid] = cell
+            return cell
 
     def _deliver(self, oid: bytes, env: Dict[str, Any]):
-        self._store[oid] = env
-        fut = self._pending.pop(oid, None)
-        if fut is not None and not fut.done():
-            fut.set_result(env)
+        """Called on the IO loop (or any thread for local puts)."""
+        with self._store_lock:
+            self._store[oid] = env
+            cell = self._pending.pop(oid, None)
+        if cell is not None:
+            cell.env = env
+            cell.event.set()
+            for fut in cell.waiters:
+                if not fut.done():
+                    fut.get_loop().call_soon_threadsafe(
+                        lambda f=fut: f.done() or f.set_result(env)
+                    )
+            cell.waiters.clear()
 
     # -------------------------------------------------------------- objects
     def put(self, value: Any, owner_inline_to_gcs: bool = True) -> ObjectRef:
@@ -270,7 +316,7 @@ class CoreWorker:
             n = serialization.write_to(memoryview(data), pickled, buffers)
             env = _env_inline(bytes(data[:n]))
             self._deliver(oid, env)
-            self._call(self._gcs.request("obj.put_inline", {"oid": oid, "data": env["d"]}))
+            self._push_gcs("obj.put_inline", {"oid": oid, "data": env["d"]})
         else:
             buf = self._shm.create_buffer(oid, total)
             serialization.write_to(buf, pickled, buffers)
@@ -278,10 +324,15 @@ class CoreWorker:
             self._shm.seal(oid)
             env = _env_shm(self.node_id, total)
             self._deliver(oid, env)
-            self._call(
-                self._gcs.request("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": total})
-            )
+            self._push_gcs("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": total})
         return ObjectRef(oid)
+
+    def _push_gcs(self, method: str, data):
+        """Fire-and-forget directory update from any thread (ordering
+        preserved on the GCS stream; resolvers grace-retry 'unknown')."""
+        self._loop.call_soon_threadsafe(
+            lambda: self._loop.create_task(self._gcs.push(method, data))
+        )
 
     def put_serialized_to_shm(self, oid: bytes, pickled, buffers) -> Dict[str, Any]:
         """Write an already-serialized value into the node arena; returns env."""
@@ -306,21 +357,23 @@ class CoreWorker:
         return out
 
     async def _aresolve(self, oid: bytes, timeout: Optional[float]) -> Dict[str, Any]:
-        env = self._store.get(oid)
-        if env is not None:
-            return env
-        fut = self._pending.get(oid)
+        fut = self._awaitable_for(oid)
         if fut is not None:
             try:
-                return await asyncio.wait_for(asyncio.shield(fut), timeout)
+                return await asyncio.wait_for(fut, timeout)
             except asyncio.TimeoutError:
                 raise exceptions.GetTimeoutError(f"get timed out on {oid.hex()}")
         # not owned by us — consult the directory
         deadline = None if timeout is None else time.monotonic() + timeout
+        unknown_grace = time.monotonic() + 1.0  # put-push may still be in flight
 
         while True:
             reply = await self._gcs.request("obj.resolve", {"oid": oid, "node_id": self.node_id})
             status = reply["status"]
+            if status == "unknown" and time.monotonic() < unknown_grace:
+                # fire-and-forget registration racing with this resolve
+                await asyncio.sleep(0.02)
+                continue
             if status == "inline":
                 env = _env_inline(reply["data"])
                 self._store[oid] = env
@@ -418,14 +471,34 @@ class CoreWorker:
         return exceptions.TaskError(env.get("fn", "?"), env.get("tb", env.get("m", "")), env.get("t", ""))
 
     def get_values(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
-        """get() with local-shm decoding (the public path)."""
+        """get() with local-shm decoding (the public path).
+
+        Fast path: owned refs resolve on the calling thread via the cell
+        event — no IO-loop round trip (this is what the 1:1 sync actor
+        call benchmark measures)."""
         oids = [r.binary() for r in refs]
-        envs = self._call(self._aget_envs(oids, timeout))
-        out = []
-        for oid, env in zip(oids, envs):
-            val = self._decode_ref(oid, env)
-            out.append(val)
-        return out
+        envs: List[Optional[Dict[str, Any]]] = [None] * len(oids)
+        slow: List[int] = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for i, oid in enumerate(oids):
+            env = self._store.get(oid)
+            if env is not None:
+                envs[i] = env
+                continue
+            cell = self._pending.get(oid)
+            if cell is not None:
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                if not cell.event.wait(remaining):
+                    raise exceptions.GetTimeoutError(f"get timed out on {oid.hex()}")
+                envs[i] = cell.env if cell.env is not None else self._store.get(oid)
+            else:
+                slow.append(i)
+        if slow:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            resolved = self._call(self._aget_envs([oids[i] for i in slow], remaining))
+            for i, env in zip(slow, resolved):
+                envs[i] = env
+        return [self._decode_ref(oid, env) for oid, env in zip(oids, envs)]
 
     def wait(
         self,
@@ -444,13 +517,20 @@ class CoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: set = set()
         while True:
+            waiters = []
             for oid in oids:
                 if oid in ready:
                     continue
                 if oid in self._store:
                     ready.add(oid)
                     continue
-                if oid not in self._pending:
+                fut = self._awaitable_for(oid)
+                if fut is not None:
+                    if fut.done():
+                        ready.add(oid)
+                    else:
+                        waiters.append(fut)
+                else:
                     # foreign ref — nonblocking directory probe
                     reply = await self._gcs.request("obj.locations", {"oid": oid})
                     if reply and (reply["has_inline"] or reply["locations"]):
@@ -459,8 +539,6 @@ class CoreWorker:
                 return ready
             if deadline is not None and time.monotonic() >= deadline:
                 return ready
-            waiters = [self._pending[oid] for oid in oids if oid in self._pending and oid not in ready]
-            t = 0.05 if not waiters else None
             if waiters:
                 t = 0.25 if deadline is None else min(0.25, max(0.0, deadline - time.monotonic()))
                 await asyncio.wait(waiters, timeout=t, return_when=asyncio.FIRST_COMPLETED)
@@ -560,15 +638,13 @@ class CoreWorker:
             "owner_addr": self._listen_addr,
             **(scheduling or {}),
         }
-        self._call(self._asubmit(spec))
-        return [ObjectRef(oid) for oid in returns]
-
-    async def _asubmit(self, spec):
-        for oid in spec["returns"]:
-            if oid not in self._pending:
-                self._pending[oid] = self._loop.create_future()
+        for oid in returns:
+            self._make_pending(oid)
         self._submitted[spec["task_id"]] = {"spec": spec, "retries_left": spec.get("max_retries", 0)}
-        await self._gcs.request("task.submit", {"spec": spec})
+        self._loop.call_soon_threadsafe(
+            lambda: self._loop.create_task(self._gcs.request("task.submit", {"spec": spec}))
+        )
+        return [ObjectRef(oid) for oid in returns]
 
     async def _on_task_failed(self, data):
         rec = self._submitted.get(data["task_id"])
@@ -621,23 +697,26 @@ class CoreWorker:
             "returns": returns,
             "caller": self.client_id,
         }
-        self._call(self._asubmit_actor(spec, max_task_retries))
+        for oid in returns:
+            self._make_pending(oid)
+        # fire-and-forget enqueue: the caller holds refs whose cells are
+        # already waitable; the loop does the sending
+        self._loop.call_soon_threadsafe(self._enqueue_actor_call, spec, max_task_retries)
         return [ObjectRef(oid) for oid in returns]
 
-    async def _asubmit_actor(self, spec, retries_left: int):
+    def _enqueue_actor_call(self, spec, retries_left: int):
         import collections
 
-        for oid in spec["returns"]:
-            self._pending[oid] = self._loop.create_future()
         actor_id = spec["actor_id"]
         q = self._actor_queues.setdefault(actor_id, collections.deque())
         q.append((spec, retries_left))
         sender = self._actor_senders.get(actor_id)
         if sender is None or sender.done():
-            self._actor_senders[actor_id] = asyncio.get_running_loop().create_task(
-                self._actor_sender_loop(actor_id)
-            )
-        await self._gcs.request("obj.register_owned", {"oids": spec["returns"]})
+            self._actor_senders[actor_id] = self._loop.create_task(self._actor_sender_loop(actor_id))
+        # ownership registration is fire-and-forget: the directory only
+        # needs it before some *other* process resolves the ref, and the
+        # push rides the same ordered GCS stream
+        self._loop.create_task(self._gcs.push("obj.register_owned", {"oids": spec["returns"]}))
 
     def _fail_call(self, spec, exc: BaseException):
         err = _env_err(exc)

@@ -95,31 +95,49 @@ class Executor:
 
     async def _run_user_function(self, spec, actor: bool = False):
         name = spec.get("name") or spec.get("method", "?")
+        loop = asyncio.get_running_loop()
+        is_async = actor and self.actor_is_async and inspect.iscoroutinefunction(
+            getattr(type(self.actor_instance), spec["method"], None)
+        )
         try:
-            loop = asyncio.get_running_loop()
-
-            def _prepare():
-                # runs off the IO loop: load_function/unpack_args may issue
-                # blocking round-trips through the CoreWorker loop
-                if actor:
-                    fn = getattr(self.actor_instance, spec["method"])
-                else:
-                    fn = self.core.load_function(spec["fn_id"])
-                args, kwargs = self.core.unpack_args(spec["args"])
-                return fn, args, kwargs
-
-            fn, args, kwargs = await loop.run_in_executor(self.pool, _prepare)
-            if inspect.iscoroutinefunction(fn):
+            if is_async:
+                # async actor: unpack off-loop, run the coroutine on-loop
+                args, kwargs = await loop.run_in_executor(self.pool, self.core.unpack_args, spec["args"])
+                fn = getattr(self.actor_instance, spec["method"])
                 result = await fn(*args, **kwargs)
-            else:
-                def _invoke():
-                    self._current_thread = threading.current_thread()
-                    try:
-                        return fn(*args, **kwargs)
-                    finally:
-                        self._current_thread = None
+                values = self._split_returns(spec, result)
+                if values is None:
+                    return [self._bad_arity_env(spec, name)] * len(spec["returns"])
+                return [await self._to_env(oid, v) for oid, v in zip(spec["returns"], values)]
 
-                result = await loop.run_in_executor(self.pool, _invoke)
+            # sync path: ONE executor hop covering unpack → invoke →
+            # serialize (each hop is a loop⇄thread round trip; the 1:1
+            # sync actor-call benchmark lives and dies on these)
+            def _run_all():
+                self._current_thread = threading.current_thread()
+                try:
+                    if actor:
+                        fn = getattr(self.actor_instance, spec["method"])
+                    else:
+                        fn = self.core.load_function(spec["fn_id"])
+                    args, kwargs = self.core.unpack_args(spec["args"])
+                    if inspect.iscoroutinefunction(fn):
+                        import asyncio as _a
+
+                        result = _a.run_coroutine_threadsafe(fn(*args, **kwargs), loop).result()
+                    else:
+                        result = fn(*args, **kwargs)
+                    values = self._split_returns(spec, result)
+                    if values is None:
+                        return [self._bad_arity_env(spec, name)]
+                    return [self._to_env_sync(oid, v) for oid, v in zip(spec["returns"], values)]
+                finally:
+                    self._current_thread = None
+
+            envs = await loop.run_in_executor(self.pool, _run_all)
+            if len(envs) == 1 and len(spec["returns"]) > 1:
+                envs = envs * len(spec["returns"])
+            return envs
         except Exception as e:
             tb = traceback.format_exc()
             logger.info("task %s failed: %s", name, tb)
@@ -129,17 +147,30 @@ class Executor:
                 err["t"] = "TaskCancelledError"
             return [err] * len(spec["returns"])
 
+    def _split_returns(self, spec, result):
         n = len(spec["returns"])
         if n == 1:
-            values = [result]
-        else:
-            values = list(result) if isinstance(result, (tuple, list)) else [result] * n
-            if len(values) != n:
-                err = _env_err(
-                    ValueError(f"task returned {len(values)} values, expected {n}"), name
-                )
-                return [err] * n
-        return [await self._to_env(oid, v) for oid, v in zip(spec["returns"], values)]
+            return [result]
+        values = list(result) if isinstance(result, (tuple, list)) else None
+        if values is None or len(values) != n:
+            return None
+        return values
+
+    def _bad_arity_env(self, spec, name):
+        return _env_err(ValueError(f"task did not return {len(spec['returns'])} values"), name)
+
+    def _to_env_sync(self, oid, value):
+        """Serialize a result on the current (executor) thread."""
+        from ray_tpu._private import serialization
+        from ray_tpu._private.config import RayConfig
+
+        pickled, buffers, _ = serialization.serialize(value)
+        total = serialization.serialized_size(pickled, buffers)
+        if total <= RayConfig.object_store_inline_max_bytes or self.core._shm is None:
+            data = bytearray(total)
+            n = serialization.write_to(memoryview(data), pickled, buffers)
+            return _env_inline(bytes(data[:n]))
+        return self.core.put_serialized_to_shm(bytes(oid), pickled, buffers)
 
     async def _to_env(self, oid: bytes, value: Any):
         loop = asyncio.get_running_loop()
@@ -189,6 +220,19 @@ class Executor:
 
 
 async def _amain():
+    # Pin the jax platform when the cluster asks for it (tests force cpu
+    # meshes; the axon sitecustomize would otherwise grab the TPU in every
+    # worker). Done eagerly because jax.config must win before first
+    # backend init, wherever user code later imports jax.
+    forced = os.environ.get("RAY_TPU_WORKER_JAX_PLATFORMS")
+    if forced:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", forced)
+        except Exception:
+            pass
+
     session_dir = os.environ["RAY_TPU_SESSION_DIR"]
     gcs_addr = os.environ["RAY_TPU_GCS_ADDR"]
     raylet_sock = os.environ["RAY_TPU_RAYLET_SOCK"]

@@ -1,0 +1,226 @@
+"""Llama-family transformer, TPU-first.
+
+The flagship model for the framework (BASELINE.json north star:
+Llama-2-7B pretraining ≥40% MFU on a v5p slice). Design choices:
+
+- Functional pytree params (no framework Module state): params and a
+  twin tree of logical axis names, so any parallelism strategy from
+  ray_tpu.parallel.sharding places the same model (DP/FSDP/TP/SP/EP)
+  without touching model code. This replaces the reference's
+  DDP/FSDP-wrap-the-module approach
+  (reference: python/ray/train/torch/train_loop_utils.py:158,453).
+- bf16 params/activations, fp32 RMSNorm + softmax + logits, MXU-aligned
+  dims, rotary embeddings, GQA, SwiGLU.
+- Attention backends: pallas flash kernel ("flash"), O(T)-memory XLA
+  ("blockwise"), or ring attention over the sp axis ("ring").
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.blockwise_attention import blockwise_attention
+from ray_tpu.ops.normalization import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "blockwise"  # flash | blockwise | ring
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=32, d_ff=11008, max_seq_len=4096), **kw})
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**{**dict(
+            vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14336, max_seq_len=8192, rope_theta=500000.0), **kw})
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test-sized model."""
+        return LlamaConfig(**{**dict(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=256, max_seq_len=256), **kw})
+
+    @staticmethod
+    def nano_tpu(**kw) -> "LlamaConfig":
+        """Single-chip bench model: MXU-aligned, fits one v5e chip."""
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=8,
+            n_kv_heads=8, d_ff=4096, max_seq_len=2048), **kw})
+
+
+def init_params(key, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Returns a params pytree; see logical_axes() for its sharding twin."""
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    d, h, kvh, hd, f = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)).astype(cfg.dtype)
+
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+
+    def make_layer(k):
+        ks = jax.random.split(k, 7)
+        return {
+            "attn_norm": jnp.ones((d,), cfg.dtype),
+            "wq": dense(ks[0], (d, h * hd), d),
+            "wk": dense(ks[1], (d, kvh * hd), d),
+            "wv": dense(ks[2], (d, kvh * hd), d),
+            "wo": dense(ks[3], (h * hd, d), h * hd),
+            "mlp_norm": jnp.ones((d,), cfg.dtype),
+            "w_gate": dense(ks[4], (d, f), d),
+            "w_up": dense(ks[5], (d, f), d),
+            "w_down": dense(ks[6], (f, d), f),
+        }
+
+    # stacked layers: one leading layer axis → lax.scan over layers keeps
+    # compile time O(1) in depth (XLA-friendly; no Python layer loop)
+    layers = jax.vmap(make_layer)(layer_keys)
+    return {
+        "embed": dense(k_embed, (cfg.vocab_size, d), d),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": dense(k_out, (d, cfg.vocab_size), d),
+    }
+
+
+def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Twin tree of logical axis names (layer axis is None — stacked)."""
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": (None, "embed"),
+            "wq": (None, "embed", "heads"),
+            "wk": (None, "embed", "kv"),
+            "wv": (None, "embed", "kv"),
+            "wo": (None, "heads", "embed"),
+            "mlp_norm": (None, "embed"),
+            "w_gate": (None, "embed", "mlp"),
+            "w_up": (None, "embed", "mlp"),
+            "w_down": (None, "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def _attention(q, k, v, cfg: LlamaConfig, mesh=None):
+    if cfg.attn_impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, True)
+    if cfg.attn_impl == "ring":
+        from ray_tpu.parallel.ring_attention import ring_attention
+
+        # inside jit with sp-sharded activations this must be called via
+        # shard_map by the caller; plain path falls back to blockwise
+        return blockwise_attention(q, k, v, True, 512)
+    return blockwise_attention(q, k, v, True, min(512, q.shape[1]))
+
+
+def _layer_fn(layer, x, cos_sin, cfg: LlamaConfig, mesh=None, rules=None):
+    cos, sin = cos_sin
+    B, T, d = x.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def cstr(t, axes):
+        if mesh is not None and rules is not None:
+            from ray_tpu.parallel.sharding import constraint
+
+            return constraint(t, mesh, axes, rules)
+        return t
+
+    # attention block
+    a = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    q = (a @ layer["wq"]).reshape(B, T, h, hd)
+    k = (a @ layer["wk"]).reshape(B, T, kvh, hd)
+    v = (a @ layer["wv"]).reshape(B, T, kvh, hd)
+    q = cstr(q, ("batch", "seq", "act_heads", None))
+    k = cstr(k, ("batch", "seq", None, None))
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    o = _attention(q, k, v, cfg, mesh)
+    o = o.reshape(B, T, h * hd) @ layer["wo"]
+    x = x + cstr(o, ("batch", "seq", "act_embed"))
+
+    # mlp block (SwiGLU)
+    m = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+    gate = jax.nn.silu((m @ layer["w_gate"]).astype(jnp.float32)).astype(cfg.dtype)
+    up = m @ layer["w_up"]
+    down = (gate * up) @ layer["w_down"]
+    return x + cstr(down, ("batch", "seq", "act_embed"))
+
+
+def forward(params, tokens, cfg: LlamaConfig, mesh=None, rules=None):
+    """tokens: [B, T] int32 → logits [B, T, vocab] (fp32)."""
+    B, T = tokens.shape
+    x = params["embed"][tokens].astype(cfg.dtype)
+    cos, sin = rope_frequencies(cfg.head_dim, T, cfg.rope_theta)
+
+    layer_fn = functools.partial(_layer_fn, cfg=cfg, mesh=mesh, rules=rules)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(x, layer):
+        return layer_fn(layer, x, (cos, sin)), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = (x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32))
+    return logits
+
+
+def loss_fn(params, batch, cfg: LlamaConfig, mesh=None, rules=None):
+    """Next-token cross entropy. batch: {"tokens": [B, T+1]} or
+    {"inputs": [B,T], "targets": [B,T]}."""
+    if "tokens" in batch:
+        inputs = batch["tokens"][:, :-1]
+        targets = batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    logits = forward(params, inputs, cfg, mesh, rules)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    d, h, kvh, hd, f, L, V = (
+        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers, cfg.vocab_size,
+    )
+    per_layer = d * h * hd + 2 * d * kvh * hd + h * hd * d + 3 * d * f + 2 * d
+    return V * d + L * per_layer + d + d * V
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Training FLOPs/token (fwd+bwd ≈ 6·params + attention term)."""
+    attn = 12 * cfg.n_layers * cfg.d_model * seq_len  # qk^T + pv fwd+bwd
+    return 6 * num_params(cfg) + attn

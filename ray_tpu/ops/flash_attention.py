@@ -1,0 +1,162 @@
+"""Flash attention — pallas TPU kernel.
+
+The hot attention path: online-softmax over KV blocks entirely in VMEM,
+MXU-shaped (128-aligned) tiles, fp32 accumulators around bf16 matmuls.
+Forward is the pallas kernel below; backward reuses the O(T)-memory
+blockwise XLA backward (ray_tpu/ops/blockwise_attention.py) — XLA already
+fuses that well, and it keeps one source of truth for gradients.
+
+Nothing to port from the reference (attention kernels are absent there;
+GPU deployments rely on external flash-attn inside train workers). Kernel
+structure follows the public flash-attention-on-pallas pattern
+(jax-ml pallas ops; guide: /opt/skills/guides/pallas_guide.md).
+
+Layout: [batch, seq, heads, head_dim]; GQA via kv-head broadcast.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_tpu.ops.blockwise_attention import _broadcast_kv, _bwd as _blockwise_bwd, _fwd_impl
+
+NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *, scale, causal, bq, bk, nk):
+    j = pl.program_id(2)
+    i = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    # Skip fully-masked kv blocks (strictly above the causal diagonal).
+    run = True
+    if causal:
+        run = j * bk <= i * bq + bq - 1
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, D]
+        v = v_ref[0].astype(jnp.float32)                  # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                  # [bq, bk]
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        m_prev = m_s[:]                                    # [bq, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                             # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)                     # [bq, 1]
+        l_s[:] = l_s[:] * corr + p.sum(axis=-1, keepdims=True)
+        m_s[:] = m_new
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nk - 1)
+    def _():
+        l_safe = jnp.where(l_s[:] == 0.0, 1.0, l_s[:])
+        o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
+        # lse broadcast across a 128-lane tile (TPU block tiling forbids a
+        # bare [bq] vector output); caller slices lane 0
+        lse_ref[0] = jnp.broadcast_to(m_s[:] + jnp.log(l_safe), (bq, 128))
+
+
+def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    k = _broadcast_kv(k, H)
+    v = _broadcast_kv(v, H)
+    bq = min(block_q, T)
+    bk = min(block_k, S)
+    assert T % bq == 0 and S % bk == 0, "seq lengths must divide block sizes"
+    nq, nk = T // bq, S // bk
+
+    qr = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    o = o.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    lse = lse[:, :, 0].reshape(B, H, T).transpose(0, 2, 1)  # [B, T, H]
+    return o, lse
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    o, _ = _flash_fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _flash_fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k):
+    T, S = q.shape[1], k.shape[1]
+    use_pallas = _on_tpu() and T % min(block_q, T) == 0 and S % min(block_k, S) == 0 and q.shape[3] % 128 == 0
+    if use_pallas:
+        return _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret=False)
+    # XLA fallback (CPU tests, odd shapes)
+    return _fwd_impl(q, k, v, causal, max(block_q, block_k), sm_scale, 0, 0)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    o, lse = _flash_fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
+    return _blockwise_bwd(causal, max(block_q, block_k), sm_scale, 0, 0, res, do)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,31 @@
+"""Rotary position embeddings (RoPE).
+
+Pure-XLA: rope is bandwidth-trivial and fuses into the surrounding
+matmuls; a pallas kernel would buy nothing here (guide: let XLA fuse what
+it already fuses).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0, dtype=jnp.float32):
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    angles = jnp.outer(t, freqs)  # [T, half]
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(x, cos, sin, positions=None):
+    """x: [B, T, H, D]; cos/sin: [maxT, D/2]; positions: [B, T] or None."""
+    B, T, H, D = x.shape
+    if positions is None:
+        c = cos[:T][None, :, None, :]
+        s = sin[:T][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]
+        s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,116 @@
+"""Expert parallelism: top-k gated MoE with all_to_all dispatch.
+
+Green-field (EP is absent from the reference — SURVEY.md §2.4). TPU-first
+design: experts are sharded on the `ep` mesh axis; tokens are routed with
+a capacity-bounded top-k gate and exchanged with two `all_to_all`s
+(dispatch + combine), the canonical TPU MoE layout (Switch/GShard style —
+static shapes, no scatter).
+
+Everything here runs inside shard_map over the `ep` axis; the grouped
+expert matmuls stay MXU-shaped: [experts_local, capacity*ep, d_model].
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class GateResult(NamedTuple):
+    combine_weights: jax.Array  # [tokens, experts, capacity]
+    dispatch_mask: jax.Array    # [tokens, experts, capacity] bool
+    aux_loss: jax.Array
+
+
+def top1_gate(logits, capacity: int):
+    """Switch-style top-1 gating with capacity + load-balance aux loss.
+
+    logits: [tokens, num_experts]
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                      # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)    # [T, E]
+    # position of each token within its expert's queue
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # [T, E]
+    keep = (pos < capacity) & (onehot > 0)                   # [T, E]
+    pos = pos.astype(jnp.int32)
+
+    cap_onehot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T, E, C]
+    dispatch = keep[..., None] & (cap_onehot > 0)
+    combine = gate[:, None, None] * dispatch.astype(jnp.float32)
+
+    # load balancing loss (Switch eq. 4)
+    density = onehot.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux = (density * density_proxy).sum() * (E * E) / E
+    return GateResult(combine, dispatch, aux)
+
+
+def moe_layer(
+    x,
+    gate_w,
+    expert_fn: Callable,
+    expert_params,
+    axis_name: str = "ep",
+    capacity_factor: float = 1.25,
+):
+    """Inside shard_map. x: [B, T_local... , D] flattened to tokens.
+
+    expert_params leaves have leading dim experts_local (sharded on ep);
+    expert_fn(params_e, tokens) applies one expert.
+    """
+    ep = jax.lax.axis_size(axis_name)
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    tokens = x.reshape(-1, D)
+    T = tokens.shape[0]
+    e_local = jax.tree.leaves(expert_params)[0].shape[0]
+    E = e_local * ep
+    capacity = max(1, int(capacity_factor * T / E))
+
+    logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # [T, E]
+    gate = top1_gate(logits, capacity)
+
+    # dispatch: [T, E, C] x [T, D] -> [E, C, D]
+    dispatched = jnp.einsum("tec,td->ecd", gate.dispatch_mask.astype(x.dtype), tokens)
+    # all_to_all over experts: [E, C, D] -> [ep, e_local, C, D] -> gather
+    dispatched = dispatched.reshape(ep, e_local, capacity, D)
+    # [ep, e_local, C, D] -> [e_local, ep, C, D]: device axis swapped for
+    # the per-source axis
+    received = jax.lax.all_to_all(dispatched, axis_name, split_axis=0, concat_axis=1, tiled=False)
+    received = received.reshape(e_local, ep * capacity, D)
+
+    # apply local experts (vmapped over the expert dim)
+    outputs = jax.vmap(expert_fn)(expert_params, received)   # [e_local, ep*C, D]
+
+    outputs = outputs.reshape(e_local, ep, capacity, D)
+    returned = jax.lax.all_to_all(outputs, axis_name, split_axis=1, concat_axis=0, tiled=False)
+    returned = returned.reshape(E, capacity, D)
+
+    combined = jnp.einsum("tec,ecd->td", gate.combine_weights.astype(x.dtype), returned)
+    return combined.reshape(orig_shape), gate.aux_loss
+
+
+def expert_parallel_moe(mesh, x, gate_w, expert_fn, expert_params, capacity_factor=1.25, axis_name="ep"):
+    """shard_map wrapper: x replicated/batch-sharded; expert_params sharded
+    on `ep` along their leading expert dim."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    fn = functools.partial(
+        moe_layer, axis_name=axis_name, capacity_factor=capacity_factor
+    )
+
+    mapped = shard_map(
+        lambda x, gw, ps: fn(x, gw, expert_fn, ps),
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name)),
+        out_specs=(P(), P()),
+        check_rep=False,
+    )
+    return jax.jit(mapped)(x, gate_w, expert_params)

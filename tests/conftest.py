@@ -8,9 +8,22 @@ multi-raylet-on-one-machine plus fake accelerator topology).
 """
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# Must be set before any jax backend is initialized. The machine's axon
+# sitecustomize force-registers the TPU plugin at interpreter start, so
+# the env var alone is not enough — jax.config wins if applied before
+# first backend use.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["RAY_TPU_WORKER_JAX_PLATFORMS"] = "cpu"
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import pytest
 
