@@ -332,16 +332,20 @@ class GcsServer:
         req = dict(spec.get("resources") or {})
         pg_id = spec.get("placement_group_id")
         if pg_id:
+            # PG-scheduled work consumes its bundle's *reservation*, not the
+            # node's free pool (reference: bundle resources become
+            # CPU_group_<pg> resources the task bids on —
+            # placement_group_resource_manager.cc)
             pg = self.placement_groups.get(pg_id)
             if not pg or pg["state"] != "CREATED":
                 return None
             idx = spec.get("bundle_index", -1)
-            candidates = (
-                [pg["bundle_nodes"][idx]] if idx >= 0 else list(dict.fromkeys(pg["bundle_nodes"]))
-            )
-            for node_id in candidates:
+            indices = [idx] if idx >= 0 else list(range(len(pg["bundles"])))
+            for i in indices:
+                node_id = pg["bundle_nodes"][i]
                 node = self.nodes.get(node_id)
-                if node and node["state"] == "ALIVE" and self._resources_fit(node["resources_available"], req):
+                if node and node["state"] == "ALIVE" and self._resources_fit(pg["bundle_available"][i], req):
+                    spec["_bundle_choice"] = i
                     return node_id
             return None
 
@@ -430,11 +434,37 @@ class GcsServer:
                 await self._dispatch(spec, node_id)
             self.pending_tasks.extend(unplaced)
 
+    def _consume_resources(self, spec: Dict[str, Any], node_id: str):
+        req = spec.get("resources") or {}
+        pg = self.placement_groups.get(spec.get("placement_group_id") or "")
+        if pg is not None and "_bundle_choice" in spec:
+            pool = pg["bundle_available"][spec["_bundle_choice"]]
+            for k, v in req.items():
+                pool[k] = pool.get(k, 0.0) - v
+        else:
+            node = self.nodes.get(node_id)
+            if node:
+                for k, v in req.items():
+                    node["resources_available"][k] = node["resources_available"].get(k, 0.0) - v
+
+    def _return_resources(self, spec: Dict[str, Any], node_id: str):
+        req = spec.get("resources") or {}
+        pg = self.placement_groups.get(spec.get("placement_group_id") or "")
+        if pg is not None and "_bundle_choice" in spec and pg["state"] == "CREATED":
+            pool = pg["bundle_available"][spec["_bundle_choice"]]
+            for k, v in req.items():
+                pool[k] = pool.get(k, 0.0) + v
+        elif pg is None and spec.get("placement_group_id"):
+            pass  # PG removed: node pool was already repaid wholesale
+        else:
+            node = self.nodes.get(node_id)
+            if node and node["state"] == "ALIVE":
+                for k, v in req.items():
+                    node["resources_available"][k] = node["resources_available"].get(k, 0.0) + v
+
     async def _dispatch(self, spec: Dict[str, Any], node_id: str):
         node = self.nodes[node_id]
-        req = spec.get("resources") or {}
-        for k, v in req.items():
-            node["resources_available"][k] = node["resources_available"].get(k, 0.0) - v
+        self._consume_resources(spec, node_id)
         task_id = spec["task_id"]
         self.inflight[task_id] = {"spec": spec, "node": node_id, "worker": None}
         self._record_event(spec, "SUBMITTED_TO_WORKER", node_id=node_id)
@@ -452,10 +482,7 @@ class GcsServer:
         rec = self.inflight.pop(task_id, None)
         if rec is None:
             return None
-        node = self.nodes.get(rec["node"])
-        if node and node["state"] == "ALIVE":
-            for k, v in (rec["spec"].get("resources") or {}).items():
-                node["resources_available"][k] = node["resources_available"].get(k, 0.0) + v
+        self._return_resources(rec["spec"], rec["node"])
         self._sched_wakeup.set()
         return rec
 
@@ -573,12 +600,9 @@ class GcsServer:
         if rec is not None:
             spec = rec["spec"]
             if spec.get("hold_resources") and actor is not None:
-                actor["held_resources"] = (rec["node"], dict(spec.get("resources") or {}))
+                actor["held_resources"] = (rec["node"], spec)
             else:
-                node = self.nodes.get(rec["node"])
-                if node and node["state"] == "ALIVE":
-                    for k, v in (spec.get("resources") or {}).items():
-                        node["resources_available"][k] = node["resources_available"].get(k, 0.0) + v
+                self._return_resources(spec, rec["node"])
             self._sched_wakeup.set()
         if actor is None:
             return False
@@ -624,11 +648,8 @@ class GcsServer:
     def _release_actor_held(self, actor):
         held = actor.pop("held_resources", None)
         if held:
-            node_id, res = held
-            node = self.nodes.get(node_id)
-            if node and node["state"] == "ALIVE":
-                for k, v in res.items():
-                    node["resources_available"][k] = node["resources_available"].get(k, 0.0) + v
+            node_id, spec = held
+            self._return_resources(spec, node_id)
             self._sched_wakeup.set()
 
     async def _destroy_actor(self, actor_id: str, reason: str, no_restart: bool = False):
@@ -801,6 +822,7 @@ class GcsServer:
             "strategy": strategy,
             "state": "PENDING",
             "bundle_nodes": [],
+            "bundle_available": [],
             "owner": self.conn_client.get(conn),
             "waiters": [],
             "lifetime": d.get("lifetime"),
@@ -863,12 +885,14 @@ class GcsServer:
                 assignment.append(choice)
                 take(choice, b)
 
-        # commit: deduct from the real resource view
+        # commit: deduct from the real resource view; each bundle becomes
+        # its own allocatable pool
         for node_id, b in zip(assignment, bundles):
             node = self.nodes[node_id]
             for k, v in b.items():
                 node["resources_available"][k] = node["resources_available"].get(k, 0.0) - v
         rec["bundle_nodes"] = assignment
+        rec["bundle_available"] = [dict(b) for b in bundles]
         rec["state"] = "CREATED"
         for fut in rec["waiters"]:
             if not fut.done():

@@ -1,0 +1,8 @@
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.air.session import get_checkpoint, get_context, report  # noqa: F401

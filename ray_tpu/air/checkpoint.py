@@ -1,0 +1,62 @@
+"""Checkpoint abstraction.
+
+Equivalent of the reference's ray.train.Checkpoint
+(reference: python/ray/train/_checkpoint.py — a directory handle on a
+pyarrow filesystem). Here a checkpoint is a directory; orbax handles the
+sharded-array content for jax states (train/_internal/storage.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @staticmethod
+    def from_directory(path: str) -> "Checkpoint":
+        return Checkpoint(path)
+
+    @staticmethod
+    def from_dict(data: Dict[str, Any]) -> "Checkpoint":
+        import cloudpickle
+
+        d = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        with open(os.path.join(d, "data.pkl"), "wb") as f:
+            cloudpickle.dump(data, f)
+        return Checkpoint(d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        import cloudpickle
+
+        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
+            return cloudpickle.load(f)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            return self.path
+        if os.path.abspath(path) != self.path:
+            shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    def update_metadata(self, metadata: Dict[str, Any]):
+        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+            json.dump(metadata, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, ".metadata.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"

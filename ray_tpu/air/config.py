@@ -1,0 +1,90 @@
+"""Shared config dataclasses.
+
+Equivalent of the reference's ray.air configs
+(reference: python/ray/air/config.py — ScalingConfig, RunConfig,
+CheckpointConfig, FailureConfig). ScalingConfig adds the TPU-native
+fields: chips per worker, slice topology, and the parallelism strategy
+(which the reference expresses implicitly via torch DDP/FSDP wrappers).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How training scales over workers and chips.
+
+    num_workers        — host processes (actors) in the gang.
+    use_tpu            — reserve TPU chips for each worker.
+    tpu_chips_per_worker — chips per host actor (v5e/v5p host = 4).
+    topology           — ICI slice topology ("2x2x2") for slice-aware
+                         placement groups.
+    strategy           — parallelism strategy string for
+                         ray_tpu.parallel.sharding ("dp", "fsdp",
+                         "fsdp+tp", "fsdp+tp+sp", ...).
+    mesh               — explicit axis degrees overriding strategy
+                         defaults, e.g. {"fsdp": 4, "tp": 2}.
+    resources_per_worker — extra resources per worker actor.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpu_chips_per_worker: int = 4
+    topology: Optional[str] = None
+    strategy: str = "dp"
+    mesh: Optional[Dict[str, int]] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    # parity shims with the reference surface
+    use_gpu: bool = False
+    trainer_resources: Optional[Dict[str, float]] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res["TPU"] = float(self.tpu_chips_per_worker)
+        return res
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_workers * self.tpu_chips_per_worker if self.use_tpu else 0
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """reference: air/config.py FailureConfig."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """reference: air/config.py CheckpointConfig."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = True
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """reference: air/config.py RunConfig."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 1
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            import os
+
+            self.storage_path = os.path.expanduser("~/ray_tpu_results")
+        if self.failure_config is None:
+            self.failure_config = FailureConfig()
+        if self.checkpoint_config is None:
+            self.checkpoint_config = CheckpointConfig()

@@ -1,0 +1,94 @@
+"""Per-worker training session.
+
+Equivalent of the reference's train session
+(reference: python/ray/train/_internal/session.py — :394 init,
+:654 report, :741 get_checkpoint). `report(metrics, checkpoint=)` ships
+metrics (+ an optional checkpoint directory) from a training worker to
+the trainer's result loop through a distributed queue.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+class _Session:
+    def __init__(self, rank: int, world_size: int, local_rank: int, result_queue, storage_dir: str,
+                 restore_checkpoint: Optional[str] = None):
+        self.rank = rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.result_queue = result_queue
+        self.storage_dir = storage_dir
+        self.restore_checkpoint = restore_checkpoint
+        self.iteration = 0
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        ckpt_path = None
+        if checkpoint is not None and self.rank == 0:
+            dest = os.path.join(self.storage_dir, f"checkpoint_{self.iteration:06d}")
+            if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            ckpt_path = dest
+        self.iteration += 1
+        if self.result_queue is not None:
+            self.result_queue.put(
+                {"rank": self.rank, "metrics": dict(metrics), "checkpoint": ckpt_path,
+                 "iteration": self.iteration}
+            )
+
+
+_local = threading.local()
+
+
+def _set_session(s: Optional[_Session]):
+    _local.session = s
+
+
+def _get_session() -> Optional[_Session]:
+    return getattr(_local, "session", None)
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("train.report() called outside a training worker")
+    s.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = _get_session()
+    if s is None or not s.restore_checkpoint:
+        return None
+    return Checkpoint(s.restore_checkpoint)
+
+
+class TrainContext:
+    def __init__(self, s: _Session):
+        self._s = s
+
+    def get_world_size(self) -> int:
+        return self._s.world_size
+
+    def get_world_rank(self) -> int:
+        return self._s.rank
+
+    def get_local_rank(self) -> int:
+        return self._s.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self._s.world_size  # single-host local == world for now
+
+    def get_node_rank(self) -> int:
+        return self._s.rank
+
+
+def get_context() -> TrainContext:
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("get_context() called outside a training worker")
+    return TrainContext(s)

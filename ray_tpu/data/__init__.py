@@ -1,0 +1,20 @@
+"""ray_tpu.data — distributed datasets (reference: python/ray/data).
+
+Lazy logical plan → block-parallel execution on tasks, Arrow blocks in
+the shared-memory object store, streaming iteration with bounded
+in-flight blocks (reference: data/_internal/execution/streaming_executor.py).
+"""
+from ray_tpu.data.dataset import Dataset  # noqa: F401
+from ray_tpu.data.read_api import (  # noqa: F401
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+    read_numpy,
+    read_binary_files,
+)

@@ -1,0 +1,69 @@
+"""Blocks — the unit of distributed data.
+
+Equivalent of the reference's block layer (reference:
+python/ray/data/block.py + _internal/arrow_block.py): a block is a
+pyarrow Table (tabular), and block metadata travels with the ref.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import pyarrow as pa
+
+
+def to_block(rows: List[Any]) -> pa.Table:
+    """Build an Arrow block from python rows (dicts or scalars)."""
+    if rows and isinstance(rows[0], dict):
+        cols: Dict[str, list] = {}
+        for r in rows:
+            for k in r:
+                cols.setdefault(k, [])
+        for r in rows:
+            for k in cols:
+                cols[k].append(r.get(k))
+        return pa.table(cols)
+    return pa.table({"item": list(rows)})
+
+
+def block_rows(block: pa.Table) -> List[Dict[str, Any]]:
+    return block.to_pylist()
+
+
+def block_size(block: pa.Table) -> int:
+    return block.num_rows
+
+
+def concat_blocks(blocks: List[pa.Table]) -> pa.Table:
+    blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+    return pa.concat_tables(blocks, promote_options="permissive")
+
+
+def slice_block(block: pa.Table, start: int, end: int) -> pa.Table:
+    return block.slice(start, end - start)
+
+
+def block_to_batch(block: pa.Table, batch_format: str):
+    if batch_format == "pyarrow":
+        return block
+    if batch_format == "pandas":
+        return block.to_pandas()
+    if batch_format in ("numpy", "default"):
+        import numpy as np
+
+        return {name: np.asarray(col) for name, col in zip(block.column_names, block.columns)}
+    raise ValueError(f"unknown batch_format {batch_format}")
+
+
+def batch_to_block(batch) -> pa.Table:
+    import numpy as np
+    import pandas as pd
+
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, pd.DataFrame):
+        return pa.Table.from_pandas(batch, preserve_index=False)
+    if isinstance(batch, dict):
+        return pa.table({k: (v if not isinstance(v, np.ndarray) else pa.array(list(v) if v.ndim > 1 else v)) for k, v in batch.items()})
+    if isinstance(batch, list):
+        return to_block(batch)
+    raise TypeError(f"cannot convert batch of type {type(batch)} to a block")

@@ -1,0 +1,264 @@
+"""Dataset — lazy, block-parallel distributed data.
+
+Equivalent of the reference's Dataset (reference:
+python/ray/data/dataset.py:142): transformations append to a logical
+plan; execution fans out per-block tasks; `iter_batches` streams with a
+bounded in-flight window (the role of the pull-based
+StreamingExecutor, reference:
+data/_internal/execution/streaming_executor.py:55 — ours is a windowed
+pipeline over the same task substrate, which on a TPU host's CPU side is
+the data-loading path feeding device_put).
+"""
+from __future__ import annotations
+
+import builtins
+import itertools
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+# remote transforms ---------------------------------------------------------
+
+
+@ray_tpu.remote
+def _apply_ops(blk, ops):
+    """Run a chain of (kind, fn) over one block inside a task."""
+    for kind, fn, kw in ops:
+        if kind == "map_batches":
+            fmt = kw.get("batch_format", "numpy")
+            out = fn(B.block_to_batch(blk, fmt))
+            blk = B.batch_to_block(out)
+        elif kind == "map":
+            blk = B.to_block([fn(r) for r in B.block_rows(blk)])
+        elif kind == "flat_map":
+            rows = []
+            for r in B.block_rows(blk):
+                rows.extend(fn(r))
+            blk = B.to_block(rows)
+        elif kind == "filter":
+            blk = B.to_block([r for r in B.block_rows(blk) if fn(r)])
+        elif kind == "add_column":
+            import pyarrow as pa
+
+            col, cfn = fn
+            vals = cfn(B.block_to_batch(blk, "pandas"))
+            blk = blk.append_column(col, pa.array(list(vals)))
+        elif kind == "drop_columns":
+            blk = blk.drop_columns(fn)
+        elif kind == "select_columns":
+            blk = blk.select(fn)
+        elif kind == "rename_columns":
+            blk = blk.rename_columns([fn.get(c, c) for c in blk.column_names])
+        else:
+            raise ValueError(f"unknown op {kind}")
+    return blk
+
+
+@ray_tpu.remote
+def _sort_block(blk, key, descending):
+    return blk.sort_by([(key, "descending" if descending else "ascending")])
+
+
+@ray_tpu.remote
+def _merge_blocks(*blks):
+    return B.concat_blocks(list(blks))
+
+
+class Dataset:
+    """Lazy dataset over block refs + a pending op chain."""
+
+    def __init__(self, block_refs: List[Any], ops: Optional[List] = None):
+        self._block_refs = block_refs
+        self._ops: List = ops or []
+
+    # ------------------------------------------------------------ transforms
+    def _with_op(self, kind: str, fn, **kw) -> "Dataset":
+        return Dataset(self._block_refs, self._ops + [(kind, fn, kw)])
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        return self._with_op("map", fn)
+
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy", **kw) -> "Dataset":
+        return self._with_op("map_batches", fn, batch_format=batch_format)
+
+    def flat_map(self, fn) -> "Dataset":
+        return self._with_op("flat_map", fn)
+
+    def filter(self, fn) -> "Dataset":
+        return self._with_op("filter", fn)
+
+    def add_column(self, name: str, fn) -> "Dataset":
+        return self._with_op("add_column", (name, fn))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self._with_op("drop_columns", cols)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self._with_op("select_columns", cols)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self._with_op("rename_columns", mapping)
+
+    # ------------------------------------------------------------- execution
+    def _execute_refs(self) -> List[Any]:
+        """Launch per-block pipelines; returns refs of transformed blocks."""
+        if not self._ops:
+            return list(self._block_refs)
+        ops = ray_tpu.put(self._ops)
+        return [_apply_ops.remote(ref, ops) for ref in self._block_refs]
+
+    def materialize(self) -> "Dataset":
+        refs = self._execute_refs()
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=None)
+        return Dataset(refs)
+
+    def blocks(self) -> List[Any]:
+        return self._execute_refs()
+
+    # ------------------------------------------------------------ reshaping
+    def repartition(self, num_blocks: int) -> "Dataset":
+        tbl = B.concat_blocks(ray_tpu.get(self._execute_refs()))
+        n = tbl.num_rows
+        per = max(1, (n + num_blocks - 1) // num_blocks)
+        refs = [ray_tpu.put(tbl.slice(i * per, per)) for i in builtins.range(num_blocks) if i * per < n or i == 0]
+        return Dataset(refs)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        import numpy as np
+
+        tbl = B.concat_blocks(ray_tpu.get(self._execute_refs()))
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(tbl.num_rows)
+        shuffled = tbl.take(idx)
+        nb = max(1, len(self._block_refs))
+        per = max(1, (tbl.num_rows + nb - 1) // nb)
+        refs = [ray_tpu.put(shuffled.slice(i * per, per)) for i in builtins.range(nb) if i * per < tbl.num_rows or i == 0]
+        return Dataset(refs)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        # sort blocks, then merge (single-node round 1; range-partitioned
+        # sort is the reference's approach for scale)
+        refs = [_sort_block.remote(r, key, descending) for r in self._execute_refs()]
+        merged = B.concat_blocks(ray_tpu.get(refs)).sort_by(
+            [(key, "descending" if descending else "ascending")]
+        )
+        return Dataset([ray_tpu.put(merged)])
+
+    def union(self, other: "Dataset") -> "Dataset":
+        return Dataset(self._execute_refs() + other._execute_refs())
+
+    def split(self, n: int) -> List["Dataset"]:
+        refs = self._execute_refs()
+        out = []
+        per = max(1, (len(refs) + n - 1) // n)
+        for i in builtins.range(n):
+            chunk = refs[i * per : (i + 1) * per]
+            out.append(Dataset(chunk if chunk else []))
+        return out
+
+    def groupby(self, key: str):
+        from ray_tpu.data.grouped import GroupedData
+
+        return GroupedData(self, key)
+
+    # ----------------------------------------------------------- consumption
+    def iter_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        batch_format: str = "numpy",
+        prefetch_blocks: int = 2,
+        drop_last: bool = False,
+    ) -> Iterator[Any]:
+        """Streaming iteration: at most `prefetch_blocks` block-pipelines
+        in flight ahead of the consumer."""
+        if not self._block_refs:
+            return
+        ops_ref = ray_tpu.put(self._ops) if self._ops else None
+
+        def launch(ref):
+            return _apply_ops.remote(ref, ops_ref) if ops_ref is not None else ref
+
+        window: List[Any] = []
+        pending = iter(self._block_refs)
+        for _ in builtins.range(prefetch_blocks + 1):
+            nxt = next(pending, None)
+            if nxt is not None:
+                window.append(launch(nxt))
+
+        leftover = None
+        while window:
+            blk = ray_tpu.get(window.pop(0))
+            nxt = next(pending, None)
+            if nxt is not None:
+                window.append(launch(nxt))
+            if leftover is not None and leftover.num_rows > 0:
+                blk = B.concat_blocks([leftover, blk])
+                leftover = None
+            off = 0
+            while off + batch_size <= blk.num_rows:
+                yield B.block_to_batch(blk.slice(off, batch_size), batch_format)
+                off += batch_size
+            leftover = blk.slice(off)
+        if leftover is not None and leftover.num_rows > 0 and not drop_last:
+            yield B.block_to_batch(leftover, batch_format)
+
+    def iter_rows(self) -> Iterator[Dict]:
+        for ref in self._execute_refs():
+            for row in B.block_rows(ray_tpu.get(ref)):
+                yield row
+
+    def take(self, n: int = 20) -> List[Dict]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(B.block_size(ray_tpu.get(r)) for r in self._execute_refs())
+
+    def schema(self):
+        if not self._block_refs:
+            return None
+        return ray_tpu.get(self._execute_refs()[0]).schema
+
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    def show(self, n: int = 20):
+        for row in self.take(n):
+            print(row)
+
+    # ------------------------------------------------------------- exports
+    def to_pandas(self):
+        return B.concat_blocks(ray_tpu.get(self._execute_refs())).to_pandas()
+
+    def to_arrow(self):
+        return B.concat_blocks(ray_tpu.get(self._execute_refs()))
+
+    def write_parquet(self, path: str):
+        import os
+
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute_refs()):
+            pq.write_table(ray_tpu.get(ref), os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str):
+        import os
+
+        import pyarrow.csv as pcsv
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute_refs()):
+            pcsv.write_csv(ray_tpu.get(ref), os.path.join(path, f"part-{i:05d}.csv"))
+
+    def __repr__(self):
+        return f"Dataset(num_blocks={len(self._block_refs)}, ops={len(self._ops)})"

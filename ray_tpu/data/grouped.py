@@ -1,0 +1,58 @@
+"""GroupedData — groupby aggregations (reference: python/ray/data/grouped_data.py)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+
+class GroupedData:
+    def __init__(self, ds, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _table(self) -> pa.Table:
+        return B.concat_blocks(ray_tpu.get(self._ds._execute_refs()))
+
+    def _agg(self, agg: str, on: str):
+        from ray_tpu.data.dataset import Dataset
+
+        tbl = self._table()
+        out = tbl.group_by(self._key).aggregate([(on, agg)])
+        return Dataset([ray_tpu.put(out)])
+
+    def count(self):
+        from ray_tpu.data.dataset import Dataset
+
+        tbl = self._table()
+        out = tbl.group_by(self._key).aggregate([(self._key, "count")])
+        return Dataset([ray_tpu.put(out)])
+
+    def sum(self, on: str):
+        return self._agg("sum", on)
+
+    def mean(self, on: str):
+        return self._agg("mean", on)
+
+    def min(self, on: str):
+        return self._agg("min", on)
+
+    def max(self, on: str):
+        return self._agg("max", on)
+
+    def map_groups(self, fn: Callable):
+        from ray_tpu.data.dataset import Dataset
+
+        tbl = self._table()
+        keys = tbl.column(self._key).unique().to_pylist()
+        rows: List[Dict] = []
+        import pyarrow.compute as pc
+
+        for k in keys:
+            sub = tbl.filter(pc.equal(tbl.column(self._key), k))
+            result = fn(sub.to_pylist())
+            rows.extend(result if isinstance(result, list) else [result])
+        return Dataset([ray_tpu.put(B.to_block(rows))])

@@ -1,0 +1,125 @@
+"""Dataset creation (reference: python/ray/data/read_api.py:279
+read_datasource + the from_*/read_* family)."""
+from __future__ import annotations
+
+import builtins
+import glob as globlib
+import os
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.data import block as B
+from ray_tpu.data.dataset import Dataset
+
+
+def _partition(items: List[Any], parallelism: int) -> List[List[Any]]:
+    n = max(1, min(parallelism, len(items) or 1))
+    per = (len(items) + n - 1) // n
+    return [items[i * per : (i + 1) * per] for i in builtins.range(n) if items[i * per : (i + 1) * per]] or [[]]
+
+
+def from_items(items: List[Any], parallelism: int = 8) -> Dataset:
+    parts = _partition(list(items), parallelism)
+    return Dataset([ray_tpu.put(B.to_block(p)) for p in parts])
+
+
+def range(n: int, parallelism: int = 8) -> Dataset:
+    return from_items([{"id": i} for i in builtins.range(n)], parallelism)
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    return Dataset([ray_tpu.put(pa.Table.from_pandas(df, preserve_index=False))])
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset([ray_tpu.put(table)])
+
+
+def from_numpy(arr) -> Dataset:
+    import pyarrow as pa
+
+    return Dataset([ray_tpu.put(pa.table({"data": list(arr)}))])
+
+
+def _expand(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(globlib.glob(os.path.join(p, "*"))))
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+@ray_tpu.remote
+def _read_parquet(path):
+    import pyarrow.parquet as pq
+
+    return pq.read_table(path)
+
+
+@ray_tpu.remote
+def _read_csv(path):
+    import pyarrow.csv as pcsv
+
+    return pcsv.read_csv(path)
+
+
+@ray_tpu.remote
+def _read_json(path):
+    import pyarrow.json as pjson
+
+    return pjson.read_json(path)
+
+
+@ray_tpu.remote
+def _read_text(path):
+    with open(path) as f:
+        lines = [l.rstrip("\n") for l in f]
+    return B.to_block([{"text": l} for l in lines])
+
+
+@ray_tpu.remote
+def _read_numpy(path):
+    import numpy as np
+    import pyarrow as pa
+
+    arr = np.load(path)
+    return pa.table({"data": list(arr)})
+
+
+@ray_tpu.remote
+def _read_binary(path):
+    with open(path, "rb") as f:
+        data = f.read()
+    return B.to_block([{"bytes": data, "path": path}])
+
+
+def read_parquet(paths, **kw) -> Dataset:
+    return Dataset([_read_parquet.remote(p) for p in _expand(paths)])
+
+
+def read_csv(paths, **kw) -> Dataset:
+    return Dataset([_read_csv.remote(p) for p in _expand(paths)])
+
+
+def read_json(paths, **kw) -> Dataset:
+    return Dataset([_read_json.remote(p) for p in _expand(paths)])
+
+
+def read_text(paths, **kw) -> Dataset:
+    return Dataset([_read_text.remote(p) for p in _expand(paths)])
+
+
+def read_numpy(paths, **kw) -> Dataset:
+    return Dataset([_read_numpy.remote(p) for p in _expand(paths)])
+
+
+def read_binary_files(paths, **kw) -> Dataset:
+    return Dataset([_read_binary.remote(p) for p in _expand(paths)])

@@ -1,0 +1,11 @@
+"""ray_tpu.rllib — reinforcement learning (reference: rllib/).
+
+New-stack architecture only (reference: RLModule/Learner/EnvRunner —
+rllib/core/rl_module/rl_module.py:237, core/learner/learner.py:105,
+env/env_runner.py:15); the torch DDP learner wrap
+(core/learner/torch/torch_learner.py:384) becomes a jax learner whose
+multi-learner gradient reduction is an ICI psum under pjit (or the host
+collective veneer across processes).
+"""
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.core.rl_module import RLModule  # noqa: F401

@@ -1,0 +1,64 @@
+"""RLModule — the neural policy/value container.
+
+Equivalent of the reference's RLModule
+(reference: rllib/core/rl_module/rl_module.py:237). Jax-native: params
+are a pytree, forward passes are pure functions — so the same module
+runs in env-runners (CPU hosts, forward_exploration) and learners (TPU,
+forward_train) without framework wrappers.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RLModule:
+    """Interface: subclasses define init_params / forward."""
+
+    def init_params(self, rng) -> Any:
+        raise NotImplementedError
+
+    def forward(self, params, obs) -> Dict[str, jnp.ndarray]:
+        """Returns {"logits": ..., "vf": ...}."""
+        raise NotImplementedError
+
+
+class DiscreteMLPModule(RLModule):
+    """MLP torso with categorical policy + value heads (the default
+    CartPole-class module; reference analogue: catalog default MLP)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden=(64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = hidden
+
+    def init_params(self, rng):
+        sizes = (self.obs_dim,) + tuple(self.hidden)
+        keys = jax.random.split(rng, len(sizes) + 2)
+        params = {"layers": []}
+        for i in range(len(sizes) - 1):
+            params["layers"].append(
+                {
+                    "w": jax.random.normal(keys[i], (sizes[i], sizes[i + 1])) * (2.0 / sizes[i]) ** 0.5,
+                    "b": jnp.zeros((sizes[i + 1],)),
+                }
+            )
+        params["pi"] = {
+            "w": jax.random.normal(keys[-2], (sizes[-1], self.num_actions)) * 0.01,
+            "b": jnp.zeros((self.num_actions,)),
+        }
+        params["vf"] = {
+            "w": jax.random.normal(keys[-1], (sizes[-1], 1)) * 1.0,
+            "b": jnp.zeros((1,)),
+        }
+        return params
+
+    def forward(self, params, obs):
+        x = obs
+        for layer in params["layers"]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        vf = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return {"logits": logits, "vf": vf}

@@ -1,0 +1,21 @@
+"""ray_tpu.serve — model serving (reference: python/ray/serve).
+
+A singleton controller actor reconciles declarative deployments into
+replica actors (reference: serve/_private/controller.py:91); handles
+route requests with power-of-two-choices over replica queue depths
+(reference: _private/replica_scheduler/pow_2_scheduler.py); an aiohttp
+proxy actor exposes HTTP routes (reference: _private/proxy.py —
+FastAPI/uvicorn there, aiohttp here since that's what the image ships).
+TPU replicas are actors with num_tpus chips running jitted inference.
+"""
+from ray_tpu.serve.api import (  # noqa: F401
+    batch,
+    delete,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401

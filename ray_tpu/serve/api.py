@@ -1,0 +1,202 @@
+"""Serve public API.
+
+Equivalent of the reference's serve.api (reference: serve/api.py:439
+serve.run; @serve.deployment decorator; serve/batching.py @serve.batch).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeControllerActor
+from ray_tpu.serve.handle import DeploymentHandle
+
+_controller_lock = threading.Lock()
+
+
+def _get_controller(create: bool = False):
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        if not create:
+            raise RuntimeError("serve is not running (no controller)")
+    with _controller_lock:
+        try:
+            return ray_tpu.get_actor(CONTROLLER_NAME)
+        except ValueError:
+            ServeControllerActor.options(name=CONTROLLER_NAME, lifetime="detached", num_cpus=0).remote()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    return ray_tpu.get_actor(CONTROLLER_NAME)
+                except ValueError:
+                    time.sleep(0.1)
+            raise RuntimeError("serve controller failed to start")
+
+
+class Application:
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+class Deployment:
+    def __init__(
+        self,
+        cls_or_fn,
+        name: Optional[str] = None,
+        num_replicas: int = 1,
+        route_prefix: Optional[str] = None,
+        ray_actor_options: Optional[dict] = None,
+        max_ongoing_requests: int = 16,
+    ):
+        self._callable = cls_or_fn
+        self.name = name or getattr(cls_or_fn, "__name__", "deployment")
+        self.num_replicas = num_replicas
+        self.route_prefix = route_prefix
+        self.ray_actor_options = ray_actor_options or {}
+        self.max_ongoing_requests = max_ongoing_requests
+
+    def options(self, **kw) -> "Deployment":
+        merged = dict(
+            name=self.name,
+            num_replicas=self.num_replicas,
+            route_prefix=self.route_prefix,
+            ray_actor_options=self.ray_actor_options,
+            max_ongoing_requests=self.max_ongoing_requests,
+        )
+        merged.update(kw)
+        return Deployment(self._callable, **merged)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+
+def deployment(_cls=None, **kwargs):
+    """@serve.deployment decorator."""
+
+    def wrap(cls):
+        return Deployment(cls, **kwargs)
+
+    if _cls is not None:
+        return wrap(_cls)
+    return wrap
+
+
+def run(app: Application, *, name: str = "default", route_prefix: Optional[str] = "/") -> DeploymentHandle:
+    """Deploy an application (reference: serve/api.py:439)."""
+    import cloudpickle
+
+    controller = _get_controller(create=True)
+    dep = app.deployment
+    prefix = dep.route_prefix if dep.route_prefix is not None else route_prefix
+    ray_tpu.get(
+        controller.deploy.remote(
+            name,
+            dep.name,
+            cloudpickle.dumps(dep._callable),
+            app.init_args,
+            app.init_kwargs,
+            dep.num_replicas,
+            prefix,
+            dep.ray_actor_options,
+        )
+    )
+    handle = DeploymentHandle(dep.name, name)
+    handle._refresh()
+    return handle
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    handle = DeploymentHandle(deployment_name, app_name)
+    handle._refresh()
+    return handle
+
+
+def get_app_handle(app_name: str = "default") -> DeploymentHandle:
+    controller = _get_controller()
+    st = ray_tpu.get(controller.status.remote())
+    deps = list(st.get(app_name, {}))
+    if not deps:
+        raise ValueError(f"no app {app_name}")
+    return get_deployment_handle(deps[-1], app_name)
+
+
+def delete(app_name: str = "default"):
+    controller = _get_controller()
+    ray_tpu.get(controller.delete_app.remote(app_name))
+
+
+def status() -> Dict[str, Any]:
+    controller = _get_controller()
+    return ray_tpu.get(controller.status.remote())
+
+
+def shutdown():
+    try:
+        controller = _get_controller()
+    except RuntimeError:
+        return
+    st = ray_tpu.get(controller.status.remote())
+    for app_name in list(st):
+        ray_tpu.get(controller.delete_app.remote(app_name))
+    ray_tpu.kill(controller)
+
+
+# --------------------------------------------------------------- batching
+def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
+    """@serve.batch — coalesce concurrent calls into one batched call
+    (reference: python/ray/serve/batching.py)."""
+
+    def deco(fn):
+        lock = threading.Lock()
+        pending: List = []  # (args_item, event, out)
+
+        @functools.wraps(fn)
+        def wrapper(self_or_item, *rest):
+            # method form: (self, item); function form: (item,)
+            if rest:
+                owner, item = self_or_item, rest[0]
+            else:
+                owner, item = None, self_or_item
+            ev = threading.Event()
+            slot: Dict[str, Any] = {}
+            with lock:
+                pending.append((item, ev, slot))
+                leader = len(pending) == 1
+            if leader:
+                while True:
+                    time.sleep(batch_wait_timeout_s)
+                    with lock:
+                        batch_items = pending[:max_batch_size]
+                        del pending[: len(batch_items)]
+                    if not batch_items:
+                        break
+                    items = [b[0] for b in batch_items]
+                    try:
+                        results = fn(owner, items) if owner is not None else fn(items)
+                        for (_, e, s), r in zip(batch_items, results):
+                            s["result"] = r
+                            e.set()
+                    except Exception as exc:
+                        for _, e, s in batch_items:
+                            s["error"] = exc
+                            e.set()
+                    with lock:
+                        if not pending:
+                            break
+            if not ev.wait(timeout=30):
+                raise TimeoutError("batched call timed out")
+            if "error" in slot:
+                raise slot["error"]
+            return slot["result"]
+
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

@@ -1,0 +1,128 @@
+"""ServeController — the reconciling control loop.
+
+Equivalent of the reference's ServeController + DeploymentState
+(reference: serve/_private/controller.py:91, deployment_state.py —
+declarative target state → replica actors started/stopped to match).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+@ray_tpu.remote(max_concurrency=16)
+class Replica:
+    """Wraps one instance of the user's deployment class
+    (reference: serve/_private/replica.py)."""
+
+    def __init__(self, cls_or_fn, init_args, init_kwargs):
+        import inspect
+
+        if inspect.isclass(cls_or_fn):
+            self.instance = cls_or_fn(*init_args, **init_kwargs)
+        else:
+            self.instance = cls_or_fn
+        self.num_requests = 0
+
+    def handle_request(self, method: str, args, kwargs):
+        self.num_requests += 1
+        fn = self.instance if method == "__call__" else getattr(self.instance, method)
+        result = fn(*args, **kwargs)
+        import inspect
+
+        if inspect.iscoroutine(result):
+            import asyncio
+
+            result = asyncio.run(result)
+        return result
+
+    def health(self):
+        return True
+
+    def stats(self):
+        return {"num_requests": self.num_requests}
+
+
+@ray_tpu.remote
+class ServeControllerActor:
+    def __init__(self):
+        # app -> deployment -> record
+        self.apps: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        self.routes: Dict[str, tuple] = {}  # route_prefix -> (app, deployment)
+        self._counter = 0
+
+    def deploy(
+        self,
+        app_name: str,
+        deployment_name: str,
+        cls_blob: bytes,
+        init_args: tuple,
+        init_kwargs: dict,
+        num_replicas: int,
+        route_prefix: Optional[str],
+        ray_actor_options: Optional[dict] = None,
+    ):
+        import cloudpickle
+
+        cls = cloudpickle.loads(cls_blob)
+        app = self.apps.setdefault(app_name, {})
+        old = app.get(deployment_name)
+        if old:
+            for name in old["replicas"]:
+                try:
+                    ray_tpu.kill(ray_tpu.get_actor(name))
+                except Exception:
+                    pass
+        replicas = []
+        opts = dict(ray_actor_options or {})
+        for i in range(num_replicas):
+            self._counter += 1
+            name = f"SERVE_REPLICA::{app_name}::{deployment_name}::{self._counter}"
+            Replica.options(name=name, max_concurrency=16, **opts).remote(cls, init_args, init_kwargs)
+            replicas.append(name)
+        # wait for readiness
+        for name in replicas:
+            h = ray_tpu.get_actor(name)
+            ray_tpu.get(h.health.remote())
+        app[deployment_name] = {
+            "replicas": replicas,
+            "num_replicas": num_replicas,
+            "route_prefix": route_prefix,
+            "deploy_time": time.time(),
+        }
+        if route_prefix:
+            self.routes[route_prefix] = (app_name, deployment_name)
+        return True
+
+    def get_replicas(self, app_name: str, deployment_name: str) -> List[str]:
+        return self.apps.get(app_name, {}).get(deployment_name, {}).get("replicas", [])
+
+    def get_routes(self) -> Dict[str, tuple]:
+        return dict(self.routes)
+
+    def delete_app(self, app_name: str):
+        app = self.apps.pop(app_name, None)
+        if not app:
+            return False
+        for dep in app.values():
+            for name in dep["replicas"]:
+                try:
+                    ray_tpu.kill(ray_tpu.get_actor(name))
+                except Exception:
+                    pass
+            if dep.get("route_prefix"):
+                self.routes.pop(dep["route_prefix"], None)
+        return True
+
+    def status(self) -> Dict[str, Any]:
+        out = {}
+        for app_name, deps in self.apps.items():
+            out[app_name] = {
+                name: {"num_replicas": d["num_replicas"], "route_prefix": d["route_prefix"]}
+                for name, d in deps.items()
+            }
+        return out

@@ -1,0 +1,93 @@
+"""DeploymentHandle — client-side router.
+
+Equivalent of the reference's handle + router
+(reference: serve/handle.py DeploymentHandle; routing policy
+serve/_private/replica_scheduler/pow_2_scheduler.py:44 — pick two random
+replicas, send to the one with fewer outstanding requests).
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like response (reference: serve/handle.py DeploymentResponse)."""
+
+    def __init__(self, ref, on_done=None):
+        self._ref = ref
+        self._on_done = on_done
+
+    def result(self, timeout: Optional[float] = None):
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            if self._on_done:
+                self._on_done()
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._replicas: List[Any] = []
+        self._outstanding: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._method = "__call__"
+
+    # -- replica set management ----------------------------------------
+    def _refresh(self):
+        from ray_tpu.serve.api import _get_controller
+
+        controller = _get_controller()
+        infos = ray_tpu.get(controller.get_replicas.remote(self.app_name, self.deployment_name))
+        with self._lock:
+            self._replicas = [ray_tpu.get_actor(name) for name in infos]
+            self._outstanding = {i: 0 for i in range(len(self._replicas))}
+
+    def options(self, method_name: str = "__call__", **_):
+        h = DeploymentHandle(self.deployment_name, self.app_name)
+        h._method = method_name
+        with self._lock:
+            h._replicas = list(self._replicas)
+            h._outstanding = dict(self._outstanding)
+        return h
+
+    # -- routing --------------------------------------------------------
+    def _pick(self) -> int:
+        """Power of two choices on outstanding counts."""
+        n = len(self._replicas)
+        if n == 1:
+            return 0
+        a, b = random.sample(range(n), 2)
+        return a if self._outstanding.get(a, 0) <= self._outstanding.get(b, 0) else b
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        if not self._replicas:
+            self._refresh()
+        if not self._replicas:
+            raise RuntimeError(f"no replicas for {self.deployment_name}")
+        with self._lock:
+            idx = self._pick()
+            self._outstanding[idx] = self._outstanding.get(idx, 0) + 1
+        replica = self._replicas[idx]
+
+        def done():
+            with self._lock:
+                self._outstanding[idx] = max(0, self._outstanding.get(idx, 1) - 1)
+
+        try:
+            ref = replica.handle_request.remote(self._method, args, kwargs)
+        except Exception:
+            done()
+            self._refresh()
+            replica = self._replicas[self._pick()]
+            ref = replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref, on_done=done)

@@ -1,0 +1,93 @@
+"""HTTP proxy actor.
+
+Equivalent of the reference's ProxyActor (reference:
+serve/_private/proxy.py:759 HTTP side): routes `route_prefix` → app
+handle, JSON bodies in/out. aiohttp (uvicorn/FastAPI not in this image).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Dict, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote(num_cpus=0)
+class ProxyActor:
+    def __init__(self, port: int = 8000):
+        self.port = port
+        self.routes: Dict[str, tuple] = {}
+        self._handles = {}
+        self._runner = None
+        asyncio.get_event_loop().create_task(self._start())
+
+    async def _start(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "0.0.0.0", self.port)
+        await site.start()
+
+    async def _refresh_routes(self):
+        from ray_tpu.serve.api import _get_controller
+
+        controller = _get_controller()
+        self.routes = ray_tpu.get(controller.get_routes.remote())
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        path = "/" + request.match_info["tail"]
+        route = None
+        for prefix in sorted(self.routes, key=len, reverse=True):
+            if path.startswith(prefix):
+                route = self.routes[prefix]
+                break
+        if route is None:
+            await self._refresh_routes()
+            for prefix in sorted(self.routes, key=len, reverse=True):
+                if path.startswith(prefix):
+                    route = self.routes[prefix]
+                    break
+        if route is None:
+            return web.json_response({"error": f"no route for {path}"}, status=404)
+        app_name, dep_name = route
+        key = (app_name, dep_name)
+        handle = self._handles.get(key)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            handle = DeploymentHandle(dep_name, app_name)
+            handle._refresh()
+            self._handles[key] = handle
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except json.JSONDecodeError:
+            body = {"raw": await request.text()}
+        try:
+            resp = handle.remote(body)
+            loop = asyncio.get_running_loop()
+            result = await loop.run_in_executor(None, resp.result, 60)
+            if isinstance(result, (dict, list, str, int, float, bool, type(None))):
+                return web.json_response({"result": result})
+            return web.json_response({"result": str(result)})
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+
+    def ready(self):
+        return self.port
+
+
+def start_proxy(port: int = 8000):
+    """Start (or return) the HTTP proxy actor."""
+    name = "SERVE_PROXY"
+    try:
+        return ray_tpu.get_actor(name)
+    except ValueError:
+        proxy = ProxyActor.options(name=name, lifetime="detached").remote(port)
+        ray_tpu.get(proxy.ready.remote())
+        return proxy

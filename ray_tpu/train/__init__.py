@@ -1,0 +1,10 @@
+"""ray_tpu.train — distributed training (reference: python/ray/train)."""
+from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.session import get_checkpoint, get_context, report  # noqa: F401
+from ray_tpu.train.jax_trainer import DataParallelTrainer, JaxTrainer, Result  # noqa: F401

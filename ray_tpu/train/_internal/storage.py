@@ -1,0 +1,56 @@
+"""Checkpoint storage: orbax for sharded jax state + run directories.
+
+Equivalent of the reference's StorageContext
+(reference: python/ray/train/_internal/storage.py — 680 LoC pyarrow-fs
+layer). Here local/NFS paths are handled directly and jax pytrees go
+through orbax (which itself speaks tensorstore for sharded arrays on
+real slices).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+def make_run_dir(storage_path: str, name: Optional[str]) -> str:
+    run_name = name or f"run_{time.strftime('%Y%m%d-%H%M%S')}"
+    path = os.path.join(os.path.expanduser(storage_path), run_name)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def save_jax_state(path: str, state: Any) -> str:
+    """Save a jax pytree (params/opt state) with orbax."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "state"), state, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def load_jax_state(path: str, target: Any) -> Any:
+    """Restore into the structure/shardings of `target`."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.join(os.path.abspath(path), "state"), target)
+
+
+def latest_checkpoint(run_dir: str) -> Optional[str]:
+    if not os.path.isdir(run_dir):
+        return None
+    ckpts = sorted(d for d in os.listdir(run_dir) if d.startswith("checkpoint_"))
+    return os.path.join(run_dir, ckpts[-1]) if ckpts else None
+
+
+def prune_checkpoints(run_dir: str, num_to_keep: Optional[int]):
+    if not num_to_keep:
+        return
+    import shutil
+
+    ckpts = sorted(d for d in os.listdir(run_dir) if d.startswith("checkpoint_"))
+    for d in ckpts[:-num_to_keep]:
+        shutil.rmtree(os.path.join(run_dir, d), ignore_errors=True)

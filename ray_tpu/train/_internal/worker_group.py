@@ -1,0 +1,96 @@
+"""Training worker group — the gang of host actors.
+
+Equivalent of the reference's WorkerGroup
+(reference: python/ray/train/_internal/worker_group.py:102). Each worker
+is an actor pinned to a placement-group bundle; on TPU pods one worker
+per host owns that host's chips.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.util.placement_group import PlacementGroup, placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """Hosts one rank of the training gang."""
+
+    def __init__(self, rank: int, world_size: int, env: Optional[Dict[str, str]] = None):
+        self.rank = rank
+        self.world_size = world_size
+        for k, v in (env or {}).items():
+            os.environ[k] = v
+
+    def setup_session(self, result_queue, storage_dir: str, restore_checkpoint: Optional[str]):
+        from ray_tpu.air.session import _Session, _set_session
+
+        self._session = _Session(
+            rank=self.rank,
+            world_size=self.world_size,
+            local_rank=self.rank,
+            result_queue=result_queue,
+            storage_dir=storage_dir,
+            restore_checkpoint=restore_checkpoint,
+        )
+        _set_session(self._session)
+        return True
+
+    def run(self, fn: Callable, config: Optional[Dict[str, Any]] = None):
+        from ray_tpu.air.session import _set_session
+
+        _set_session(self._session)
+        import inspect
+
+        if config is not None or len(inspect.signature(fn).parameters) >= 1:
+            return fn(config or {})
+        return fn()
+
+    def ping(self):
+        return self.rank
+
+    def node_id(self):
+        return ray_tpu.get_runtime_context().node_id
+
+
+class WorkerGroup:
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Dict[str, float],
+        placement_strategy: str = "PACK",
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self.num_workers = num_workers
+        bundles = [dict(resources_per_worker) for _ in range(num_workers)]
+        self.pg: PlacementGroup = placement_group(bundles, strategy=placement_strategy)
+        if not self.pg.wait(120):
+            remove_placement_group(self.pg)
+            raise RuntimeError(
+                f"could not reserve {num_workers} x {resources_per_worker} "
+                f"(cluster resources: {ray_tpu.cluster_resources()})"
+            )
+        self.workers = [
+            TrainWorker.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(self.pg, placement_group_bundle_index=i),
+                num_cpus=resources_per_worker.get("CPU", 1),
+                num_tpus=resources_per_worker.get("TPU"),
+                max_restarts=0,
+            ).remote(i, num_workers, env)
+            for i in range(num_workers)
+        ]
+        ray_tpu.get([w.ping.remote() for w in self.workers])
+
+    def run_all(self, fn: Callable, config: Optional[Dict[str, Any]] = None) -> List[Any]:
+        return [w.run.remote(fn, config) for w in self.workers]
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        remove_placement_group(self.pg)

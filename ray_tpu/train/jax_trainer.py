@@ -1,0 +1,173 @@
+"""JaxTrainer — distributed training over a TPU worker gang.
+
+The replacement for the reference's TorchTrainer stack
+(reference: TorchTrainer at python/ray/train/torch/torch_trainer.py:208;
+DataParallelTrainer at train/data_parallel_trainer.py; BackendExecutor at
+train/_internal/backend_executor.py:65 — placement group :200,
+start_training :438; NCCL process-group setup at train/torch/config.py:47-99).
+
+What changes TPU-side:
+  - No process groups / NCCL: each worker is a host actor owning its
+    chips; multi-host SPMD is initialized with jax.distributed via
+    GCS-KV rendezvous (ray_tpu.parallel.initialize_multihost) and all
+    collectives are XLA ICI ops from sharding annotations.
+  - The gang is a placement group whose bundles map to pod-slice hosts
+    (ScalingConfig.topology → tpu_slice_bundles).
+  - Failure handling follows the reference's semantics: any worker
+    failure tears down the gang and retries from the last checkpoint up
+    to FailureConfig.max_failures.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.air.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.train._internal import storage
+from ray_tpu.train._internal.worker_group import WorkerGroup
+from ray_tpu.util.queue import Queue
+
+logger = logging.getLogger("ray_tpu.train")
+
+
+class Result:
+    """reference: python/ray/air/result.py."""
+
+    def __init__(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint], path: str, error=None):
+        self.metrics = metrics
+        self.checkpoint = checkpoint
+        self.path = path
+        self.error = error
+
+    def __repr__(self):
+        return f"Result(metrics={self.metrics}, checkpoint={self.checkpoint}, error={self.error})"
+
+
+class JaxTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self._train_loop = train_loop_per_worker
+        self._config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self._datasets = datasets or {}
+        self._resume = resume_from_checkpoint
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> Result:
+        run_dir = storage.make_run_dir(self.run_config.storage_path, self.run_config.name)
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        restore = self._resume.path if self._resume else None
+        while True:
+            try:
+                return self._fit_once(run_dir, restore)
+            except Exception as e:
+                attempt += 1
+                if attempt > max_failures >= 0:
+                    if max_failures == 0:
+                        raise
+                    logger.exception("training failed after %d retries", attempt - 1)
+                    last = storage.latest_checkpoint(run_dir)
+                    return Result(
+                        metrics={},
+                        checkpoint=Checkpoint(last) if last else None,
+                        path=run_dir,
+                        error=e,
+                    )
+                restore = storage.latest_checkpoint(run_dir) or restore
+                logger.warning(
+                    "worker gang failed (%s); retry %d/%d from %s", e, attempt, max_failures, restore
+                )
+
+    def _fit_once(self, run_dir: str, restore: Optional[str]) -> Result:
+        sc = self.scaling_config
+        cc: CheckpointConfig = self.run_config.checkpoint_config
+        results_q = Queue()
+        env = {}
+        if sc.use_tpu:
+            env["RAY_TPU_TRAIN_STRATEGY"] = sc.strategy
+        group = WorkerGroup(
+            num_workers=sc.num_workers,
+            resources_per_worker=sc.worker_resources(),
+            placement_strategy=sc.placement_strategy,
+            env=env,
+        )
+        try:
+            ray_tpu.get(
+                [
+                    w.setup_session.remote(results_q, run_dir, restore)
+                    for w in group.workers
+                ]
+            )
+            config = dict(self._config)
+            if self._datasets:
+                config["datasets"] = self._datasets
+            done_refs = group.run_all(self._train_loop, config)
+
+            last_metrics: Dict[str, Any] = {}
+            last_ckpt: Optional[str] = None
+            pending = list(done_refs)
+            while pending:
+                ready, pending = ray_tpu.wait(pending, num_returns=len(pending), timeout=0.25)
+                if ready:
+                    # surface worker exceptions
+                    ray_tpu.get(ready)
+                while True:
+                    try:
+                        item = results_q.get(block=False)
+                    except Exception:
+                        break
+                    if item["rank"] == 0:
+                        last_metrics = item["metrics"]
+                        if item.get("checkpoint"):
+                            last_ckpt = item["checkpoint"]
+                            storage.prune_checkpoints(run_dir, cc.num_to_keep)
+            # drain any remaining reports
+            while True:
+                try:
+                    item = results_q.get(block=False)
+                except Exception:
+                    break
+                if item["rank"] == 0:
+                    last_metrics = item["metrics"]
+                    if item.get("checkpoint"):
+                        last_ckpt = item["checkpoint"]
+                        storage.prune_checkpoints(run_dir, cc.num_to_keep)
+            ckpt = Checkpoint(last_ckpt) if last_ckpt else None
+            return Result(metrics=last_metrics, checkpoint=ckpt, path=run_dir)
+        finally:
+            try:
+                results_q.shutdown()
+            except Exception:
+                pass
+            group.shutdown()
+
+    @classmethod
+    def restore(cls, path: str, train_loop_per_worker: Callable, **kwargs) -> "JaxTrainer":
+        """reference: BaseTrainer.restore (train/base_trainer.py:218)."""
+        last = storage.latest_checkpoint(path)
+        trainer = cls(train_loop_per_worker, **kwargs)
+        if last:
+            trainer._resume = Checkpoint(last)
+        if trainer.run_config.name is None:
+            import os
+
+            trainer.run_config.name = os.path.basename(path)
+            trainer.run_config.storage_path = os.path.dirname(path)
+        return trainer
+
+
+class DataParallelTrainer(JaxTrainer):
+    """Parity alias (reference: train/data_parallel_trainer.py)."""

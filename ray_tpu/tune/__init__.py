@@ -1,0 +1,18 @@
+"""ray_tpu.tune — hyperparameter search (reference: python/ray/tune).
+
+Trials run as actors driven by an event loop in the Tuner (reference:
+TuneController, tune/execution/tune_controller.py:72); searchers produce
+configs, schedulers (ASHA/median) stop poor trials early.
+"""
+from ray_tpu.air.session import report  # noqa: F401  (tune.report == train.report)
+from ray_tpu.tune.search import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    qrandint,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner  # noqa: F401
+from ray_tpu.tune import schedulers  # noqa: F401

@@ -1,0 +1,108 @@
+"""Trial schedulers: FIFO, ASHA, Median stopping, HyperBand-lite.
+
+Equivalent of the reference's tune.schedulers
+(reference: python/ray/tune/schedulers/async_hyperband.py ASHA,
+median_stopping_rule.py, hyperband.py). Decisions are made per reported
+result: CONTINUE or STOP.
+"""
+from __future__ import annotations
+
+import collections
+import math
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+    def on_complete(self, trial_id: str):
+        pass
+
+
+class AsyncHyperBandScheduler(FIFOScheduler):
+    """ASHA: promote the top 1/reduction_factor at each rung; stop the rest
+    (reference: tune/schedulers/async_hyperband.py)."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 3.0,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung thresholds: grace, grace*rf, grace*rf^2, ...
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(int(t))
+            t *= reduction_factor
+        self.rung_records: Dict[int, List[float]] = collections.defaultdict(list)
+
+    def _better(self, a: float, cutoff: float) -> bool:
+        return a <= cutoff if self.mode == "min" else a >= cutoff
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        for rung in self.rungs:
+            if t == rung:
+                records = self.rung_records[rung]
+                records.append(float(value))
+                if len(records) >= max(2, int(self.rf)):
+                    ordered = sorted(records, reverse=(self.mode == "max"))
+                    k = max(1, int(len(ordered) / self.rf))
+                    cutoff = ordered[k - 1]
+                    if not self._better(float(value), cutoff):
+                        return STOP
+        if t >= self.max_t:
+            return STOP
+        return CONTINUE
+
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class MedianStoppingRule(FIFOScheduler):
+    """Stop trials below the median of running averages
+    (reference: tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration", grace_period: int = 1,
+                 min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.history: Dict[str, List[float]] = collections.defaultdict(list)
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        value = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if value is None:
+            return CONTINUE
+        self.history[trial_id].append(float(value))
+        if t < self.grace_period or len(self.history) < self.min_samples:
+            return CONTINUE
+        avgs = {tid: sum(v) / len(v) for tid, v in self.history.items() if v}
+        others = [v for tid, v in avgs.items() if tid != trial_id]
+        if not others:
+            return CONTINUE
+        med = sorted(others)[len(others) // 2]
+        mine = avgs[trial_id]
+        worse = mine > med if self.mode == "min" else mine < med
+        return STOP if worse else CONTINUE

@@ -1,0 +1,150 @@
+"""Search spaces + basic searchers.
+
+Equivalent of the reference's tune.search basic variant generation
+(reference: python/ray/tune/search/basic_variant.py + sample.py domains).
+External searcher integrations (Optuna/HEBO/...) plug in through the
+same Searcher interface.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Uniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+class Randint(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class QRandint(Domain):
+    def __init__(self, low, high, q):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        return (rng.randrange(self.low, self.high) // self.q) * self.q
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable[[Dict], Any]):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn({})
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> Randint:
+    return Randint(low, high)
+
+
+def qrandint(low, high, q) -> QRandint:
+    return QRandint(low, high, q)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def sample_from(fn) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+class Searcher:
+    """Interface (reference: tune/search/searcher.py)."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid axes are exhaustively crossed; Domain axes are sampled.
+    num_samples multiplies the whole thing (reference semantics)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1, seed: Optional[int] = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        grid_axes = {k: v.values for k, v in param_space.items() if isinstance(v, GridSearch)}
+        if grid_axes:
+            keys = list(grid_axes)
+            combos = list(itertools.product(*(grid_axes[k] for k in keys)))
+            self._grid = [dict(zip(keys, c)) for c in combos]
+        else:
+            self._grid = [{}]
+        self._queue = []
+        for _ in range(num_samples):
+            for g in self._grid:
+                self._queue.append(g)
+        self._i = 0
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._queue)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._i >= len(self._queue):
+            return None
+        base = dict(self._queue[self._i])
+        self._i += 1
+        out = {}
+        for k, v in self.param_space.items():
+            if isinstance(v, GridSearch):
+                out[k] = base[k]
+            elif isinstance(v, Domain):
+                out[k] = v.sample(self.rng)
+            else:
+                out[k] = v
+        return out

@@ -1,0 +1,232 @@
+"""Tuner — the trial controller.
+
+Equivalent of the reference's Tuner + TuneController
+(reference: python/ray/tune/tuner.py + tune/execution/tune_controller.py:72):
+an event loop that starts trial actors up to max_concurrent, consumes
+their reported results through a queue, lets the scheduler stop bad
+trials, and collects a ResultGrid.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.config import RunConfig
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.util.queue import Empty, Queue
+
+logger = logging.getLogger("ray_tpu.tune")
+
+
+class TuneConfig:
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        num_samples: int = 1,
+        max_concurrent_trials: Optional[int] = None,
+        search_alg: Optional[Searcher] = None,
+        scheduler=None,
+        seed: Optional[int] = None,
+    ):
+        assert mode in ("min", "max")
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.max_concurrent_trials = max_concurrent_trials
+        self.search_alg = search_alg
+        self.scheduler = scheduler or FIFOScheduler()
+        self.seed = seed
+
+
+class TrialResult:
+    def __init__(self, trial_id: str, config: Dict[str, Any]):
+        self.trial_id = trial_id
+        self.config = config
+        self.metrics: Dict[str, Any] = {}
+        self.history: List[Dict[str, Any]] = []
+        self.status = "PENDING"
+        self.error: Optional[str] = None
+
+    def __repr__(self):
+        return f"TrialResult({self.trial_id}, {self.status}, {self.metrics})"
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: str, mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    def get_best_result(self, metric: Optional[str] = None, mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric}")
+        return (min if mode == "min" else max)(scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = {"trial_id": r.trial_id, "status": r.status, **{f"config/{k}": v for k, v in r.config.items()}}
+            row.update(r.metrics)
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+@ray_tpu.remote
+class _TrialActor:
+    def __init__(self, trial_id: str, queue):
+        self.trial_id = trial_id
+        self.queue = queue
+
+    def run(self, fn: Callable, config: Dict[str, Any]):
+        from ray_tpu.air.session import _Session, _set_session
+
+        class _Q:
+            def __init__(self, q, tid):
+                self.q, self.tid = q, tid
+
+            def put(self, item):
+                item["trial_id"] = self.tid
+                self.q.put(item)
+
+        session = _Session(0, 1, 0, _Q(self.queue, self.trial_id), storage_dir="/tmp", restore_checkpoint=None)
+        _set_session(session)
+        try:
+            fn(config)
+            return {"trial_id": self.trial_id, "status": "TERMINATED"}
+        except Exception as e:
+            import traceback
+
+            return {"trial_id": self.trial_id, "status": "ERROR", "error": f"{e}\n{traceback.format_exc()}"}
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self._trainable = trainable
+        self._space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        searcher = tc.search_alg or BasicVariantGenerator(self._space, tc.num_samples, seed=tc.seed)
+        scheduler = tc.scheduler
+        queue = Queue()
+        max_conc = tc.max_concurrent_trials or 4
+
+        trials: Dict[str, TrialResult] = {}
+        running: Dict[str, Any] = {}  # trial_id -> (actor, done_ref)
+        counter = 0
+        exhausted = False
+
+        def launch_next():
+            nonlocal counter, exhausted
+            if exhausted:
+                return False
+            trial_id = f"trial_{counter:05d}"
+            config = searcher.suggest(trial_id)
+            if config is None:
+                exhausted = True
+                return False
+            counter += 1
+            t = TrialResult(trial_id, config)
+            t.status = "RUNNING"
+            trials[trial_id] = t
+            actor = _TrialActor.options(num_cpus=1).remote(trial_id, queue)
+            done = actor.run.remote(self._trainable, config)
+            running[trial_id] = (actor, done)
+            return True
+
+        while len(running) < max_conc and launch_next():
+            pass
+
+        while running:
+            # drain reported results
+            try:
+                while True:
+                    item = queue.get(block=False)
+                    tid = item.get("trial_id")
+                    t = trials.get(tid)
+                    if t is None:
+                        continue
+                    metrics = dict(item["metrics"])
+                    metrics.setdefault("training_iteration", item.get("iteration", len(t.history) + 1))
+                    t.history.append(metrics)
+                    t.metrics = metrics
+                    if tid in running and scheduler.on_result(tid, metrics) == STOP:
+                        actor, _ = running.pop(tid)
+                        t.status = "STOPPED"
+                        try:
+                            ray_tpu.kill(actor)
+                        except Exception:
+                            pass
+                        while len(running) < max_conc and launch_next():
+                            pass
+            except Empty:
+                pass
+
+            done_refs = {done: tid for tid, (_, done) in running.items()}
+            if not done_refs:
+                continue
+            ready, _ = ray_tpu.wait(list(done_refs.keys()), num_returns=1, timeout=0.2)
+            for ref in ready:
+                tid = done_refs[ref]
+                actor, _ = running.pop(tid)
+                t = trials[tid]
+                try:
+                    status = ray_tpu.get(ref)
+                    t.status = status.get("status", "TERMINATED")
+                    if t.status == "ERROR":
+                        t.error = status.get("error")
+                except Exception as e:
+                    t.status = "ERROR"
+                    t.error = str(e)
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
+                searcher.on_trial_complete(tid, t.metrics)
+                while len(running) < max_conc and launch_next():
+                    pass
+
+        # final drain of queue (results reported just before completion)
+        try:
+            while True:
+                item = queue.get(block=False)
+                t = trials.get(item.get("trial_id"))
+                if t is not None:
+                    metrics = dict(item["metrics"])
+                    metrics.setdefault("training_iteration", item.get("iteration", len(t.history) + 1))
+                    t.history.append(metrics)
+                    t.metrics = metrics
+        except Empty:
+            pass
+        try:
+            queue.shutdown()
+        except Exception:
+            pass
+        return ResultGrid(list(trials.values()), tc.metric, tc.mode)

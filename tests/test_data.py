@@ -1,0 +1,99 @@
+"""Tests for ray_tpu.data (models the reference's data tests:
+python/ray/data/tests/test_dataset.py core coverage)."""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_range_count_take(ray_start_regular):
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+    assert ds.num_blocks() == 4
+
+
+def test_map_and_filter(ray_start_regular):
+    ds = rd.range(50).map(lambda r: {"id": r["id"] * 2}).filter(lambda r: r["id"] % 4 == 0)
+    out = [r["id"] for r in ds.take_all()]
+    assert out == [i * 2 for i in range(50) if (i * 2) % 4 == 0]
+
+
+def test_map_batches_numpy(ray_start_regular):
+    ds = rd.range(64).map_batches(lambda b: {"id": b["id"] + 100}, batch_format="numpy")
+    assert ds.take(2) == [{"id": 100}, {"id": 101}]
+
+
+def test_flat_map(ray_start_regular):
+    ds = rd.from_items([{"x": 1}, {"x": 2}]).flat_map(lambda r: [{"x": r["x"]}, {"x": -r["x"]}])
+    assert sorted(r["x"] for r in ds.take_all()) == [-2, -1, 1, 2]
+
+
+def test_iter_batches_sizes(ray_start_regular):
+    ds = rd.range(100, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=32, batch_format="numpy"))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 100
+    assert all(s == 32 for s in sizes[:-1])
+
+
+def test_repartition_and_shuffle(ray_start_regular):
+    ds = rd.range(60, parallelism=2).repartition(6)
+    assert ds.num_blocks() == 6
+    assert ds.count() == 60
+    sh = rd.range(60).random_shuffle(seed=7)
+    ids = [r["id"] for r in sh.take_all()]
+    assert sorted(ids) == list(range(60))
+    assert ids != list(range(60))
+
+
+def test_sort(ray_start_regular):
+    ds = rd.from_items([{"v": x} for x in [5, 3, 9, 1]]).sort("v")
+    assert [r["v"] for r in ds.take_all()] == [1, 3, 5, 9]
+    dsd = rd.from_items([{"v": x} for x in [5, 3, 9, 1]]).sort("v", descending=True)
+    assert [r["v"] for r in dsd.take_all()] == [9, 5, 3, 1]
+
+
+def test_groupby(ray_start_regular):
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(30)])
+    out = {r["k"]: r["v_sum"] for r in ds.groupby("k").sum("v").take_all()}
+    expect = {}
+    for i in range(30):
+        expect[i % 3] = expect.get(i % 3, 0) + i
+    assert out == expect
+
+
+def test_parquet_roundtrip(ray_start_regular, tmp_path):
+    ds = rd.range(40, parallelism=2)
+    path = str(tmp_path / "pq")
+    ds.write_parquet(path)
+    back = rd.read_parquet(path)
+    assert back.count() == 40
+    assert sorted(r["id"] for r in back.take_all()) == list(range(40))
+
+
+def test_csv_and_text(ray_start_regular, tmp_path):
+    p = tmp_path / "f.csv"
+    p.write_text("a,b\n1,x\n2,y\n")
+    ds = rd.read_csv(str(p))
+    assert ds.take_all() == [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
+    t = tmp_path / "f.txt"
+    t.write_text("hello\nworld\n")
+    assert rd.read_text(str(t)).take_all() == [{"text": "hello"}, {"text": "world"}]
+
+
+def test_union_split(ray_start_regular):
+    a = rd.range(10, parallelism=2)
+    b = rd.range(10, parallelism=2).map(lambda r: {"id": r["id"] + 10})
+    u = a.union(b)
+    assert u.count() == 20
+    parts = u.split(2)
+    assert sum(p.count() for p in parts) == 20
+
+
+def test_to_pandas(ray_start_regular):
+    df = rd.range(5).to_pandas()
+    assert list(df["id"]) == [0, 1, 2, 3, 4]

@@ -1,0 +1,137 @@
+"""Tests for ray_tpu.serve (models reference serve tests:
+python/ray/serve/tests/test_standalone.py core coverage)."""
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_serve(ray_start_regular):
+    yield
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+
+
+def test_deploy_and_call(ray_start_regular):
+    @serve.deployment
+    class Greeter:
+        def __init__(self, greeting):
+            self.greeting = greeting
+
+        def __call__(self, name):
+            return f"{self.greeting}, {name}!"
+
+    handle = serve.run(Greeter.bind("Hello"), name="app1")
+    assert handle.remote("world").result(timeout=30) == "Hello, world!"
+
+
+def test_multiple_replicas_balance(ray_start_regular):
+    @serve.deployment(num_replicas=2)
+    class PidService:
+        def __call__(self, _):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(PidService.bind(), name="app2")
+    pids = {handle.remote(None).result(timeout=30) for _ in range(12)}
+    assert len(pids) == 2
+
+
+def test_method_call_and_status(ray_start_regular):
+    @serve.deployment
+    class Calc:
+        def add(self, a, b):
+            return a + b
+
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Calc.bind(), name="app3", route_prefix="/calc")
+    assert handle.options(method_name="add").remote(2, 3).result(timeout=30) == 5
+    st = serve.status()
+    assert "app3" in st
+    assert st["app3"]["Calc"]["num_replicas"] == 1
+
+
+def test_redeploy_replaces_replicas(ray_start_regular):
+    @serve.deployment
+    class V:
+        def __init__(self, version):
+            self.version = version
+
+        def __call__(self, _):
+            return self.version
+
+    h1 = serve.run(V.bind(1), name="app4")
+    assert h1.remote(None).result(timeout=30) == 1
+    h2 = serve.run(V.bind(2), name="app4")
+    assert h2.remote(None).result(timeout=30) == 2
+
+
+def test_delete_app(ray_start_regular):
+    @serve.deployment
+    class D:
+        def __call__(self, _):
+            return "ok"
+
+    handle = serve.run(D.bind(), name="app5")
+    assert handle.remote(None).result(timeout=30) == "ok"
+    serve.delete("app5")
+    st = serve.status()
+    assert "app5" not in st
+
+
+def test_batching(ray_start_regular):
+    calls = []
+
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+    def process(items):
+        calls.append(len(items))
+        return [i * 2 for i in items]
+
+    results = []
+    threads = [threading.Thread(target=lambda v=v: results.append(process(v))) for v in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(results) == [0, 2, 4, 6, 8, 10, 12, 14]
+    assert max(calls) > 1  # at least one real batch formed
+
+
+def test_http_proxy(ray_start_regular):
+    import urllib.request
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, body):
+            return {"echo": body}
+
+    serve.run(Echo.bind(), name="app6", route_prefix="/echo")
+    from ray_tpu.serve.proxy import start_proxy
+
+    start_proxy(port=18111)
+    deadline = time.time() + 20
+    out = None
+    while time.time() < deadline:
+        try:
+            req = urllib.request.Request(
+                "http://127.0.0.1:18111/echo",
+                data=b'{"msg": "hi"}',
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                import json
+
+                out = json.loads(resp.read())
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert out == {"result": {"echo": {"msg": "hi"}}}

@@ -1,0 +1,146 @@
+"""End-to-end JaxTrainer tests — the reference build-plan's 'one model
+running' milestone (SURVEY.md §7 step 6): gang placement group, worker
+actors, session.report with checkpoints, restore/resume, failure retry.
+
+Models the reference's train tests (python/ray/train/tests/test_data_parallel_trainer.py).
+"""
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import CheckpointConfig, FailureConfig, JaxTrainer, RunConfig, ScalingConfig
+
+
+def test_trainer_runs_and_reports(ray_start_regular, tmp_path):
+    def loop(config):
+        ctx = train.get_context()
+        for step in range(3):
+            train.report({"step": step, "rank": ctx.get_world_rank(), "loss": 1.0 / (step + 1)})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path), name="basic"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert result.metrics["loss"] == pytest.approx(1 / 3)
+
+
+def test_trainer_world_info(ray_start_regular, tmp_path):
+    def loop(config):
+        ctx = train.get_context()
+        train.report({"world": ctx.get_world_size(), "rank": ctx.get_world_rank()})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=3),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert result.metrics["world"] == 3
+    assert result.metrics["rank"] == 0
+
+
+def test_trainer_checkpointing_and_restore(ray_start_regular, tmp_path):
+    def loop(config):
+        import jax.numpy as jnp
+
+        from ray_tpu.air.checkpoint import Checkpoint
+        from ray_tpu.train._internal.storage import load_jax_state, save_jax_state
+
+        ctx = train.get_context()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            state = load_jax_state(ckpt.path, {"w": jnp.zeros((4,)), "step": 0})
+            start = int(state["step"]) + 1
+        for step in range(start, 3):
+            if ctx.get_world_rank() == 0:
+                import tempfile
+
+                d = tempfile.mkdtemp()
+                save_jax_state(d, {"w": jnp.full((4,), float(step)), "step": step})
+                train.report({"step": step}, checkpoint=Checkpoint(d))
+            else:
+                train.report({"step": step})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path), name="ckpt",
+                             checkpoint_config=CheckpointConfig(num_to_keep=2)),
+    )
+    result = trainer.fit()
+    assert result.checkpoint is not None
+    # resume: starts from step 3 => no new steps, but restores state
+    trainer2 = JaxTrainer.restore(
+        os.path.join(str(tmp_path), "ckpt"),
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path), name="ckpt"),
+    )
+    result2 = trainer2.fit()
+    assert result2.error is None
+
+
+def test_trainer_surfaces_worker_failure(ray_start_regular, tmp_path):
+    def loop(config):
+        raise RuntimeError("train boom")
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path), failure_config=FailureConfig(max_failures=0)),
+    )
+    with pytest.raises(Exception, match="train boom"):
+        trainer.fit()
+
+
+def test_trainer_gang_infeasible_raises(ray_start_regular, tmp_path):
+    trainer = JaxTrainer(
+        lambda c: None,
+        scaling_config=ScalingConfig(num_workers=2, resources_per_worker={"CPU": 100}),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    with pytest.raises(RuntimeError, match="reserve"):
+        trainer.fit()
+
+
+def test_trainer_jax_training_loop(ray_start_regular, tmp_path):
+    """A real (tiny) jax model trained data-parallel style in the workers."""
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        ctx = train.get_context()
+        key = jax.random.PRNGKey(ctx.get_world_rank())
+        w = jnp.zeros((8,))
+        x = jax.random.normal(key, (64, 8))
+        y = x @ jnp.arange(8.0)
+        tx = optax.sgd(0.1)
+        opt = tx.init(w)
+
+        @jax.jit
+        def step(w, opt):
+            def loss(w):
+                return ((x @ w - y) ** 2).mean()
+
+            l, g = jax.value_and_grad(loss)(w)
+            u, opt = tx.update(g, opt)
+            return optax.apply_updates(w, u), opt, l
+
+        for i in range(50):
+            w, opt, l = step(w, opt)
+        train.report({"final_loss": float(l)})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert result.metrics["final_loss"] < 1.0

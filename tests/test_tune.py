@@ -1,0 +1,98 @@
+"""Tests for ray_tpu.tune (models reference tune tests:
+python/ray/tune/tests/test_tune_*.py core coverage)."""
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.schedulers import AsyncHyperBandScheduler, MedianStoppingRule
+
+
+def _objective(config):
+    # quadratic bowl: best at x=3
+    score = (config["x"] - 3) ** 2
+    for i in range(5):
+        tune.report({"loss": score + (5 - i) * 0.1, "training_iteration": i + 1})
+
+
+def test_grid_search_finds_best(ray_start_regular):
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=TuneConfig(metric="loss", mode="min", max_concurrent_trials=2),
+    )
+    results = tuner.fit()
+    assert len(results) == 5
+    best = results.get_best_result()
+    assert best.config["x"] == 3
+
+
+def test_random_search_samples(ray_start_regular):
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.uniform(0, 6)},
+        tune_config=TuneConfig(num_samples=4, metric="loss", mode="min", max_concurrent_trials=2, seed=0),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    assert all(r.status in ("TERMINATED", "STOPPED") for r in results)
+    assert 0 <= results.get_best_result().config["x"] <= 6
+
+
+def test_trial_error_captured(ray_start_regular):
+    def bad(config):
+        raise ValueError("bad trial")
+
+    results = Tuner(bad, param_space={}, tune_config=TuneConfig(num_samples=1)).fit()
+    assert results[0].status == "ERROR"
+    assert "bad trial" in results[0].error
+
+
+def test_asha_stops_poor_trials(ray_start_regular):
+    def slow_objective(config):
+        for i in range(20):
+            tune.report({"loss": config["x"] + i * 0.0, "training_iteration": i + 1})
+
+    sched = AsyncHyperBandScheduler(metric="loss", mode="min", max_t=20, grace_period=2, reduction_factor=2)
+    results = Tuner(
+        slow_objective,
+        param_space={"x": tune.grid_search([1.0, 2.0, 3.0, 4.0])},
+        tune_config=TuneConfig(metric="loss", mode="min", scheduler=sched, max_concurrent_trials=4),
+    ).fit()
+    assert len(results) == 4
+    stopped = [r for r in results if r.status == "STOPPED"]
+    assert stopped, "ASHA should stop at least one poor trial"
+    assert results.get_best_result().config["x"] == 1.0
+
+
+def test_result_dataframe(ray_start_regular):
+    results = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+    ).fit()
+    df = results.get_dataframe()
+    assert len(df) == 2
+    assert "config/x" in df.columns
+    assert "loss" in df.columns
+
+
+def test_search_domains():
+    from ray_tpu.tune.search import BasicVariantGenerator
+
+    gen = BasicVariantGenerator(
+        {"a": tune.grid_search([1, 2]), "b": tune.choice(["p", "q"]), "c": tune.loguniform(1e-4, 1e-1), "fixed": 7},
+        num_samples=2,
+        seed=1,
+    )
+    assert gen.total_trials == 4
+    seen = []
+    while True:
+        cfg = gen.suggest("t")
+        if cfg is None:
+            break
+        assert cfg["b"] in ("p", "q")
+        assert 1e-4 <= cfg["c"] <= 1e-1
+        assert cfg["fixed"] == 7
+        seen.append(cfg["a"])
+    assert sorted(seen) == [1, 1, 2, 2]
