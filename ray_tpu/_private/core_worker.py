@@ -337,7 +337,26 @@ class CoreWorker:
     def put_serialized_to_shm(self, oid: bytes, pickled, buffers) -> Dict[str, Any]:
         """Write an already-serialized value into the node arena; returns env."""
         total = serialization.serialized_size(pickled, buffers)
-        buf = self._shm.create_buffer(oid, total)
+        try:
+            buf = self._shm.create_buffer(oid, total)
+        except FileExistsError:
+            # Task retry re-executing on this node after a crash between seal
+            # and owner push: the sealed bytes are the same deterministic
+            # return id — adopt them instead of failing the retry. An
+            # unsealed entry may be a concurrent writer (e.g. the raylet
+            # pulling this oid from a replica), so wait for its seal rather
+            # than clobbering it; only a still-unsealed entry after the
+            # grace (a dead mid-write leftover) is deleted.
+            existing = self._shm.get(oid, timeout_ms=2000)
+            if existing is not None:
+                size = existing.size
+                existing.release()
+                if size == total:
+                    self._call(self._gcs.request("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": size}))
+                    return _env_shm(self.node_id, size)
+                # non-byte-stable reserialization: replace with this attempt
+            self._shm.delete(oid)
+            buf = self._shm.create_buffer(oid, total)
         serialization.write_to(buf, pickled, buffers)
         buf.release()
         self._shm.seal(oid)
@@ -382,8 +401,14 @@ class CoreWorker:
                 return _env_shm(self.node_id, reply["size"])
             if status == "owner":
                 try:
+                    if deadline is not None and deadline - time.monotonic() <= 0:
+                        raise exceptions.GetTimeoutError(f"get timed out on {oid.hex()}")
                     conn = await self._peer(reply["owner_addr"])
-                    t = None if deadline is None else max(0.0, deadline - time.monotonic())
+                    # recompute after connect so connect latency counts
+                    # against the caller's deadline too
+                    t = None if deadline is None else deadline - time.monotonic()
+                    if t is not None and t <= 0:
+                        raise exceptions.GetTimeoutError(f"get timed out on {oid.hex()}")
                     env = await conn.request("owner.resolve", {"oid": oid}, timeout=t)
                 except (protocol.ConnectionLost, asyncio.TimeoutError) as e:
                     if isinstance(e, asyncio.TimeoutError):

@@ -86,7 +86,7 @@ class Connection:
         self._pending[rid] = fut
         try:
             await self._send({"t": "req", "i": rid, "m": method, "d": data})
-            if timeout:
+            if timeout is not None:  # 0.0 is a real (expired) deadline, not "no timeout"
                 return await asyncio.wait_for(fut, timeout)
             return await fut
         finally:

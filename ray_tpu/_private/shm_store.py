@@ -8,19 +8,29 @@ call into the library; gets of sealed objects are a hash probe, not a
 socket round trip.
 
 The shared library is compiled on first use (g++ -O2 -shared) and cached
-next to the source. The build is also exposed via `python -m
-ray_tpu._private.shm_store build` for wheels/CI.
+next to the source, keyed by a content hash of shm_store.cc so a stale or
+foreign binary is never loaded (mtimes are not preserved by git). The
+build is also exposed via `python -m ray_tpu._private.shm_store build`
+for wheels/CI.
 """
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
 from typing import Optional
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "src", "shm_store.cc")
-_LIB = os.path.join(os.path.dirname(_SRC), "libshm_store.so")
+
+
+def _lib_path() -> str:
+    """Library path embedding a hash of the source: rebuilds are automatic
+    whenever shm_store.cc changes, regardless of file timestamps."""
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(os.path.dirname(_SRC), f"libshm_store.{digest}.so")
 
 ST_OK = 0
 ST_EXISTS = -1
@@ -34,16 +44,25 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 def build_library(force: bool = False) -> str:
+    lib = _lib_path()
     with _build_lock:
-        if force or (not os.path.exists(_LIB)) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-            tmp = _LIB + f".tmp.{os.getpid()}"
+        if force or not os.path.exists(lib):
+            tmp = lib + f".tmp.{os.getpid()}"
             subprocess.run(
                 ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC, "-lpthread"],
                 check=True,
                 capture_output=True,
             )
-            os.replace(tmp, _LIB)
-    return _LIB
+            os.replace(tmp, lib)
+            # drop builds of older source revisions
+            d = os.path.dirname(lib)
+            for name in os.listdir(d):
+                if name.startswith("libshm_store.") and name.endswith(".so") and os.path.join(d, name) != lib:
+                    try:
+                        os.unlink(os.path.join(d, name))
+                    except OSError:
+                        pass
+    return lib
 
 
 def _load() -> ctypes.CDLL:

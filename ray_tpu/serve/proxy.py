@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import threading
 from typing import Dict, Optional
 
 import ray_tpu
@@ -15,12 +16,19 @@ import ray_tpu
 
 @ray_tpu.remote(num_cpus=0)
 class ProxyActor:
+    """Runs aiohttp on a dedicated thread with its own event loop, so the
+    actor is plain-sync from the runtime's perspective and never shares
+    (or blocks) the CoreWorker IO loop."""
+
     def __init__(self, port: int = 8000):
         self.port = port
         self.routes: Dict[str, tuple] = {}
         self._handles = {}
         self._runner = None
-        asyncio.get_event_loop().create_task(self._start())
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever, daemon=True, name="serve-proxy")
+        self._thread.start()
+        asyncio.run_coroutine_threadsafe(self._start(), self._loop).result(timeout=30)
 
     async def _start(self):
         from aiohttp import web
@@ -35,8 +43,11 @@ class ProxyActor:
     async def _refresh_routes(self):
         from ray_tpu.serve.api import _get_controller
 
-        controller = _get_controller()
-        self.routes = ray_tpu.get(controller.get_routes.remote())
+        def _fetch():
+            controller = _get_controller()
+            return ray_tpu.get(controller.get_routes.remote())
+
+        self.routes = await asyncio.get_running_loop().run_in_executor(None, _fetch)
 
     async def _handle(self, request):
         from aiohttp import web

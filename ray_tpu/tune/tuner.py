@@ -95,7 +95,7 @@ class _TrialActor:
         self.trial_id = trial_id
         self.queue = queue
 
-    def run(self, fn: Callable, config: Dict[str, Any]):
+    def run(self, fn: Callable, config: Dict[str, Any], storage_dir: str):
         from ray_tpu.air.session import _Session, _set_session
 
         class _Q:
@@ -106,15 +106,27 @@ class _TrialActor:
                 item["trial_id"] = self.tid
                 self.q.put(item)
 
-        session = _Session(0, 1, 0, _Q(self.queue, self.trial_id), storage_dir="/tmp", restore_checkpoint=None)
+        import os
+
+        os.makedirs(storage_dir, exist_ok=True)
+        session = _Session(
+            0, 1, 0, _Q(self.queue, self.trial_id),
+            storage_dir=storage_dir,
+            restore_checkpoint=None,
+        )
         _set_session(session)
         try:
             fn(config)
-            return {"trial_id": self.trial_id, "status": "TERMINATED"}
+            return {"trial_id": self.trial_id, "status": "TERMINATED", "n_reports": session.iteration}
         except Exception as e:
             import traceback
 
-            return {"trial_id": self.trial_id, "status": "ERROR", "error": f"{e}\n{traceback.format_exc()}"}
+            return {
+                "trial_id": self.trial_id,
+                "status": "ERROR",
+                "error": f"{e}\n{traceback.format_exc()}",
+                "n_reports": session.iteration,
+            }
 
 
 class Tuner:
@@ -132,11 +144,23 @@ class Tuner:
         self.run_config = run_config or RunConfig()
 
     def fit(self) -> ResultGrid:
+        import os
+        import tempfile
+
         tc = self.tune_config
         searcher = tc.search_alg or BasicVariantGenerator(self._space, tc.num_samples, seed=tc.seed)
         scheduler = tc.scheduler
         queue = Queue()
         max_conc = tc.max_concurrent_trials or 4
+        # one run-scoped directory holds every trial's checkpoints: a user
+        # path from RunConfig, or a temp dir that a single rm can clean up
+        run_dir = getattr(self.run_config, "storage_path", None)
+        if run_dir:
+            run_dir = os.path.join(run_dir, getattr(self.run_config, "name", None) or "tune_run")
+            os.makedirs(run_dir, exist_ok=True)
+        else:
+            run_dir = tempfile.mkdtemp(prefix="ray_tpu_tune_")
+        self.run_dir = run_dir
 
         trials: Dict[str, TrialResult] = {}
         running: Dict[str, Any] = {}  # trial_id -> (actor, done_ref)
@@ -157,54 +181,87 @@ class Tuner:
             t.status = "RUNNING"
             trials[trial_id] = t
             actor = _TrialActor.options(num_cpus=1).remote(trial_id, queue)
-            done = actor.run.remote(self._trainable, config)
+            done = actor.run.remote(self._trainable, config, os.path.join(run_dir, trial_id))
             running[trial_id] = (actor, done)
             return True
+
+        def process_item(item) -> None:
+            """Record one reported result and apply the scheduler's decision.
+            Every report goes through the scheduler in arrival order, so
+            STOP decisions are deterministic w.r.t. report ordering even
+            when the trial process has already exited."""
+            tid = item.get("trial_id")
+            t = trials.get(tid)
+            if t is None:
+                return
+            metrics = dict(item["metrics"])
+            metrics.setdefault("training_iteration", item.get("iteration", len(t.history) + 1))
+            t.history.append(metrics)
+            t.metrics = metrics
+            if t.status in ("STOPPED", "TERMINATED", "ERROR"):
+                return
+            if scheduler.on_result(tid, metrics) == STOP:
+                t.status = "STOPPED"
+                entry = running.pop(tid, None)
+                if entry is not None:
+                    try:
+                        ray_tpu.kill(entry[0])
+                    except Exception:
+                        pass
+
+        def drain(block: bool = False, timeout: float = 0.05) -> bool:
+            """Process queued reports; returns True if anything arrived."""
+            got = False
+            try:
+                while True:
+                    item = queue.get(block=block and not got, timeout=timeout)
+                    got = True
+                    process_item(item)
+            except Empty:
+                pass
+            return got
 
         while len(running) < max_conc and launch_next():
             pass
 
         while running:
-            # drain reported results
-            try:
-                while True:
-                    item = queue.get(block=False)
-                    tid = item.get("trial_id")
-                    t = trials.get(tid)
-                    if t is None:
-                        continue
-                    metrics = dict(item["metrics"])
-                    metrics.setdefault("training_iteration", item.get("iteration", len(t.history) + 1))
-                    t.history.append(metrics)
-                    t.metrics = metrics
-                    if tid in running and scheduler.on_result(tid, metrics) == STOP:
-                        actor, _ = running.pop(tid)
-                        t.status = "STOPPED"
-                        try:
-                            ray_tpu.kill(actor)
-                        except Exception:
-                            pass
-                        while len(running) < max_conc and launch_next():
-                            pass
-            except Empty:
+            drain()
+            while len(running) < max_conc and launch_next():
                 pass
-
             done_refs = {done: tid for tid, (_, done) in running.items()}
             if not done_refs:
                 continue
             ready, _ = ray_tpu.wait(list(done_refs.keys()), num_returns=1, timeout=0.2)
             for ref in ready:
                 tid = done_refs[ref]
-                actor, _ = running.pop(tid)
+                entry = running.pop(tid, None)
+                if entry is None:  # stopped by the scheduler during drain
+                    continue
+                actor = entry[0]
                 t = trials[tid]
+                n_reports = None
+                final_status, final_error = "TERMINATED", None
                 try:
                     status = ray_tpu.get(ref)
-                    t.status = status.get("status", "TERMINATED")
-                    if t.status == "ERROR":
-                        t.error = status.get("error")
+                    final_status = status.get("status", "TERMINATED")
+                    final_error = status.get("error")
+                    n_reports = status.get("n_reports")
                 except Exception as e:
-                    t.status = "ERROR"
-                    t.error = str(e)
+                    final_status, final_error = "ERROR", str(e)
+                # the trial has exited, but its reports may still be in
+                # flight — wait until the scheduler has judged all of them
+                # before declaring the trial TERMINATED
+                if n_reports is not None:
+                    deadline = time.monotonic() + 5.0
+                    while len(t.history) < n_reports and time.monotonic() < deadline:
+                        drain(block=True, timeout=0.1)
+                if final_status == "ERROR":
+                    # a crash outranks a late scheduler STOP — never hide
+                    # the traceback
+                    t.status, t.error = "ERROR", final_error
+                elif t.status != "STOPPED":
+                    t.status = final_status
+                    t.error = final_error
                 try:
                     ray_tpu.kill(actor)
                 except Exception:
@@ -213,18 +270,7 @@ class Tuner:
                 while len(running) < max_conc and launch_next():
                     pass
 
-        # final drain of queue (results reported just before completion)
-        try:
-            while True:
-                item = queue.get(block=False)
-                t = trials.get(item.get("trial_id"))
-                if t is not None:
-                    metrics = dict(item["metrics"])
-                    metrics.setdefault("training_iteration", item.get("iteration", len(t.history) + 1))
-                    t.history.append(metrics)
-                    t.metrics = metrics
-        except Empty:
-            pass
+        drain()  # results reported just before the last completion
         try:
             queue.shutdown()
         except Exception:
