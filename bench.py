@@ -1,14 +1,21 @@
 """Benchmark — prints ONE JSON line to stdout.
 
-Headline metric: 1:1 sync actor call throughput, directly comparable to
-the reference's release microbenchmark
-(reference: python/ray/_private/ray_perf.py "1:1 actor calls sync";
-recorded baseline 2,138 calls/s in release_logs/2.9.2/microbenchmark.json
-— see BASELINE.md). vs_baseline > 1.0 means faster than the reference.
+Headline metric: training MFU of the flagship Llama model on one real
+TPU chip, against the BASELINE.json north star of 40% MFU (reference has
+no TPU numbers; its training benchmarks assert wall-clock parity only —
+reference: release/air_tests/air_benchmarks/workloads/torch_benchmark.py).
+vs_baseline > 1.0 means above the 40% north star.
 
-Side metrics (TPU train-step throughput/MFU on the flagship model, async
-actor calls, task throughput) go to stderr so the stdout contract stays
-a single JSON line.
+Side metrics (runtime microbenchmarks vs the reference's release rig
+numbers — reference: python/ray/_private/ray_perf.py:93-241 and
+BASELINE.md) go to stderr, and are also embedded in the JSON line under
+"extra" for the record.
+
+Timing notes: the TPU is reached through a relay where a host→device
+fetch costs ~100 ms, and the first TWO step calls each compile (the
+donated-buffer layout triggers a second compile). Steady state is
+measured as the slope between a short and a long run, with a single
+fetch at the end of each — never per-step fetches.
 """
 from __future__ import annotations
 
@@ -16,17 +23,31 @@ import json
 import sys
 import time
 
-BASELINE_SYNC_ACTOR_CALLS = 2138.0  # reference release rig
+# reference release-rig numbers (BASELINE.md; release_logs/2.9.2/microbenchmark.json)
+BASELINES = {
+    "actor_calls_sync_1to1": 2138.0,
+    "actor_calls_async_1to1": 9183.0,
+    "actor_calls_async_nn": 28922.0,
+    "tasks_async": 26697.0,  # multi-client; single-client here is conservative
+    "puts_per_s": 12682.0,
+    "put_gib_per_s": 33.6,
+    "pg_per_s": 899.0,
+}
+MFU_NORTH_STAR = 0.40  # BASELINE.json: Llama ≥40% MFU
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def bench_runtime():
+def bench_runtime(extra):
+    import numpy as np
+
     import ray_tpu
 
-    ray_tpu.init(num_cpus=2, object_store_memory=256 * 1024 * 1024)
+    # logical CPUs: the n:n benchmark books 9 actors (1 echo + 4 callers
+    # + 4 nested echoes); resources here are admission control, not cores
+    ray_tpu.init(num_cpus=16, object_store_memory=512 * 1024 * 1024)
 
     @ray_tpu.remote
     class Echo:
@@ -35,91 +56,169 @@ def bench_runtime():
 
     a = Echo.remote()
     ray_tpu.get(a.ping.remote())
-    # warmup
     for _ in range(200):
         ray_tpu.get(a.ping.remote())
 
     N = 3000
-    t0 = time.time()
+    t0 = time.perf_counter()
     for _ in range(N):
         ray_tpu.get(a.ping.remote())
-    sync_rate = N / (time.time() - t0)
-    log(f"[bench] 1:1 sync actor calls: {sync_rate:.0f}/s (baseline {BASELINE_SYNC_ACTOR_CALLS:.0f})")
+    sync_rate = N / (time.perf_counter() - t0)
+    extra["actor_calls_sync_1to1"] = round(sync_rate, 1)
+    log(f"[bench] 1:1 sync actor calls: {sync_rate:.0f}/s (baseline {BASELINES['actor_calls_sync_1to1']:.0f})")
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     ray_tpu.get([a.ping.remote() for _ in range(N)])
-    log(f"[bench] 1:1 async actor calls: {N / (time.time() - t0):.0f}/s (baseline 9183)")
+    r = N / (time.perf_counter() - t0)
+    extra["actor_calls_async_1to1"] = round(r, 1)
+    log(f"[bench] 1:1 async actor calls: {r:.0f}/s (baseline {BASELINES['actor_calls_async_1to1']:.0f})")
+
+    # n:n — 4 caller actors each driving their own callee
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self):
+            self.target = Echo.remote()
+            ray_tpu.get(self.target.ping.remote())
+
+        def drive(self, n):
+            ray_tpu.get([self.target.ping.remote() for _ in range(n)])
+            return n
+
+    callers = [Caller.remote() for _ in range(4)]
+    ray_tpu.get([c.drive.remote(10) for c in callers])
+    t0 = time.perf_counter()
+    per = 1000
+    ray_tpu.get([c.drive.remote(per) for c in callers])
+    r = 4 * per / (time.perf_counter() - t0)
+    extra["actor_calls_async_nn"] = round(r, 1)
+    log(f"[bench] n:n async actor calls: {r:.0f}/s (baseline {BASELINES['actor_calls_async_nn']:.0f})")
 
     @ray_tpu.remote
     def noop():
         return None
 
     ray_tpu.get(noop.remote())
-    t0 = time.time()
-    ray_tpu.get([noop.remote() for _ in range(500)])
-    log(f"[bench] async tasks: {500 / (time.time() - t0):.0f}/s")
+    t0 = time.perf_counter()
+    ray_tpu.get([noop.remote() for _ in range(1000)])
+    r = 1000 / (time.perf_counter() - t0)
+    extra["tasks_async"] = round(r, 1)
+    log(f"[bench] async tasks: {r:.0f}/s")
+
+    # put throughput (small objects) + bandwidth (large objects)
+    small = b"x" * 1024
+    for _ in range(50):
+        ray_tpu.put(small)
+    t0 = time.perf_counter()
+    for _ in range(2000):
+        ray_tpu.put(small)
+    r = 2000 / (time.perf_counter() - t0)
+    extra["puts_per_s"] = round(r, 1)
+    log(f"[bench] puts (1KB): {r:.0f}/s (baseline {BASELINES['puts_per_s']:.0f})")
+
+    big = np.ones(16 * 1024 * 1024 // 8, np.float64)  # 16 MiB
+    ray_tpu.put(big)
+    t0 = time.perf_counter()
+    n_big = 20
+    for _ in range(n_big):
+        ray_tpu.put(big)
+    gib = n_big * big.nbytes / (1 << 30) / (time.perf_counter() - t0)
+    extra["put_gib_per_s"] = round(gib, 2)
+    log(f"[bench] put bandwidth: {gib:.1f} GiB/s (baseline {BASELINES['put_gib_per_s']})")
+
+    # placement group churn
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    t0 = time.perf_counter()
+    n_pg = 100
+    for _ in range(n_pg):
+        pg = placement_group([{"CPU": 1}])
+        pg.wait(10)
+        remove_placement_group(pg)
+    r = n_pg / (time.perf_counter() - t0)
+    extra["pg_per_s"] = round(r, 1)
+    log(f"[bench] PG create+remove: {r:.0f}/s (baseline {BASELINES['pg_per_s']:.0f})")
 
     ray_tpu.shutdown()
-    return sync_rate
 
 
-def bench_tpu_train():
-    """Flagship-model train step on the real chip (side metric)."""
+def bench_tpu_train(extra):
+    """Flagship-model train step on the real chip — the headline metric."""
     try:
         import jax
 
         if jax.default_backend() not in ("tpu",):
             log(f"[bench] no TPU backend ({jax.default_backend()}); skipping train bench")
-            return
-        import jax.numpy as jnp
+            return None
 
         from ray_tpu.models.llama import LlamaConfig, flops_per_token
+        from ray_tpu.ops.flash_attention import kernel_supported
         from ray_tpu.parallel.mesh import MeshSpec, build_mesh
         from ray_tpu.train.step import build_sharded_train_step
 
-        cfg = LlamaConfig.nano_tpu()
+        cfg = LlamaConfig.nano_tpu()  # attn_impl="auto" → pallas flash on TPU
         B, T = 8, 1024
+        assert kernel_supported(T, T, cfg.head_dim), "flash kernel must be on the benched path"
         mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
         init_fn, step_fn, shard_batch, _ = build_sharded_train_step(cfg, mesh, strategy="dp")
         state = init_fn(jax.random.PRNGKey(0))
         tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T + 1), 0, cfg.vocab_size)
         batch = shard_batch({"tokens": tokens})
-        t0 = time.time()
-        state, m = step_fn(state, batch)
-        jax.block_until_ready(m["loss"])
-        log(f"[bench] train step compile: {time.time() - t0:.1f}s, loss {float(m['loss']):.3f}")
 
-        steps = 10
-        t0 = time.time()
-        for _ in range(steps):
+        t0 = time.perf_counter()
+        for _ in range(3):  # covers both compiles (fresh + donated layouts)
             state, m = step_fn(state, batch)
-        jax.block_until_ready(m["loss"])
-        dt = (time.time() - t0) / steps
-        tokens_per_s = B * T / dt
-        flops = flops_per_token(cfg, T) * B * T
-        # v5e peak ≈ 197 TFLOP/s bf16
-        mfu = flops / dt / 197e12
+        loss = float(m["loss"])
+        log(f"[bench] warmup (2 compiles + 1 step): {time.perf_counter() - t0:.1f}s, loss {loss:.3f}")
+
+        def run(n):
+            nonlocal state
+            t0 = time.perf_counter()
+            for _ in range(n):
+                state, m = step_fn(state, batch)
+            _ = float(m["loss"])  # single fetch
+            return time.perf_counter() - t0
+
+        n1, n2 = 5, 25
+        dt = (run(n2) - run(n1)) / (n2 - n1)
+        fl = flops_per_token(cfg, T) * B * T
+        mfu = fl / dt / 197e12  # v5e peak ≈ 197 TFLOP/s bf16
+        extra["train_ms_per_step"] = round(dt * 1e3, 1)
+        extra["train_tok_per_s_chip"] = round(B * T / dt, 0)
+        extra["train_mfu_pct"] = round(mfu * 100, 1)
         log(
-            f"[bench] llama-nano train: {dt * 1e3:.1f} ms/step, "
-            f"{tokens_per_s:,.0f} tok/s/chip, ~{mfu * 100:.1f}% MFU (v5e peak)"
+            f"[bench] llama-nano train (flash path): {dt * 1e3:.1f} ms/step, "
+            f"{B * T / dt:,.0f} tok/s/chip, {mfu * 100:.1f}% MFU (v5e peak)"
         )
+        return mfu
     except Exception as e:
-        log(f"[bench] tpu train bench failed: {type(e).__name__}: {e}")
+        import traceback
+
+        log(f"[bench] tpu train bench failed: {type(e).__name__}: {e}\n{traceback.format_exc()}")
+        return None
 
 
 def main():
-    sync_rate = bench_runtime()
-    bench_tpu_train()
-    print(
-        json.dumps(
-            {
-                "metric": "actor_calls_sync_1to1",
-                "value": round(sync_rate, 1),
-                "unit": "calls/s",
-                "vs_baseline": round(sync_rate / BASELINE_SYNC_ACTOR_CALLS, 3),
-            }
-        )
-    )
+    extra = {}
+    bench_runtime(extra)
+    mfu = bench_tpu_train(extra)
+    if mfu is not None:
+        headline = {
+            "metric": "llama_train_mfu",
+            "value": round(mfu * 100, 1),
+            "unit": "%",
+            "vs_baseline": round(mfu / MFU_NORTH_STAR, 3),
+            "extra": extra,
+        }
+    else:  # no TPU — fall back to the runtime headline
+        sync = extra.get("actor_calls_sync_1to1", 0.0)
+        headline = {
+            "metric": "actor_calls_sync_1to1",
+            "value": sync,
+            "unit": "calls/s",
+            "vs_baseline": round(sync / BASELINES["actor_calls_sync_1to1"], 3),
+            "extra": extra,
+        }
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

@@ -347,16 +347,35 @@ class CoreWorker:
             # pulling this oid from a replica), so wait for its seal rather
             # than clobbering it; only a still-unsealed entry after the
             # grace (a dead mid-write leftover) is deleted.
+            def _adopt(size):
+                self._call(self._gcs.request("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": size}))
+                return _env_shm(self.node_id, size)
+
             existing = self._shm.get(oid, timeout_ms=2000)
             if existing is not None:
                 size = existing.size
                 existing.release()
                 if size == total:
-                    self._call(self._gcs.request("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": size}))
-                    return _env_shm(self.node_id, size)
+                    return _adopt(size)
                 # non-byte-stable reserialization: replace with this attempt
-            self._shm.delete(oid)
-            buf = self._shm.create_buffer(oid, total)
+                # (delete tombstones if readers still hold refs)
+                self._shm.delete(oid)
+            else:
+                # dead mid-write leftover: abort frees a created-but-unsealed
+                # entry regardless of the crashed writer's never-released ref
+                self._shm.abort(oid)
+            try:
+                buf = self._shm.create_buffer(oid, total)
+            except FileExistsError:
+                # sealed entry pinned by live readers (pending delete): the
+                # first attempt's value is still being served — adopt it
+                # (at-least-once semantics: one attempt's value wins)
+                pinned = self._shm.get(oid, timeout_ms=0)
+                if pinned is None:
+                    raise
+                size = pinned.size
+                pinned.release()
+                return _adopt(size)
         serialization.write_to(buf, pickled, buffers)
         buf.release()
         self._shm.seal(oid)

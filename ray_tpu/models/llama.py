@@ -40,7 +40,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "blockwise"  # flash | blockwise | ring
+    attn_impl: str = "auto"  # auto | flash | blockwise | ring
     remat: bool = True
 
     @property
@@ -130,11 +130,22 @@ def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
 
 
 def _attention(q, k, v, cfg: LlamaConfig, mesh=None):
-    if cfg.attn_impl == "flash":
+    impl = cfg.attn_impl
+    if impl == "auto":
+        # TPU default is the pallas flash kernel whenever the shapes
+        # dispatch to it; anything else falls back to the XLA blockwise path
+        from ray_tpu.ops.flash_attention import _on_tpu, kernel_supported
+
+        impl = (
+            "flash"
+            if _on_tpu() and kernel_supported(q.shape[1], k.shape[1], q.shape[3])
+            else "blockwise"
+        )
+    if impl == "flash":
         from ray_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, True)
-    if cfg.attn_impl == "ring":
+    if impl == "ring":
         from ray_tpu.parallel.ring_attention import ring_attention
 
         # inside jit with sp-sharded activations this must be called via

@@ -141,10 +141,20 @@ def flash_attention(
     return o
 
 
+def kernel_supported(seq_q: int, seq_k: int, head_dim: int, block_q: int = 128, block_k: int = 128) -> bool:
+    """True iff these shapes dispatch to the pallas kernel on a TPU backend.
+    head_dim 64 (validated on-chip; covers most small models) or a
+    128-multiple (MXU-native); seq lengths must divide the block sizes."""
+    return (
+        seq_q % min(block_q, seq_q) == 0
+        and seq_k % min(block_k, seq_k) == 0
+        and (head_dim == 64 or head_dim % 128 == 0)
+    )
+
+
 def _flash_fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k):
     T, S = q.shape[1], k.shape[1]
-    use_pallas = _on_tpu() and T % min(block_q, T) == 0 and S % min(block_k, S) == 0 and q.shape[3] % 128 == 0
-    if use_pallas:
+    if _on_tpu() and kernel_supported(T, S, q.shape[3], block_q, block_k):
         return _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret=False)
     # XLA fallback (CPU tests, odd shapes)
     return _fwd_impl(q, k, v, causal, max(block_q, block_k), sm_scale, 0, 0)

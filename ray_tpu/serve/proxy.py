@@ -72,8 +72,12 @@ class ProxyActor:
         if handle is None:
             from ray_tpu.serve.handle import DeploymentHandle
 
-            handle = DeploymentHandle(dep_name, app_name)
-            handle._refresh()
+            def _build():
+                h = DeploymentHandle(dep_name, app_name)
+                h._refresh()  # blocking controller round trips — off-loop
+                return h
+
+            handle = await asyncio.get_running_loop().run_in_executor(None, _build)
             self._handles[key] = handle
         try:
             body = await request.json() if request.can_read_body else {}

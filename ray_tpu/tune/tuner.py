@@ -152,11 +152,14 @@ class Tuner:
         scheduler = tc.scheduler
         queue = Queue()
         max_conc = tc.max_concurrent_trials or 4
-        # one run-scoped directory holds every trial's checkpoints: a user
-        # path from RunConfig, or a temp dir that a single rm can clean up
+        # one run-scoped directory holds every trial's checkpoints. An
+        # unnamed run gets a unique name so trial_00000 etc. never collide
+        # with a previous run under the same storage_path.
         run_dir = getattr(self.run_config, "storage_path", None)
+        name = getattr(self.run_config, "name", None)
         if run_dir:
-            run_dir = os.path.join(run_dir, getattr(self.run_config, "name", None) or "tune_run")
+            name = name or f"tune_run_{os.getpid()}_{int(time.time())}"
+            run_dir = os.path.join(os.path.expanduser(run_dir), name)
             os.makedirs(run_dir, exist_ok=True)
         else:
             run_dir = tempfile.mkdtemp(prefix="ray_tpu_tune_")
