@@ -22,6 +22,8 @@ _DEFS: Dict[str, Any] = {
     "object_chunk_size_bytes": 4 * 1024**2,  # node-to-node transfer chunking
     # --- scheduler ---
     "worker_lease_timeout_s": 30.0,
+    "lease_idle_timeout_s": 1.0,  # direct-dispatch lease linger before release
+    "max_leases_per_shape": 16,  # cap on concurrently leased workers per resource shape
     "worker_pool_prestart": 2,
     "worker_pool_max_idle": 8,
     "scheduler_spread_threshold": 0.5,

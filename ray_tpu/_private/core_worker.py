@@ -23,6 +23,7 @@ Transport (reference: src/ray/core_worker/transport/):
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import os
 import threading
@@ -80,6 +81,19 @@ def _env_err(exc: BaseException, function_name: str = ""):
     }
 
 
+class _ShapeState:
+    """Owner-side direct-dispatch state for one resource shape: a queue of
+    specs plus the leased workers draining it (reference: the submitter's
+    per-SchedulingKey lease sets in direct_task_transport.cc)."""
+
+    def __init__(self):
+        self.queue: collections.deque = collections.deque()
+        self.leases: set = set()  # lease_ids with a running drain loop
+        self.acquiring = 0
+        self.event = asyncio.Event()
+        self.denied_until = 0.0
+
+
 class CoreWorker:
     def __init__(
         self,
@@ -89,6 +103,7 @@ class CoreWorker:
         node_id: Optional[str] = None,
         shm_path: Optional[str] = None,
         worker_id: Optional[str] = None,
+        raylet_addr: Optional[str] = None,
     ):
         self.mode = mode
         self.gcs_addr = gcs_addr
@@ -97,6 +112,18 @@ class CoreWorker:
         self.worker_id = worker_id or hex_id(new_id())
         self.client_id: Optional[str] = None
         self.job_id: Optional[str] = None
+        self._raylet_addr = raylet_addr
+        self._raylet_conn: Optional[protocol.Connection] = None
+        self._shapes: Dict[tuple, _ShapeState] = {}
+        self._direct_inflight: Dict[str, protocol.Connection] = {}  # task_id -> worker conn
+        self._owned_pending: List[bytes] = []
+        self._owned_flush_scheduled = False
+        # batched driver-thread → IO-loop posts: call_soon_threadsafe wakes
+        # the loop through a self-pipe write (~20µs); one wakeup covers
+        # every post made while the loop was busy
+        self._post_buf: collections.deque = collections.deque()
+        self._post_lock = threading.Lock()
+        self._post_scheduled = False
 
         self._loop = asyncio.new_event_loop()
         self._loop_thread = threading.Thread(target=self._run_loop, daemon=True, name="core-worker-io")
@@ -155,6 +182,27 @@ class CoreWorker:
         """Run a coroutine on the IO loop from any thread."""
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
         return fut.result(timeout)
+
+    def _post(self, fn):
+        """Queue fn to run on the IO loop; one loop wakeup covers every
+        post raced in while the loop was busy."""
+        self._post_buf.append(fn)
+        with self._post_lock:
+            if not self._post_scheduled:
+                self._post_scheduled = True
+                self._loop.call_soon_threadsafe(self._drain_posts)
+
+    def _drain_posts(self):
+        while True:
+            with self._post_lock:
+                if not self._post_buf:
+                    self._post_scheduled = False
+                    return
+            fn = self._post_buf.popleft()  # single consumer: safe un-locked
+            try:
+                fn()
+            except Exception:
+                logger.exception("posted callback failed")
 
     async def _astart(self):
         self._peer_lock = asyncio.Lock()
@@ -249,6 +297,12 @@ class CoreWorker:
             if self.executor is None:
                 raise RuntimeError("not an executor worker")
             return await self.executor.handle_actor_call(data, conn)
+        if method == "call.task":
+            # direct normal-task dispatch from a lease-holding owner
+            # (reference: PushNormalTask onto a leased worker)
+            if self.executor is None:
+                raise RuntimeError("not an executor worker")
+            return await self.executor.handle_direct_task(data)
         if method == "exec.cancel":
             if self.executor is not None:
                 self.executor.cancel(data["task_id"], data.get("force", False))
@@ -685,10 +739,192 @@ class CoreWorker:
         for oid in returns:
             self._make_pending(oid)
         self._submitted[spec["task_id"]] = {"spec": spec, "retries_left": spec.get("max_retries", 0)}
-        self._loop.call_soon_threadsafe(
-            lambda: self._loop.create_task(self._gcs.request("task.submit", {"spec": spec}))
-        )
+        if self._direct_eligible(spec):
+            self._post(lambda: self._direct_submit(spec))
+        else:
+            self._post(
+                lambda: self._loop.create_task(self._gcs.request("task.submit", {"spec": spec}))
+            )
         return [ObjectRef(oid) for oid in returns]
+
+    # ------------------------------------------------- direct task dispatch
+    # Owner-side worker leases: repeated small tasks skip the central
+    # scheduler entirely — the owner leases workers from its local raylet
+    # (one GCS admission round trip per LEASE, amortized over many tasks)
+    # and pushes specs straight to them, results riding the reply
+    # (reference: CoreWorkerDirectTaskSubmitter lease caching,
+    # src/ray/core_worker/transport/direct_task_transport.cc:121-135).
+
+    def _direct_eligible(self, spec) -> bool:
+        if self._raylet_addr is None:
+            return False
+        if (
+            spec.get("placement_group_id")
+            or spec.get("node_id_affinity")
+            or spec.get("label_affinity_hard")
+            or spec.get("label_affinity_soft")
+            or spec.get("scheduling_strategy") not in (None, "DEFAULT")
+        ):
+            return False
+        res = spec.get("resources") or {}
+        return set(res) <= {"CPU"}
+
+    def _shape_key(self, spec) -> tuple:
+        return tuple(sorted((spec.get("resources") or {}).items()))
+
+    def _register_owned(self, oids):
+        """Loop-side micro-batched ownership registration: every call in
+        one loop iteration rides a single GCS push."""
+        self._owned_pending.extend(oids)
+        if not self._owned_flush_scheduled:
+            self._owned_flush_scheduled = True
+            self._loop.call_soon(self._flush_owned)
+
+    def _flush_owned(self):
+        self._owned_flush_scheduled = False
+        if not self._owned_pending:
+            return
+        oids, self._owned_pending = self._owned_pending, []
+        self._loop.create_task(self._gcs.push("obj.register_owned", {"oids": oids}))
+
+    def _direct_submit(self, spec):
+        """Loop-side: enqueue on the shape queue and size the lease pool."""
+        self._register_owned(spec["returns"])
+        key = self._shape_key(spec)
+        st = self._shapes.get(key)
+        if st is None:
+            st = self._shapes[key] = _ShapeState()
+        if time.monotonic() < st.denied_until and not st.leases and not st.acquiring:
+            # denial window with nothing draining: go straight to the
+            # central scheduler, or the spec would sit unqueued forever
+            self._loop.create_task(self._gcs.request("task.submit", {"spec": spec}))
+            return
+        st.queue.append(spec)
+        st.event.set()
+        self._grow_leases(key, st)
+
+    def _grow_leases(self, key, st: _ShapeState):
+        target = min(len(st.queue), RayConfig.max_leases_per_shape)
+        if time.monotonic() < st.denied_until:
+            target = min(target, len(st.leases))  # don't grow while denied
+        while len(st.leases) + st.acquiring < target:
+            st.acquiring += 1
+            self._loop.create_task(self._acquire_lease(key, st))
+        if st.queue and not st.leases and not st.acquiring:
+            # nothing will drain this queue (denial window): GCS fallback
+            while st.queue:
+                spec = st.queue.popleft()
+                self._loop.create_task(self._gcs.request("task.submit", {"spec": spec}))
+
+    async def _raylet(self) -> protocol.Connection:
+        if self._raylet_conn is None or self._raylet_conn.closed:
+            self._raylet_conn = await protocol.connect(
+                self._raylet_addr, self._handle_peer, name="cw-raylet"
+            )
+        return self._raylet_conn
+
+    async def _acquire_lease(self, key, st: _ShapeState):
+        try:
+            rl = await self._raylet()
+            reply = await rl.request("lease.request", {"resources": dict(key)})
+        except Exception as e:
+            logger.debug("lease request failed: %s", e)
+            reply = {"ok": False}
+        finally:
+            st.acquiring -= 1
+        if not reply.get("ok"):
+            st.denied_until = time.monotonic() + 0.5
+            if not st.leases and st.acquiring == 0:
+                # no direct capacity at all: hand the backlog to the
+                # central scheduler (cross-node placement lives there)
+                while st.queue:
+                    spec = st.queue.popleft()
+                    self._loop.create_task(self._gcs.request("task.submit", {"spec": spec}))
+            return
+        lease_id = reply["lease_id"]
+        try:
+            conn = await self._peer(reply["addr"])
+        except Exception:
+            try:
+                await (await self._raylet()).request("lease.release", {"lease_id": lease_id})
+            except Exception:
+                pass
+            return
+        st.leases.add(lease_id)
+        self._loop.create_task(self._lease_drain(key, st, lease_id, conn))
+
+    async def _lease_drain(self, key, st: _ShapeState, lease_id: str, conn):
+        """One leased worker: drain the shape queue with a small pipeline
+        window (the worker executes serially; the window hides wire +
+        event-loop latency). Lingers briefly when idle, then gives the
+        worker back."""
+        window: collections.deque = collections.deque()  # (spec, reply_fut)
+
+        async def _worker_died(extra_specs):
+            # everything sent (or about to send) may have executed — spend
+            # a retry each and fall back to the central scheduler
+            for spec in [s for s, _ in window] + list(extra_specs):
+                tid = spec["task_id"]
+                self._direct_inflight.pop(tid, None)
+                rec = self._submitted.get(tid)
+                if rec and rec["retries_left"] > 0:
+                    rec["retries_left"] -= 1
+                    await self._gcs.request("task.submit", {"spec": spec})
+                else:
+                    self._fail_call(
+                        spec, exceptions.WorkerCrashedError("leased worker died during task")
+                    )
+                    self._submitted.pop(tid, None)
+            window.clear()
+
+        try:
+            while True:
+                while st.queue and len(window) < 4:
+                    spec = st.queue.popleft()
+                    if spec.get("cancelled"):
+                        self._fail_call(spec, exceptions.TaskCancelledError(spec.get("name", "")))
+                        self._submitted.pop(spec["task_id"], None)
+                        continue
+                    self._direct_inflight[spec["task_id"]] = conn
+                    try:
+                        fut = await conn.request_send("call.task", {"spec": spec})
+                    except (protocol.ConnectionLost, OSError):
+                        await _worker_died([spec])
+                        return  # lease is dead (raylet reap credits the resources)
+                    window.append((spec, fut))
+                if not window:
+                    st.event.clear()
+                    if not st.queue:  # re-check after clear (no await between)
+                        try:
+                            await asyncio.wait_for(st.event.wait(), RayConfig.lease_idle_timeout_s)
+                        except asyncio.TimeoutError:
+                            return
+                    continue
+                spec, fut = window.popleft()
+                task_id = spec["task_id"]
+                try:
+                    reply = await fut
+                except (protocol.ConnectionLost, OSError):
+                    await _worker_died([spec])
+                    return  # lease is dead (raylet reap credits the resources)
+                except Exception as e:
+                    self._direct_inflight.pop(task_id, None)
+                    self._fail_call(spec, e)
+                    self._submitted.pop(task_id, None)
+                    continue
+                self._direct_inflight.pop(task_id, None)
+                for item in reply["results"]:
+                    self._deliver(bytes(item["oid"]), item["env"])
+                self._submitted.pop(task_id, None)
+        finally:
+            st.leases.discard(lease_id)
+            try:
+                await (await self._raylet()).request("lease.release", {"lease_id": lease_id})
+            except Exception:
+                pass
+            # work may have arrived while we were releasing
+            if st.queue:
+                self._grow_leases(key, st)
 
     async def _on_task_failed(self, data):
         rec = self._submitted.get(data["task_id"])
@@ -745,7 +981,7 @@ class CoreWorker:
             self._make_pending(oid)
         # fire-and-forget enqueue: the caller holds refs whose cells are
         # already waitable; the loop does the sending
-        self._loop.call_soon_threadsafe(self._enqueue_actor_call, spec, max_task_retries)
+        self._post(lambda: self._enqueue_actor_call(spec, max_task_retries))
         return [ObjectRef(oid) for oid in returns]
 
     def _enqueue_actor_call(self, spec, retries_left: int):
@@ -759,8 +995,8 @@ class CoreWorker:
             self._actor_senders[actor_id] = self._loop.create_task(self._actor_sender_loop(actor_id))
         # ownership registration is fire-and-forget: the directory only
         # needs it before some *other* process resolves the ref, and the
-        # push rides the same ordered GCS stream
-        self._loop.create_task(self._gcs.push("obj.register_owned", {"oids": spec["returns"]}))
+        # push rides the same ordered GCS stream (micro-batched per loop tick)
+        self._register_owned(spec["returns"])
 
     def _fail_call(self, spec, exc: BaseException):
         err = _env_err(exc)
@@ -822,37 +1058,45 @@ class CoreWorker:
                 await asyncio.sleep(0.1)
                 continue
             q.popleft()
-            asyncio.get_running_loop().create_task(self._await_actor_reply(actor_id, spec, retries_left, reply_fut))
+            # deliver on the reply callback; only failures spawn a task
+            # (a Task per call costs more than the delivery itself)
+            reply_fut.add_done_callback(
+                lambda fut, s=spec, r=retries_left: self._on_actor_reply(actor_id, s, r, fut)
+            )
         self._actor_senders.pop(actor_id, None)
 
-    async def _await_actor_reply(self, actor_id: str, spec, retries_left: int, reply_fut):
+    def _on_actor_reply(self, actor_id: str, spec, retries_left: int, fut):
+        exc = fut.exception() if not fut.cancelled() else None
+        if fut.cancelled() or exc is not None:
+            asyncio.get_running_loop().create_task(
+                self._actor_reply_failed(actor_id, spec, retries_left, exc)
+            )
+            return
+        for item in fut.result()["results"]:
+            self._deliver(bytes(item["oid"]), item["env"])
+
+    async def _actor_reply_failed(self, actor_id: str, spec, retries_left: int, exc):
+        if isinstance(exc, protocol.RpcError):
+            self._fail_call(spec, exceptions.ActorError(f"actor call failed: {exc}", actor_id=actor_id))
+            return
+        if not isinstance(exc, (protocol.ConnectionLost, OSError)):
+            self._fail_call(spec, exc if isinstance(exc, BaseException) else RuntimeError("call cancelled"))
+            return
+        self._actor_addr_cache.pop(actor_id, None)
         try:
-            reply = await reply_fut
-            for item in reply["results"]:
-                self._deliver(bytes(item["oid"]), item["env"])
+            info = await self._gcs.request("actor.get_info", {"actor_id": actor_id, "wait_ready": False})
+        except Exception:
+            info = {"state": "DEAD", "death_cause": "gcs unreachable"}
+        if info["state"] == "DEAD" or retries_left <= 0:
+            self._fail_call(
+                spec,
+                exceptions.ActorDiedError(
+                    f"actor died: {info.get('death_cause', 'connection lost during call')}",
+                    actor_id=actor_id,
+                ),
+            )
             return
-        except protocol.RpcError as e:
-            self._fail_call(spec, exceptions.ActorError(f"actor call failed: {e}", actor_id=actor_id))
-            return
-        except (protocol.ConnectionLost, OSError):
-            self._actor_addr_cache.pop(actor_id, None)
-            try:
-                info = await self._gcs.request("actor.get_info", {"actor_id": actor_id, "wait_ready": False})
-            except Exception:
-                info = {"state": "DEAD", "death_cause": "gcs unreachable"}
-            if info["state"] == "DEAD" or retries_left <= 0:
-                self._fail_call(
-                    spec,
-                    exceptions.ActorDiedError(
-                        f"actor died: {info.get('death_cause', 'connection lost during call')}",
-                        actor_id=actor_id,
-                    ),
-                )
-                return
-            # re-enqueue for re-execution on the restarted actor
-            await self._asubmit_actor_requeue(spec, retries_left - 1)
-        except Exception as e:
-            self._fail_call(spec, e)
+        await self._asubmit_actor_requeue(spec, retries_left - 1)
 
     async def _asubmit_actor_requeue(self, spec, retries_left: int):
         import collections
@@ -882,7 +1126,21 @@ class CoreWorker:
                 return False
         else:
             task_id = task_id_or_ref
-        return self._call(self._gcs.request("task.cancel", {"task_id": task_id, "force": force}))
+
+        async def _acancel():
+            # direct-path tasks are invisible to the GCS: cancel locally
+            conn = self._direct_inflight.get(task_id)
+            if conn is not None:
+                await conn.push("exec.cancel", {"task_id": task_id, "force": force})
+                return True
+            for st in self._shapes.values():
+                for spec in st.queue:
+                    if spec["task_id"] == task_id:
+                        spec["cancelled"] = True
+                        return True
+            return await self._gcs.request("task.cancel", {"task_id": task_id, "force": force})
+
+        return self._call(_acancel())
 
     # ------------------------------------------------------------------ misc
     def gcs_request(self, method: str, data=None, timeout=None):

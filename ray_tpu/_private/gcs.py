@@ -126,6 +126,11 @@ class GcsServer:
         self.inflight: Dict[str, Dict[str, Any]] = {}  # task_id -> {spec, node, worker}
         self._sched_wakeup = asyncio.Event()
 
+        # worker leases for owner-side direct dispatch (reference: lease
+        # grants in direct_task_transport.cc — the GCS only admits the
+        # resources; tasks on a leased worker never come back here)
+        self.leases: Dict[str, Dict[str, Any]] = {}  # lease_id -> {node, resources}
+
         # placement groups: pg_id hex -> record
         self.placement_groups: Dict[str, Dict[str, Any]] = {}
 
@@ -309,6 +314,10 @@ class GcsServer:
         # objects located only there are lost
         for oid, rec in self.objects.items():
             rec["locations"].discard(node_id)
+        # leases on the dead node vanish with it (its pool is gone too)
+        for lease_id, rec in list(self.leases.items()):
+            if rec["node"] == node_id:
+                self.leases.pop(lease_id, None)
 
     async def _health_loop(self):
         period = RayConfig.health_check_period_s
@@ -492,6 +501,35 @@ class GcsServer:
             self._record_event(rec["spec"], "FINISHED")
             if d.get("worker_id"):
                 rec["worker"] = d["worker_id"]
+        return True
+
+    # ------------------------------------------------------- worker leases
+    async def _rpc_lease_admit(self, d, conn):
+        """Admission control for a raylet granting a worker lease: deduct
+        the shape from the node pool so the central scheduler and direct
+        dispatch share one resource ledger."""
+        node = self.nodes.get(d["node_id"])
+        if node is None or node["state"] != "ALIVE":
+            return {"ok": False, "reason": "node gone"}
+        req = d.get("resources") or {}
+        avail = node["resources_available"]
+        if any(avail.get(k, 0.0) < v for k, v in req.items()):
+            return {"ok": False, "reason": "insufficient resources"}
+        for k, v in req.items():
+            avail[k] = avail.get(k, 0.0) - v
+        lease_id = hex_id(new_id())
+        self.leases[lease_id] = {"node": d["node_id"], "resources": req}
+        return {"ok": True, "lease_id": lease_id}
+
+    async def _rpc_lease_done(self, d, conn):
+        rec = self.leases.pop(d["lease_id"], None)
+        if rec is not None:
+            node = self.nodes.get(rec["node"])
+            if node is not None and node["state"] == "ALIVE":
+                avail = node["resources_available"]
+                for k, v in rec["resources"].items():
+                    avail[k] = avail.get(k, 0.0) + v
+            self._sched_wakeup.set()
         return True
 
     async def _rpc_task_failed(self, d, conn):

@@ -106,8 +106,10 @@ class Connection:
             self._pending.pop(rid, None)
             raise
 
-        def _cleanup(_):
+        def _cleanup(f):
             self._pending.pop(rid, None)
+            if not f.cancelled():
+                f.exception()  # mark retrieved: in-flight sends at shutdown are expected losses
 
         fut.add_done_callback(_cleanup)
         return fut

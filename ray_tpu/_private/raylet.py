@@ -73,6 +73,7 @@ class WorkerHandle:
         self.current_task: Optional[Dict[str, Any]] = None
         self.is_actor = False
         self.actor_id: Optional[str] = None
+        self.lease_id: Optional[str] = None  # leased to an owner for direct dispatch
         self.registered = asyncio.Event()
         self.idle_since = time.time()
 
@@ -109,6 +110,7 @@ class Raylet:
 
         self._gcs: Optional[protocol.Connection] = None
         self._peer_conns: Dict[str, protocol.Connection] = {}
+        self._conn_leases: Dict[protocol.Connection, set] = {}  # owner conn -> lease_ids
 
     def _cleanup(self):
         for h in list(getattr(self, "workers", {}).values()):
@@ -249,6 +251,10 @@ class Raylet:
                     await self._gcs.request(
                         "actor.died", {"actor_id": h.actor_id, "reason": f"worker process exited ({code})"}
                     )
+                if h.lease_id:
+                    # leased worker died: credit the shape back; the owner
+                    # notices via its broken conn and re-routes in-flight work
+                    await self._gcs.request("lease.done", {"lease_id": h.lease_id})
                 self._pump()
 
     def _pump(self):
@@ -362,6 +368,10 @@ class Raylet:
             self.idle.append(h.worker_id)
             self._pump()
             return {"node_id": self.node_id}
+        if method == "lease.request":
+            return await self._lease_request(data, conn)
+        if method == "lease.release":
+            return await self._lease_release(data, conn)
         if method == "fetch.meta":
             oid = bytes(data["oid"])
             buf = self.store.get(oid, timeout_ms=0)
@@ -381,6 +391,66 @@ class Raylet:
             finally:
                 buf.release()
         raise ValueError(f"unknown method {method}")
+
+    # ------------------------------------------------------- worker leases
+    async def _lease_request(self, data, conn) -> Dict[str, Any]:
+        """Grant a worker lease for owner-side direct dispatch (reference:
+        raylet lease grants consumed by direct_task_transport.cc:121-135 —
+        the owner then pushes tasks straight to the leased worker and the
+        scheduler never sees them). Leases are tied to the requesting
+        connection: if the owner dies, its leased workers are reclaimed."""
+        admit = await self._gcs.request(
+            "lease.admit", {"node_id": self.node_id, "resources": data.get("resources") or {}}
+        )
+        if not admit.get("ok"):
+            return {"ok": False, "reason": admit.get("reason", "denied")}
+        lease_id = admit["lease_id"]
+        deadline = time.monotonic() + 10.0
+        while True:
+            worker = None
+            while self.idle:
+                wid = self.idle.popleft()
+                h = self.workers.get(wid)
+                if h is not None and h.proc.poll() is None and h.conn is not None:
+                    worker = h
+                    break
+            if worker is not None:
+                worker.lease_id = lease_id
+                self._conn_leases.setdefault(conn, set()).add(lease_id)
+                if conn.on_close is None:
+                    conn.on_close = self._on_owner_conn_close
+                return {"ok": True, "lease_id": lease_id, "worker_id": worker.worker_id, "addr": worker.addr}
+            if time.monotonic() > deadline:
+                await self._gcs.request("lease.done", {"lease_id": lease_id})
+                return {"ok": False, "reason": "no worker available"}
+            if self.starting == 0 and len(self.workers) < self.max_workers:
+                self._start_worker()
+            await asyncio.sleep(0.02)
+
+    async def _lease_release(self, data, conn=None) -> bool:
+        lease_id = data["lease_id"]
+        if conn is not None and conn in self._conn_leases:
+            self._conn_leases[conn].discard(lease_id)
+        for h in self.workers.values():
+            if h.lease_id == lease_id:
+                h.lease_id = None
+                self._return_worker(h)
+                break
+        await self._gcs.request("lease.done", {"lease_id": lease_id})
+        return True
+
+    async def _on_owner_conn_close(self, conn):
+        """Owner died holding leases: kill its leased workers (they may be
+        mid-task for the dead owner) and credit the resources back."""
+        for lease_id in self._conn_leases.pop(conn, set()):
+            for h in list(self.workers.values()):
+                if h.lease_id == lease_id:
+                    h.lease_id = None
+                    try:
+                        h.proc.kill()
+                    except Exception:
+                        pass
+            await self._gcs.request("lease.done", {"lease_id": lease_id})
 
     async def _fetch(self, data) -> bool:
         """Pull an object from a remote raylet into the local arena in

@@ -76,6 +76,22 @@ def _aligned(off: int) -> int:
     return (off + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
+_BULK_COPY_MIN = 64 * 1024
+
+
+def _bulk_copy(dst: memoryview, off: int, src: memoryview) -> None:
+    """memoryview slice-assign into a ctypes-backed view is ~4x slower than
+    memcpy (observed 0.6 vs 4 GiB/s into the shm arena); route large
+    buffers through numpy, which copies with memcpy."""
+    n = src.nbytes
+    if n >= _BULK_COPY_MIN:
+        import numpy as np
+
+        np.frombuffer(dst, np.uint8, count=n, offset=off)[:] = np.frombuffer(src, np.uint8)
+    else:
+        dst[off : off + n] = src
+
+
 def write_to(buf: memoryview, pickled: bytes, buffers: List[pickle.PickleBuffer]) -> int:
     """Writes the wire format into `buf`; returns bytes written."""
     _HDR.pack_into(buf, 0, len(buffers), len(pickled))
@@ -87,7 +103,7 @@ def write_to(buf: memoryview, pickled: bytes, buffers: List[pickle.PickleBuffer]
         mv = memoryview(b).cast("B")
         _BUF_HDR.pack_into(buf, off, mv.nbytes)
         off += _BUF_HDR.size
-        buf[off : off + mv.nbytes] = mv
+        _bulk_copy(buf, off, mv)
         off += mv.nbytes
     return off
 

@@ -94,6 +94,7 @@ class Worker:
                 session_dir=self.session_dir,
                 node_id=node_info["node_id"] if node_info else None,
                 shm_path=node_info["shm_path"] if node_info else None,
+                raylet_addr=node_info.get("raylet_sock") if node_info else None,
             )
             self.core.start()
             # publish the driver's sys.path so workers can import its modules

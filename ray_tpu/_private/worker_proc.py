@@ -55,9 +55,7 @@ class Executor:
             return {"ok": True}
         if spec.get("actor_creation"):
             return await self._create_actor(spec)
-        self._current_task_id = spec["task_id"]
         envs = await self._run_user_function(spec)
-        self._current_task_id = None
         await self._push_results(spec, envs)
         return {"ok": True}
 
@@ -81,6 +79,17 @@ class Executor:
             self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_conc, thread_name_prefix="actor")
         self.actor_semaphore = asyncio.Semaphore(max_conc)
         return {"ok": True, "addr": self.core._listen_addr}
+
+    async def handle_direct_task(self, data) -> Dict[str, Any]:
+        """Normal task pushed directly by a lease-holding owner; results
+        travel back in the reply (no raylet, no GCS on this path)."""
+        spec = data["spec"]
+        if spec.get("cancelled") or spec["task_id"] in self._cancelled:
+            err = _env_err(exceptions.TaskCancelledError(spec.get("name", "")), spec.get("name", ""))
+            err["t"] = "TaskCancelledError"
+            return {"results": [{"oid": oid, "env": err} for oid in spec["returns"]]}
+        envs = await self._run_user_function(spec)
+        return {"results": [{"oid": oid, "env": env} for oid, env in zip(spec["returns"], envs)]}
 
     async def handle_actor_call(self, data, conn) -> Dict[str, Any]:
         """Direct actor invocation. Calls from one caller arrive in
@@ -114,8 +123,14 @@ class Executor:
             # serialize (each hop is a loop⇄thread round trip; the 1:1
             # sync actor-call benchmark lives and dies on these)
             def _run_all():
+                # pipelined handler coroutines may interleave; the task
+                # that owns the pool thread is the one cancel() can
+                # interrupt, so both fields are set HERE, on that thread
                 self._current_thread = threading.current_thread()
+                self._current_task_id = spec["task_id"]
                 try:
+                    if spec["task_id"] in self._cancelled:
+                        raise exceptions.TaskCancelledError(spec.get("name", ""))
                     if actor:
                         fn = getattr(self.actor_instance, spec["method"])
                     else:
@@ -133,6 +148,7 @@ class Executor:
                     return [self._to_env_sync(oid, v) for oid, v in zip(spec["returns"], values)]
                 finally:
                     self._current_thread = None
+                    self._current_task_id = None
 
             envs = await loop.run_in_executor(self.pool, _run_all)
             if len(envs) == 1 and len(spec["returns"]) > 1:
@@ -249,6 +265,7 @@ async def _amain():
         node_id=node_id,
         shm_path=shm_path,
         worker_id=worker_id,
+        raylet_addr=raylet_sock,
     )
     # CoreWorker.start spins its own loop thread; we are already in asyncio —
     # run start() in a thread to avoid blocking this loop.
