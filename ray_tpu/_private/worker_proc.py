@@ -20,6 +20,7 @@ import ctypes
 import inspect
 import logging
 import os
+import sys
 import threading
 import traceback
 from typing import Any, Dict, Optional
@@ -236,18 +237,48 @@ class Executor:
 
 
 async def _amain():
-    # Pin the jax platform when the cluster asks for it (tests force cpu
-    # meshes; the axon sitecustomize would otherwise grab the TPU in every
-    # worker). Done eagerly because jax.config must win before first
-    # backend init, wherever user code later imports jax.
-    forced = os.environ.get("RAY_TPU_WORKER_JAX_PLATFORMS")
-    if forced:
+    # Pin the jax platform: the raylet always sets JAX_PLATFORMS for
+    # workers (cpu unless the task's resources grant it the TPU), but a
+    # TPU-plugin sitecustomize can force-register the device at
+    # interpreter start, overriding the env var — jax.config wins only if
+    # applied before first backend use. Without the pin, every jax op in
+    # a worker silently round-trips the driver's TPU (observed ~130 ms
+    # per host<->device transfer through the tunnel, a ~1000x slowdown on
+    # CPU-sized work). To keep jax-free workers cheap, only import jax
+    # eagerly when a sitecustomize already paid for the import; otherwise
+    # pin lazily at the task's first `import jax`, reading the env at
+    # that moment so a task granted the TPU can set JAX_PLATFORMS=tpu
+    # before importing jax and still get it.
+    def _pin_jax_platform():
+        platforms = os.environ.get("RAY_TPU_WORKER_JAX_PLATFORMS") or os.environ.get("JAX_PLATFORMS")
+        if not platforms:
+            return
         try:
             import jax
 
-            jax.config.update("jax_platforms", forced)
+            jax.config.update("jax_platforms", platforms)
         except Exception:
             pass
+
+    if "jax" in sys.modules:
+        _pin_jax_platform()
+    else:
+        import builtins
+
+        _orig_import = builtins.__import__
+
+        def _import_hook(name, *args, **kwargs):
+            mod = _orig_import(name, *args, **kwargs)
+            if name == "jax" or name.startswith("jax."):
+                # nested jax.* imports fire while jax/__init__ is still
+                # running — only pin (and unhook) once jax.config exists
+                jax_mod = sys.modules.get("jax")
+                if jax_mod is not None and hasattr(jax_mod, "config"):
+                    builtins.__import__ = _orig_import
+                    _pin_jax_platform()
+            return mod
+
+        builtins.__import__ = _import_hook
 
     session_dir = os.environ["RAY_TPU_SESSION_DIR"]
     gcs_addr = os.environ["RAY_TPU_GCS_ADDR"]
@@ -270,8 +301,6 @@ async def _amain():
     # CoreWorker.start spins its own loop thread; we are already in asyncio —
     # run start() in a thread to avoid blocking this loop.
     await asyncio.get_running_loop().run_in_executor(None, core.start)
-
-    import sys
 
     extra_path = core.gcs_request("kv.get", {"ns": "session", "key": "driver_sys_path"})
     if extra_path:

@@ -4,8 +4,11 @@ New-stack architecture only (reference: RLModule/Learner/EnvRunner —
 rllib/core/rl_module/rl_module.py:237, core/learner/learner.py:105,
 env/env_runner.py:15); the torch DDP learner wrap
 (core/learner/torch/torch_learner.py:384) becomes a jax learner whose
-multi-learner gradient reduction is an ICI psum under pjit (or the host
-collective veneer across processes).
+multi-learner gradient reduction is an ICI psum under pjit (or
+lockstep pytree averaging across learner actors on separate hosts).
 """
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
-from ray_tpu.rllib.core.rl_module import RLModule  # noqa: F401
+from ray_tpu.rllib.core.learner import Learner, LearnerGroup  # noqa: F401
+from ray_tpu.rllib.core.rl_module import RLModule, DiscreteMLPModule  # noqa: F401
+from ray_tpu.rllib.env import EnvRunner, SingleAgentEnvRunner  # noqa: F401

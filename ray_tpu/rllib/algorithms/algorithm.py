@@ -1,0 +1,273 @@
+"""Algorithm + AlgorithmConfig — the RLlib training driver.
+
+Equivalent of the reference's Algorithm(Trainable)
+(reference: rllib/algorithms/algorithm.py:192; step at :797) and the
+fluent AlgorithmConfig builder
+(reference: rllib/algorithms/algorithm_config.py). The Algorithm owns
+an EnvRunnerGroup (sampling actors) and a LearnerGroup (jax updates);
+`train()` runs one `training_step` and folds in sampler metrics.
+"""
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional, Type, Union
+
+import numpy as np
+
+
+class AlgorithmConfig:
+    algo_class: Optional[type] = None
+    learner_class: Optional[type] = None
+
+    def __init__(self):
+        # environment
+        self.env: Union[str, Callable, None] = None
+        self.env_config: Dict[str, Any] = {}
+        # env runners
+        self.num_env_runners = 0
+        self.num_envs_per_env_runner = 8
+        self.rollout_fragment_length = 64
+        self.num_cpus_per_env_runner = 1
+        # training
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.train_batch_size = 2048
+        self.minibatch_size = 128
+        self.num_epochs = 6
+        self.grad_clip: Optional[float] = 0.5
+        # learners
+        self.num_learners = 0
+        self.num_cpus_per_learner = 1
+        self.num_devices_per_learner = 1
+        # module
+        self.module_class = None
+        self.model_config: Dict[str, Any] = {"hidden": (64, 64)}
+        # misc
+        self.seed = 0
+
+    # -- fluent setters (reference: AlgorithmConfig.environment/env_runners/...)
+    def environment(self, env=None, env_config=None):
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, num_env_runners=None, num_envs_per_env_runner=None,
+                    rollout_fragment_length=None, num_cpus_per_env_runner=None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if num_cpus_per_env_runner is not None:
+            self.num_cpus_per_env_runner = num_cpus_per_env_runner
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def learners(self, num_learners=None, num_cpus_per_learner=None, num_devices_per_learner=None):
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if num_cpus_per_learner is not None:
+            self.num_cpus_per_learner = num_cpus_per_learner
+        if num_devices_per_learner is not None:
+            self.num_devices_per_learner = num_devices_per_learner
+        return self
+
+    def rl_module(self, module_class=None, model_config=None):
+        if module_class is not None:
+            self.module_class = module_class
+        if model_config is not None:
+            self.model_config = model_config
+        return self
+
+    def debugging(self, seed=None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    # -- builders -------------------------------------------------------------
+    def build_module(self, obs_space, action_space):
+        from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+
+        module_class = self.module_class or DiscreteMLPModule
+        return module_class(obs_space, action_space, self.model_config)
+
+    def build_learner_mesh(self):
+        """A 1-D 'dp' mesh over local devices when the learner is multi-chip."""
+        if self.num_devices_per_learner <= 1:
+            return None
+        import jax
+        from jax.sharding import Mesh
+
+        devices = jax.devices()[: self.num_devices_per_learner]
+        return Mesh(np.array(devices), ("dp",))
+
+    def build(self) -> "Algorithm":
+        if self.env is None:
+            raise ValueError("config.environment(env=...) is required")
+        return self.algo_class(self.copy())
+
+
+class EnvRunnerGroup:
+    """Local or remote SingleAgentEnvRunner pool
+    (reference: rllib/env/env_runner_group.py)."""
+
+    def __init__(self, config):
+        from ray_tpu.rllib.env.single_agent_env_runner import SingleAgentEnvRunner
+
+        self.config = config
+        self.local_runner: Optional[SingleAgentEnvRunner] = None
+        self.remote_runners: List[Any] = []
+        if config.num_env_runners == 0:
+            self.local_runner = SingleAgentEnvRunner(config, worker_index=0)
+        else:
+            import ray_tpu
+
+            remote_cls = ray_tpu.remote(SingleAgentEnvRunner)
+            self.remote_runners = [
+                remote_cls.options(num_cpus=config.num_cpus_per_env_runner).remote(config, worker_index=i + 1)
+                for i in range(config.num_env_runners)
+            ]
+
+    def spaces(self):
+        if self.local_runner is not None:
+            env = self.local_runner.env
+            return env.single_observation_space, env.single_action_space
+        from ray_tpu.rllib.utils.env import env_spaces
+
+        return env_spaces(self.config)
+
+    def sample(self) -> List[Dict[str, Any]]:
+        if self.local_runner is not None:
+            return [self.local_runner.sample()]
+        import ray_tpu
+
+        return ray_tpu.get([r.sample.remote() for r in self.remote_runners], timeout=300)
+
+    def sync_weights(self, weights, seq: int) -> None:
+        if self.local_runner is not None:
+            self.local_runner.set_weights(weights, seq)
+            return
+        import ray_tpu
+
+        ref = ray_tpu.put(weights)
+        ray_tpu.get([r.set_weights.remote(ref, seq) for r in self.remote_runners])
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        if self.local_runner is not None:
+            self.local_runner.stop()
+        for r in self.remote_runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+
+class Algorithm:
+    """One `train()` call = one training_step (sample → learn → sync)."""
+
+    config_class = AlgorithmConfig
+
+    def __init__(self, config: AlgorithmConfig):
+        from ray_tpu.rllib.core.learner.learner_group import LearnerGroup
+
+        self.config = config
+        self.env_runner_group = EnvRunnerGroup(config)
+        obs_space, action_space = self.env_runner_group.spaces()
+        self.learner_group = LearnerGroup(config, obs_space, action_space)
+        self._iteration = 0
+        self._weights_seq = 0
+        self._env_steps_lifetime = 0
+        self._recent_returns: List[float] = []
+
+    # -- the per-iteration logic; subclasses override ------------------------
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        results = self.training_step()
+        self._iteration += 1
+        results.setdefault("training_iteration", self._iteration)
+        results.setdefault("num_env_steps_sampled_lifetime", self._env_steps_lifetime)
+        results.setdefault("time_this_iter_s", time.monotonic() - t0)
+        return results
+
+    def _fold_sample_metrics(self, samples) -> Dict[str, Any]:
+        steps = sum(s["metrics"]["num_env_steps"] for s in samples)
+        self._env_steps_lifetime += steps
+        for s in samples:
+            self._recent_returns.extend(s["metrics"]["episode_returns"])
+        self._recent_returns = self._recent_returns[-100:]
+        mean_ret = float(np.mean(self._recent_returns)) if self._recent_returns else float("nan")
+        return {
+            "num_env_steps_sampled": steps,
+            "episode_return_mean": mean_ret,
+            "env_runners": {"episode_return_mean": mean_ret},
+        }
+
+    # -- inference -----------------------------------------------------------
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        # cache module + weights across calls; refresh when training moved on
+        if getattr(self, "_infer_cache_seq", None) != self._weights_seq:
+            group = self.env_runner_group
+            self._infer_module = (
+                group.local_runner.module
+                if group.local_runner is not None
+                else self.config.build_module(*group.spaces())
+            )
+            self._infer_weights = self.learner_group.get_weights()
+            self._infer_cache_seq = self._weights_seq
+        module, weights = self._infer_module, self._infer_weights
+        out = module.forward(weights, jnp.asarray(obs, dtype=jnp.float32)[None])
+        if explore:
+            key = jax.random.PRNGKey(int(time.monotonic_ns() % (2**31)))
+            return int(jax.random.categorical(key, out["logits"])[0])
+        return int(jnp.argmax(out["logits"], axis=-1)[0])
+
+    # -- checkpointing (reference: Algorithm.save_to_path / from_checkpoint) --
+    def save_to_path(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        state = {
+            "config": self.config,
+            "learner_state": self.learner_group.get_state(),
+            "iteration": self._iteration,
+            "env_steps_lifetime": self._env_steps_lifetime,
+        }
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return path
+
+    @classmethod
+    def from_checkpoint(cls, path: str) -> "Algorithm":
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        algo = state["config"].algo_class(state["config"])
+        algo.learner_group.set_state(state["learner_state"])
+        algo._iteration = state["iteration"]
+        algo._env_steps_lifetime = state["env_steps_lifetime"]
+        return algo
+
+    def stop(self) -> None:
+        self.env_runner_group.stop()
+        self.learner_group.stop()

@@ -1,0 +1,2 @@
+from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.ppo.ppo_learner import PPOLearner  # noqa: F401

@@ -1,0 +1,57 @@
+"""PPO — Proximal Policy Optimization on the new stack.
+
+Equivalent of the reference's PPO/PPOConfig
+(reference: rllib/algorithms/ppo/ppo.py:405 training_step): sample
+rollout fragments from the EnvRunnerGroup, update the LearnerGroup
+with clipped-surrogate minibatch SGD, broadcast fresh weights back to
+the runners.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo.ppo_learner import PPOLearner
+
+
+class PPOConfig(AlgorithmConfig):
+    learner_class = PPOLearner
+
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+
+
+class PPO(Algorithm):
+    config_class = PPOConfig
+
+    def training_step(self) -> Dict[str, Any]:
+        import numpy as np
+
+        # 1. fresh weights out to the samplers
+        self._weights_seq += 1
+        self.env_runner_group.sync_weights(self.learner_group.get_weights(), self._weights_seq)
+
+        # 2. collect rollouts until train_batch_size env steps
+        samples = []
+        collected = 0
+        while collected < self.config.train_batch_size:
+            round_samples = self.env_runner_group.sample()
+            samples.extend(round_samples)
+            collected += sum(s["metrics"]["num_env_steps"] for s in round_samples)
+
+        keys = samples[0]["batch"].keys()
+        batch = {k: np.concatenate([s["batch"][k] for s in samples], axis=0) for k in keys}
+
+        # 3. learn
+        learner_stats = self.learner_group.update(batch)
+
+        results = self._fold_sample_metrics(samples)
+        results["learner"] = learner_stats
+        return results
+
+
+PPOConfig.algo_class = PPO

@@ -1,0 +1,53 @@
+"""PPOLearner — the clipped-surrogate PPO loss, jitted.
+
+Equivalent of the reference's PPOTorchLearner loss
+(reference: rllib/algorithms/ppo/torch/ppo_torch_learner.py and
+ppo.py:405 training_step). Advantages are normalized per minibatch;
+the value head is trained on GAE value targets with optional clipping.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ray_tpu.rllib.core.learner.learner import Learner
+
+
+class PPOLearner(Learner):
+    def compute_loss(self, params, batch):
+        cfg = self.config
+        out = self.module.forward(params, batch["obs"])
+        logits = out["logits"]
+        vf = out["vf"]
+
+        # numerically stable log-softmax
+        logp_all = logits - jnp.max(logits, axis=-1, keepdims=True)
+        logp_all = logp_all - jnp.log(jnp.sum(jnp.exp(logp_all), axis=-1, keepdims=True))
+        logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=1)[:, 0]
+
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        ratio = jnp.exp(logp - batch["logp_old"])
+        clipped = jnp.clip(ratio, 1.0 - cfg.clip_param, 1.0 + cfg.clip_param)
+        policy_loss = -jnp.mean(jnp.minimum(ratio * adv, clipped * adv))
+
+        vf_err = (vf - batch["value_targets"]) ** 2
+        if cfg.vf_clip_param is not None:
+            vf_clipped = batch["values"] + jnp.clip(
+                vf - batch["values"], -cfg.vf_clip_param, cfg.vf_clip_param
+            )
+            vf_err = jnp.maximum(vf_err, (vf_clipped - batch["value_targets"]) ** 2)
+        vf_loss = 0.5 * jnp.mean(vf_err)
+
+        probs = jnp.exp(logp_all)
+        entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
+
+        total = policy_loss + cfg.vf_loss_coeff * vf_loss - cfg.entropy_coeff * entropy
+        stats = {
+            "total_loss": total,
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "kl": jnp.mean(batch["logp_old"] - logp),
+        }
+        return total, stats

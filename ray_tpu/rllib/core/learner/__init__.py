@@ -1,0 +1,2 @@
+from ray_tpu.rllib.core.learner.learner import Learner  # noqa: F401
+from ray_tpu.rllib.core.learner.learner_group import LearnerGroup  # noqa: F401

@@ -1,0 +1,139 @@
+"""Learner — jax-native gradient updates on an RLModule.
+
+Equivalent of the reference's Learner
+(reference: rllib/core/learner/learner.py:105). Where the reference
+wraps modules in TorchDDPRLModule for multi-GPU allreduce
+(reference: rllib/core/learner/torch/torch_learner.py:384-395), this
+learner is a pure jitted update over a pytree: multi-device data
+parallelism is a `jax.sharding.Mesh` — minibatches shard over the
+'dp' axis, params are replicated, and XLA inserts the gradient psum
+over ICI. No process groups, no DDP wrapper.
+
+Algorithm-specific losses subclass and implement `compute_loss`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class Learner:
+    def __init__(self, config, obs_space=None, action_space=None, mesh=None):
+        import jax
+        import optax
+
+        self.config = config
+        self._jax = jax
+        if obs_space is None or action_space is None:
+            from ray_tpu.rllib.utils.env import env_spaces
+
+            obs_space, action_space = env_spaces(config)
+        self.module = config.build_module(obs_space, action_space)
+        self.params = self.module.init_params(jax.random.PRNGKey(config.seed))
+
+        tx = []
+        if config.grad_clip is not None:
+            tx.append(optax.clip_by_global_norm(config.grad_clip))
+        tx.append(optax.adam(config.lr))
+        self.optimizer = optax.chain(*tx)
+        self.opt_state = self.optimizer.init(self.params)
+        self._np_rng = np.random.default_rng(config.seed + 7)
+
+        self.mesh = mesh
+        self._batch_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            replicated = NamedSharding(mesh, P())
+            self.params = jax.device_put(self.params, replicated)
+            self.opt_state = jax.device_put(self.opt_state, replicated)
+            self._batch_sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+
+        def _update_step(params, opt_state, batch):
+            (loss, stats), grads = jax.value_and_grad(self.compute_loss, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, stats
+
+        def _grad_step(params, batch):
+            (_, stats), grads = jax.value_and_grad(self.compute_loss, has_aux=True)(params, batch)
+            return grads, stats
+
+        def _apply_step(params, opt_state, grads):
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._update_step = jax.jit(_update_step)
+        self._grad_step = jax.jit(_grad_step)
+        self._apply_step = jax.jit(_apply_step)
+
+    # -- algorithm hook ------------------------------------------------------
+    def compute_loss(self, params, batch) -> Tuple[Any, Dict[str, Any]]:
+        raise NotImplementedError
+
+    # -- local update --------------------------------------------------------
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """Run num_epochs of shuffled minibatch SGD over `batch`."""
+        n = len(batch["actions"])
+        mb = min(self.config.minibatch_size, n)
+        if self.mesh is not None:
+            # every device needs an equal shard
+            mb -= mb % self.mesh.devices.size
+        all_stats = []
+        for _ in range(self.config.num_epochs):
+            perm = self._np_rng.permutation(n)
+            for start in range(0, n - mb + 1, mb):
+                idx = perm[start : start + mb]
+                minibatch = {k: v[idx] for k, v in batch.items()}
+                if self._batch_sharding is not None:
+                    minibatch = self._jax.device_put(minibatch, self._batch_sharding)
+                self.params, self.opt_state, stats = self._update_step(self.params, self.opt_state, minibatch)
+                all_stats.append(stats)
+        return {k: float(np.mean([np.asarray(s[k]) for s in all_stats])) for k in all_stats[0]} if all_stats else {}
+
+    # -- distributed (LearnerGroup-coordinated) update -----------------------
+    def shuffled_minibatches(self, batch, num_steps: int):
+        """Deterministic minibatch index plan for lockstep multi-learner SGD."""
+        n = len(batch["actions"])
+        mb = min(self.config.minibatch_size, n)
+        out = []
+        perm = self._np_rng.permutation(n)
+        pos = 0
+        for _ in range(num_steps):
+            if pos + mb > n:
+                perm = self._np_rng.permutation(n)
+                pos = 0
+            out.append(perm[pos : pos + mb])
+            pos += mb
+        return out
+
+    def compute_grads(self, batch: Dict[str, np.ndarray]):
+        grads, stats = self._grad_step(self.params, batch)
+        return self._jax.tree.map(np.asarray, grads), {k: float(np.asarray(v)) for k, v in stats.items()}
+
+    def apply_grads(self, grads) -> None:
+        self.params, self.opt_state = self._apply_step(self.params, self.opt_state, grads)
+
+    # -- weights -------------------------------------------------------------
+    def get_weights(self):
+        return self._jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = self._jax.tree.map(np.asarray, weights)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.params = self._jax.device_put(self.params, NamedSharding(self.mesh, P()))
+
+    def get_state(self):
+        return {
+            "params": self.get_weights(),
+            "opt_state": self._jax.tree.map(np.asarray, self.opt_state),
+        }
+
+    def set_state(self, state) -> None:
+        self.set_weights(state["params"])
+        self.opt_state = self._jax.tree.map(np.asarray, state["opt_state"])
+
+

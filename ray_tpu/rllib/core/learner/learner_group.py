@@ -1,0 +1,146 @@
+"""LearnerGroup — coordinates one local or N remote Learners.
+
+Equivalent of the reference's LearnerGroup
+(reference: rllib/core/learner/learner_group.py:71, "coordinator of n
+possibly-remote Learner workers"). Where the reference's multi-learner
+gradient reduction is torch DDP/NCCL
+(reference: core/learner/torch/torch_learner.py:384-395), here each
+remote jax learner computes grads on its batch shard and the group
+averages the pytrees and applies them in lockstep — params never
+diverge. Intra-learner multi-device reduction is already an XLA psum
+via the Learner's mesh, so "N remote learners" means N hosts, not N
+chips.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class _LearnerActor:
+    """Actor shell around a Learner subclass (runs in a CPU worker)."""
+
+    def __init__(self, learner_cls, config, obs_space, action_space):
+        self.learner = learner_cls(config, obs_space, action_space, mesh=config.build_learner_mesh())
+        self._batch = None
+        self._plan = None
+
+    def set_batch_and_plan(self, batch, num_steps: int):
+        self._batch = batch
+        self._plan = self.learner.shuffled_minibatches(batch, num_steps)
+        return True
+
+    def grad_step(self, step: int):
+        idx = self._plan[step]
+        minibatch = {k: v[idx] for k, v in self._batch.items()}
+        return self.learner.compute_grads(minibatch)
+
+    def apply_grads(self, grads):
+        self.learner.apply_grads(grads)
+        return True
+
+    def update(self, batch):
+        return self.learner.update(batch)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights):
+        self.learner.set_weights(weights)
+        return True
+
+    def get_state(self):
+        return self.learner.get_state()
+
+    def set_state(self, state):
+        self.learner.set_state(state)
+        return True
+
+
+class LearnerGroup:
+    def __init__(self, config, obs_space=None, action_space=None):
+        self.config = config
+        self.num_learners = config.num_learners
+        self._local = None
+        self._workers: List[Any] = []
+        learner_cls = config.learner_class
+        if self.num_learners == 0:
+            mesh = config.build_learner_mesh()
+            self._local = learner_cls(config, obs_space, action_space, mesh=mesh)
+        else:
+            import ray_tpu
+
+            remote_cls = ray_tpu.remote(_LearnerActor)
+            self._workers = [
+                remote_cls.options(num_cpus=config.num_cpus_per_learner).remote(
+                    learner_cls, config, obs_space, action_space
+                )
+                for _ in range(self.num_learners)
+            ]
+
+    # -- update ---------------------------------------------------------------
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if self._local is not None:
+            return self._local.update(batch)
+        import jax
+        import ray_tpu
+
+        n = len(batch["actions"])
+        shard_size = n // len(self._workers)
+        mb = min(self.config.minibatch_size, shard_size)
+        num_steps = self.config.num_epochs * max(1, shard_size // mb)
+        shards = [
+            {k: v[i * shard_size : (i + 1) * shard_size] for k, v in batch.items()}
+            for i in range(len(self._workers))
+        ]
+        ray_tpu.get([w.set_batch_and_plan.remote(s, num_steps) for w, s in zip(self._workers, shards)])
+        all_stats = []
+        for step in range(num_steps):
+            results = ray_tpu.get([w.grad_step.remote(step) for w in self._workers])
+            grads = [g for g, _ in results]
+            all_stats.extend(s for _, s in results)
+            avg = jax.tree.map(lambda *gs: np.mean(np.stack(gs), axis=0), *grads)
+            ray_tpu.get([w.apply_grads.remote(avg) for w in self._workers])
+        return {k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]} if all_stats else {}
+
+    # -- weights / state --------------------------------------------------------
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        import ray_tpu
+
+        return ray_tpu.get(self._workers[0].get_weights.remote())
+
+    def set_weights(self, weights) -> None:
+        if self._local is not None:
+            self._local.set_weights(weights)
+            return
+        import ray_tpu
+
+        ray_tpu.get([w.set_weights.remote(weights) for w in self._workers])
+
+    def get_state(self):
+        if self._local is not None:
+            return self._local.get_state()
+        import ray_tpu
+
+        return ray_tpu.get(self._workers[0].get_state.remote())
+
+    def set_state(self, state) -> None:
+        if self._local is not None:
+            self._local.set_state(state)
+            return
+        import ray_tpu
+
+        ray_tpu.get([w.set_state.remote(state) for w in self._workers])
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self._workers = []

@@ -27,12 +27,24 @@ class RLModule:
 
 class DiscreteMLPModule(RLModule):
     """MLP torso with categorical policy + value heads (the default
-    CartPole-class module; reference analogue: catalog default MLP)."""
+    CartPole-class module; reference analogue: catalog default MLP).
 
-    def __init__(self, obs_dim: int, num_actions: int, hidden=(64, 64)):
-        self.obs_dim = obs_dim
-        self.num_actions = num_actions
-        self.hidden = hidden
+    Implements the module_class contract used by
+    AlgorithmConfig.build_module: __init__(obs_space, action_space,
+    model_config) — model_config keys: "hidden" (tuple of layer widths).
+    """
+
+    def __init__(self, obs_space, action_space, model_config=None):
+        import numpy as np
+
+        if not hasattr(action_space, "n"):
+            raise ValueError(
+                f"DiscreteMLPModule requires a discrete action space, got {action_space}"
+            )
+        model_config = model_config or {}
+        self.obs_dim = int(np.prod(obs_space.shape))
+        self.num_actions = int(action_space.n)
+        self.hidden = tuple(model_config.get("hidden", (64, 64)))
 
     def init_params(self, rng):
         sizes = (self.obs_dim,) + tuple(self.hidden)

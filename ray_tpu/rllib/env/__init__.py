@@ -1,0 +1,2 @@
+from ray_tpu.rllib.env.env_runner import EnvRunner  # noqa: F401
+from ray_tpu.rllib.env.single_agent_env_runner import SingleAgentEnvRunner  # noqa: F401

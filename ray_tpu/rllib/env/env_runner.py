@@ -1,0 +1,26 @@
+"""EnvRunner — the sampling-side worker interface.
+
+Equivalent of the reference's EnvRunner ABC
+(reference: rllib/env/env_runner.py:15). Instances run either inline in
+the driver (num_env_runners=0) or as ray_tpu actors on CPU hosts; the
+learner never steps an environment.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class EnvRunner:
+    def sample(self) -> Dict[str, Any]:
+        """Collect one rollout fragment; returns a flat train batch plus
+        sampling metrics under the "metrics" key."""
+        raise NotImplementedError
+
+    def get_weights(self) -> Any:
+        raise NotImplementedError
+
+    def set_weights(self, weights: Any) -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        pass

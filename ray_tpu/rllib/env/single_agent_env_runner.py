@@ -1,0 +1,165 @@
+"""SingleAgentEnvRunner — vectorized gymnasium sampling.
+
+Equivalent of the reference's SingleAgentEnvRunner
+(reference: rllib/env/single_agent_env_runner.py), jax-native: the
+policy forward is the RLModule's pure function jitted on the host CPU
+(worker processes never grab the TPU — raylet sets JAX_PLATFORMS=cpu),
+actions are sampled with a jax PRNG, and GAE runs here in numpy so the
+learner receives a flat, device-ready batch.
+
+Gymnasium >=1.0 vector envs autoreset in NEXT_STEP mode: the step after
+a terminated/truncated step ignores the action and returns the reset
+observation with reward 0. Those reset frames are masked out of the
+batch (valid = ~prev_done), and the observation returned *at* the done
+step is the true terminal state, so V(next_obs) is correct for
+truncation bootstraps with no special casing.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env.env_runner import EnvRunner
+from ray_tpu.rllib.utils.postprocessing import compute_gae
+
+
+class SingleAgentEnvRunner(EnvRunner):
+    def __init__(self, config, worker_index: int = 0):
+        import jax
+
+        self.config = config
+        self.worker_index = worker_index
+        self._jax = jax
+        self.env = self._make_env(config)
+        self.num_envs = config.num_envs_per_env_runner
+        self.module = config.build_module(self.env.single_observation_space, self.env.single_action_space)
+        self._rng = jax.random.PRNGKey(config.seed + 1000 * (worker_index + 1))
+        self.params = self.module.init_params(self._rng)
+        self._weights_seq = 0
+
+        import jax.numpy as jnp
+
+        def _forward_sample(params, obs, rng):
+            out = self.module.forward(params, obs)
+            logits = out["logits"]
+            action = jax.random.categorical(rng, logits)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, action[:, None], axis=1)[:, 0]
+            return action, logp, out["vf"]
+
+        self._forward = jax.jit(_forward_sample)
+        self._value_fn = jax.jit(lambda params, obs: self.module.forward(params, obs)["vf"])
+
+        seed = config.seed + 10_000 * (worker_index + 1)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._prev_done = np.zeros((self.num_envs,), dtype=bool)
+        # Running per-env episode accounting (survives fragment edges).
+        self._ep_return = np.zeros((self.num_envs,), dtype=np.float64)
+        self._ep_len = np.zeros((self.num_envs,), dtype=np.int64)
+        self._completed_returns: list = []
+        self._completed_lengths: list = []
+
+    @staticmethod
+    def _make_env(config):
+        from ray_tpu.rllib.utils.env import make_vector_env
+
+        return make_vector_env(config)
+
+    # -- weights -----------------------------------------------------------
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights, seq: Optional[int] = None) -> None:
+        self.params = self._jax.tree.map(np.asarray, weights)
+        if seq is not None:
+            self._weights_seq = seq
+
+    # -- sampling ----------------------------------------------------------
+    def sample(self) -> Dict[str, Any]:
+        T = self.config.rollout_fragment_length
+        E = self.num_envs
+        obs_shape = self.env.single_observation_space.shape
+        obs_buf = np.empty((E, T) + obs_shape, dtype=np.float32)
+        act_buf = np.empty((E, T), dtype=np.int64)
+        logp_buf = np.empty((E, T), dtype=np.float32)
+        vf_buf = np.empty((E, T), dtype=np.float32)
+        rew_buf = np.empty((E, T), dtype=np.float32)
+        term_buf = np.zeros((E, T), dtype=bool)
+        done_buf = np.zeros((E, T), dtype=bool)
+        valid_buf = np.zeros((E, T), dtype=bool)
+        next_obs_buf = np.empty((E, T) + obs_shape, dtype=np.float32)
+
+        obs = self._obs
+        prev_done = self._prev_done
+        for t in range(T):
+            self._rng, key = self._jax.random.split(self._rng)
+            action, logp, vf = self._forward(self.params, obs.astype(np.float32), key)
+            action = np.asarray(action)
+            obs_buf[:, t] = obs
+            act_buf[:, t] = action
+            logp_buf[:, t] = np.asarray(logp)
+            vf_buf[:, t] = np.asarray(vf)
+            valid_buf[:, t] = ~prev_done
+
+            next_obs, reward, terminated, truncated, _ = self.env.step(action)
+            done = terminated | truncated
+            rew_buf[:, t] = reward
+            term_buf[:, t] = terminated
+            done_buf[:, t] = done
+            next_obs_buf[:, t] = next_obs
+
+            live = ~prev_done
+            self._ep_return[live] += reward[live]
+            self._ep_len[live] += 1
+            for e in np.nonzero(done & live)[0]:
+                self._completed_returns.append(float(self._ep_return[e]))
+                self._completed_lengths.append(int(self._ep_len[e]))
+                self._ep_return[e] = 0.0
+                self._ep_len[e] = 0
+            # Envs that were reset this step (prev_done) start fresh now.
+            self._ep_return[prev_done] = 0.0
+            self._ep_len[prev_done] = 0
+
+            obs = next_obs
+            prev_done = done
+        self._obs = obs
+        self._prev_done = prev_done
+
+        # next_values[e,t] = V(obs returned at t) — the true next state,
+        # terminal states included (masked by `terminateds` inside GAE).
+        flat_next = next_obs_buf.reshape((E * T,) + obs_shape).astype(np.float32)
+        next_values = np.asarray(self._value_fn(self.params, flat_next)).reshape(E, T)
+        advantages, value_targets = compute_gae(
+            rew_buf,
+            vf_buf,
+            next_values,
+            term_buf,
+            done_buf,
+            gamma=self.config.gamma,
+            lambda_=self.config.lambda_,
+        )
+
+        mask = valid_buf.reshape(-1)
+        batch = {
+            "obs": obs_buf.reshape((E * T,) + obs_shape)[mask],
+            "actions": act_buf.reshape(-1)[mask],
+            "logp_old": logp_buf.reshape(-1)[mask],
+            "values": vf_buf.reshape(-1)[mask],
+            "advantages": advantages.reshape(-1)[mask],
+            "value_targets": value_targets.reshape(-1)[mask],
+        }
+        # report-and-clear: each completed episode is reported exactly once;
+        # smoothing over a trailing window happens in the Algorithm.
+        metrics = {
+            "num_env_steps": int(mask.sum()),
+            "episode_returns": self._completed_returns,
+            "episode_lengths": self._completed_lengths,
+            "weights_seq": self._weights_seq,
+        }
+        self._completed_returns = []
+        self._completed_lengths = []
+        return {"batch": batch, "metrics": metrics}
+
+    def stop(self) -> None:
+        self.env.close()

@@ -1,0 +1,39 @@
+"""Env construction helpers shared by runners, learners and the algorithm.
+
+One place for the callable-vs-registry branch so env instantiation can't
+drift between the spaces probe and the actual sampling envs
+(reference: rllib env creation via gym.make / EnvContext in
+rllib/env/utils.py).
+"""
+from __future__ import annotations
+
+
+def make_single_env(config):
+    import gymnasium as gym
+
+    if callable(config.env):
+        return config.env(config.env_config)
+    return gym.make(config.env, **(config.env_config or {}))
+
+
+def make_vector_env(config):
+    import gymnasium as gym
+
+    if callable(config.env):
+        return gym.vector.SyncVectorEnv(
+            [lambda: config.env(config.env_config) for _ in range(config.num_envs_per_env_runner)]
+        )
+    return gym.make_vec(
+        config.env,
+        num_envs=config.num_envs_per_env_runner,
+        vectorization_mode="sync",
+        **(config.env_config or {}),
+    )
+
+
+def env_spaces(config):
+    """(observation_space, action_space) from one throwaway env."""
+    env = make_single_env(config)
+    spaces = (env.observation_space, env.action_space)
+    env.close()
+    return spaces

@@ -1,0 +1,46 @@
+"""Trajectory postprocessing: GAE advantages / value targets.
+
+Equivalent of the reference's GAE learner connector
+(reference: rllib/connectors/learner/general_advantage_estimation.py and
+rllib/evaluation/postprocessing.py compute_advantages). Pure numpy —
+runs on the env-runner host right after sampling, so the learner batch
+arrives flat and device-ready.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def compute_gae(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    next_values: np.ndarray,
+    terminateds: np.ndarray,
+    dones: np.ndarray,
+    gamma: float = 0.99,
+    lambda_: float = 0.95,
+):
+    """Generalized Advantage Estimation over [num_envs, T] arrays.
+
+    The caller supplies `next_values[e, t] = V(s_{t+1})` with truncation
+    handled: at a truncated step it must be V(final_observation) (the
+    state the time limit cut, not the auto-reset state); at a terminated
+    step its value is irrelevant (masked to 0 by `terminateds`). `dones`
+    = terminated | truncated resets the lambda-trace so no credit leaks
+    across episode boundaries.
+
+    Returns (advantages, value_targets), both [num_envs, T] float32.
+    """
+    rewards = rewards.astype(np.float32)
+    values = values.astype(np.float32)
+    num_envs, horizon = rewards.shape
+    advantages = np.zeros((num_envs, horizon), dtype=np.float32)
+    not_done = 1.0 - dones.astype(np.float32)
+    not_terminated = 1.0 - terminateds.astype(np.float32)
+    last_gae = np.zeros((num_envs,), dtype=np.float32)
+    for t in range(horizon - 1, -1, -1):
+        delta = rewards[:, t] + gamma * next_values[:, t] * not_terminated[:, t] - values[:, t]
+        last_gae = delta + gamma * lambda_ * not_done[:, t] * last_gae
+        advantages[:, t] = last_gae
+    value_targets = advantages + values
+    return advantages, value_targets

@@ -1,0 +1,122 @@
+"""RLlib tests: PPO learning, GAE, distributed sampling/learning, checkpoints.
+
+Models the reference's per-algorithm learning tests
+(reference: rllib/algorithms/ppo/tests/test_ppo.py — train CartPole to
+a target return) plus unit coverage for the postprocessing math.
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_imports():
+    import ray_tpu.rllib as rllib
+
+    assert rllib.PPO is not None
+    assert rllib.PPOConfig is not None
+    assert rllib.Learner is not None
+    assert rllib.LearnerGroup is not None
+    assert rllib.EnvRunner is not None
+    assert rllib.SingleAgentEnvRunner is not None
+    assert rllib.RLModule is not None
+
+
+def test_gae_matches_reference_recursion():
+    from ray_tpu.rllib.utils.postprocessing import compute_gae
+
+    rng = np.random.default_rng(0)
+    T = 12
+    rewards = rng.normal(size=(1, T)).astype(np.float32)
+    values = rng.normal(size=(1, T)).astype(np.float32)
+    next_values = rng.normal(size=(1, T)).astype(np.float32)
+    term = np.zeros((1, T), bool)
+    term[0, 5] = True
+    done = term.copy()
+    gamma, lam = 0.97, 0.9
+
+    adv, targets = compute_gae(rewards, values, next_values, term, done, gamma, lam)
+
+    # brute-force per-episode reference
+    expected = np.zeros(T, np.float32)
+    last = 0.0
+    for t in range(T - 1, -1, -1):
+        boot = 0.0 if term[0, t] else next_values[0, t]
+        delta = rewards[0, t] + gamma * boot - values[0, t]
+        last = delta + gamma * lam * (0.0 if done[0, t] else 1.0) * last
+        expected[t] = last
+    np.testing.assert_allclose(adv[0], expected, rtol=1e-5)
+    np.testing.assert_allclose(targets[0], expected + values[0], rtol=1e-5)
+
+
+def test_ppo_cartpole_local():
+    """PPO solves CartPole (>=450/500) in-process — no cluster needed."""
+    from ray_tpu.rllib import PPOConfig
+
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=16, rollout_fragment_length=128)
+        .training(lr=3e-4, train_batch_size=2048, minibatch_size=128, num_epochs=6)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    best = -np.inf
+    for _ in range(80):
+        result = algo.train()
+        best = max(best, result["episode_return_mean"])
+        if best >= 450.0:
+            break
+    algo.stop()
+    assert best >= 450.0, f"PPO failed to reach 450 on CartPole (best {best})"
+
+
+def test_ppo_distributed_smoke(ray_start_regular):
+    """Remote EnvRunner actors + a remote Learner actor: weights flow out,
+    batches flow back, return improves over random (~22)."""
+    from ray_tpu.rllib import PPOConfig
+
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=8, rollout_fragment_length=64)
+        .learners(num_learners=1)
+        .training(lr=3e-4, train_batch_size=1024, minibatch_size=128, num_epochs=4)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    last = 0.0
+    for _ in range(10):
+        result = algo.train()
+        last = result["episode_return_mean"]
+    algo.stop()
+    assert result["num_env_steps_sampled_lifetime"] >= 10 * 1024
+    assert last > 40.0, f"distributed PPO did not improve over random ({last})"
+
+
+def test_ppo_checkpoint_restore(tmp_path):
+    from ray_tpu.rllib import PPO, PPOConfig
+
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=4, rollout_fragment_length=32)
+        .training(train_batch_size=128, minibatch_size=64, num_epochs=1)
+        .debugging(seed=1)
+    )
+    algo = config.build()
+    algo.train()
+    algo.train()
+    path = algo.save_to_path(str(tmp_path / "ckpt"))
+    weights = algo.learner_group.get_weights()
+    algo.stop()
+
+    restored = PPO.from_checkpoint(path)
+    assert restored._iteration == 2
+    rw = restored.learner_group.get_weights()
+    import jax
+
+    for a, b in zip(jax.tree.leaves(weights), jax.tree.leaves(rw)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    restored.train()  # resumes cleanly
+    restored.stop()
