@@ -40,6 +40,14 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _settle(seconds: float = 4.0):
+    """Wait out background churn (worker prestart/import storms). The
+    bench box has ONE core, so a worker importing numpy in the background
+    halves every number measured meanwhile — observed 0.4 vs 1.3 GiB/s on
+    put bandwidth with/without the settle."""
+    time.sleep(seconds)
+
+
 def bench_runtime(extra):
     import numpy as np
 
@@ -58,53 +66,10 @@ def bench_runtime(extra):
     ray_tpu.get(a.ping.remote())
     for _ in range(200):
         ray_tpu.get(a.ping.remote())
+    _settle()
 
-    N = 3000
-    t0 = time.perf_counter()
-    for _ in range(N):
-        ray_tpu.get(a.ping.remote())
-    sync_rate = N / (time.perf_counter() - t0)
-    extra["actor_calls_sync_1to1"] = round(sync_rate, 1)
-    log(f"[bench] 1:1 sync actor calls: {sync_rate:.0f}/s (baseline {BASELINES['actor_calls_sync_1to1']:.0f})")
-
-    t0 = time.perf_counter()
-    ray_tpu.get([a.ping.remote() for _ in range(N)])
-    r = N / (time.perf_counter() - t0)
-    extra["actor_calls_async_1to1"] = round(r, 1)
-    log(f"[bench] 1:1 async actor calls: {r:.0f}/s (baseline {BASELINES['actor_calls_async_1to1']:.0f})")
-
-    # n:n — 4 caller actors each driving their own callee
-    @ray_tpu.remote
-    class Caller:
-        def __init__(self):
-            self.target = Echo.remote()
-            ray_tpu.get(self.target.ping.remote())
-
-        def drive(self, n):
-            ray_tpu.get([self.target.ping.remote() for _ in range(n)])
-            return n
-
-    callers = [Caller.remote() for _ in range(4)]
-    ray_tpu.get([c.drive.remote(10) for c in callers])
-    t0 = time.perf_counter()
-    per = 1000
-    ray_tpu.get([c.drive.remote(per) for c in callers])
-    r = 4 * per / (time.perf_counter() - t0)
-    extra["actor_calls_async_nn"] = round(r, 1)
-    log(f"[bench] n:n async actor calls: {r:.0f}/s (baseline {BASELINES['actor_calls_async_nn']:.0f})")
-
-    @ray_tpu.remote
-    def noop():
-        return None
-
-    ray_tpu.get(noop.remote())
-    t0 = time.perf_counter()
-    ray_tpu.get([noop.remote() for _ in range(1000)])
-    r = 1000 / (time.perf_counter() - t0)
-    extra["tasks_async"] = round(r, 1)
-    log(f"[bench] async tasks: {r:.0f}/s")
-
-    # put throughput (small objects) + bandwidth (large objects)
+    # put throughput + bandwidth FIRST: the later benches fork worker
+    # storms whose imports would otherwise contend with the memcpys
     small = b"x" * 1024
     for _ in range(50):
         ray_tpu.put(small)
@@ -125,6 +90,20 @@ def bench_runtime(extra):
     extra["put_gib_per_s"] = round(gib, 2)
     log(f"[bench] put bandwidth: {gib:.1f} GiB/s (baseline {BASELINES['put_gib_per_s']})")
 
+    N = 3000
+    t0 = time.perf_counter()
+    for _ in range(N):
+        ray_tpu.get(a.ping.remote())
+    sync_rate = N / (time.perf_counter() - t0)
+    extra["actor_calls_sync_1to1"] = round(sync_rate, 1)
+    log(f"[bench] 1:1 sync actor calls: {sync_rate:.0f}/s (baseline {BASELINES['actor_calls_sync_1to1']:.0f})")
+
+    t0 = time.perf_counter()
+    ray_tpu.get([a.ping.remote() for _ in range(N)])
+    r = N / (time.perf_counter() - t0)
+    extra["actor_calls_async_1to1"] = round(r, 1)
+    log(f"[bench] 1:1 async actor calls: {r:.0f}/s (baseline {BASELINES['actor_calls_async_1to1']:.0f})")
+
     # placement group churn
     from ray_tpu.util.placement_group import placement_group, remove_placement_group
 
@@ -137,6 +116,42 @@ def bench_runtime(extra):
     r = n_pg / (time.perf_counter() - t0)
     extra["pg_per_s"] = round(r, 1)
     log(f"[bench] PG create+remove: {r:.0f}/s (baseline {BASELINES['pg_per_s']:.0f})")
+
+    _settle()
+
+    # n:n — 4 caller actors each driving their own callee
+    @ray_tpu.remote
+    class Caller:
+        def __init__(self):
+            self.target = Echo.remote()
+            ray_tpu.get(self.target.ping.remote())
+
+        def drive(self, n):
+            ray_tpu.get([self.target.ping.remote() for _ in range(n)])
+            return n
+
+    callers = [Caller.remote() for _ in range(4)]
+    ray_tpu.get([c.drive.remote(10) for c in callers])
+    _settle()
+    t0 = time.perf_counter()
+    per = 1000
+    ray_tpu.get([c.drive.remote(per) for c in callers])
+    r = 4 * per / (time.perf_counter() - t0)
+    extra["actor_calls_async_nn"] = round(r, 1)
+    log(f"[bench] n:n async actor calls: {r:.0f}/s (baseline {BASELINES['actor_calls_async_nn']:.0f})")
+
+    _settle()
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ray_tpu.get(noop.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([noop.remote() for _ in range(1000)])
+    r = 1000 / (time.perf_counter() - t0)
+    extra["tasks_async"] = round(r, 1)
+    log(f"[bench] async tasks: {r:.0f}/s")
 
     ray_tpu.shutdown()
 

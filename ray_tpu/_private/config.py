@@ -23,7 +23,13 @@ _DEFS: Dict[str, Any] = {
     # --- scheduler ---
     "worker_lease_timeout_s": 30.0,
     "lease_idle_timeout_s": 1.0,  # direct-dispatch lease linger before release
-    "max_leases_per_shape": 16,  # cap on concurrently leased workers per resource shape
+    # cap on concurrently leased workers per resource shape: physical
+    # cores, not queue depth — a leased worker past the core count only
+    # adds context-switch overhead (measured 15k vs 5.5k noop tasks/s on
+    # a 1-core box with 2 vs 16 leases); logical num_cpus is admission
+    # control and can legitimately exceed cores
+    "max_leases_per_shape": max(2, os.cpu_count() or 4),
+    "actor_call_batch_max": 16,  # pipelined actor calls coalesced per wire message
     "worker_pool_prestart": 2,
     "worker_pool_max_idle": 8,
     "scheduler_spread_threshold": 0.5,

@@ -117,6 +117,8 @@ class CoreWorker:
         self._shapes: Dict[tuple, _ShapeState] = {}
         self._direct_inflight: Dict[str, protocol.Connection] = {}  # task_id -> worker conn
         self._owned_pending: List[bytes] = []
+        self._owned: set = set()  # oids this worker CREATED (owns)
+        self._gcs_registered: set = set()  # owned oids the directory knows
         self._owned_flush_scheduled = False
         # batched driver-thread → IO-loop posts: call_soon_threadsafe wakes
         # the loop through a self-pipe write (~20µs); one wakeup covers
@@ -297,12 +299,21 @@ class CoreWorker:
             if self.executor is None:
                 raise RuntimeError("not an executor worker")
             return await self.executor.handle_actor_call(data, conn)
+        if method == "call.actors":
+            # coalesced pipelined calls from one caller (batched sender)
+            if self.executor is None:
+                raise RuntimeError("not an executor worker")
+            return await self.executor.handle_actor_calls(data, conn)
         if method == "call.task":
             # direct normal-task dispatch from a lease-holding owner
             # (reference: PushNormalTask onto a leased worker)
             if self.executor is None:
                 raise RuntimeError("not an executor worker")
             return await self.executor.handle_direct_task(data)
+        if method == "call.tasks":
+            if self.executor is None:
+                raise RuntimeError("not an executor worker")
+            return await self.executor.handle_direct_tasks(data)
         if method == "exec.cancel":
             if self.executor is not None:
                 self.executor.cancel(data["task_id"], data.get("force", False))
@@ -363,7 +374,11 @@ class CoreWorker:
         if isinstance(value, ObjectRef):
             raise TypeError("put of an ObjectRef is not allowed")
         oid = new_id()
-        pickled, buffers, _ = serialization.serialize(value)
+        with self._store_lock:
+            self._owned.add(oid)
+        pickled, buffers, refs = serialization.serialize(value)
+        if refs:
+            self._ensure_registered([r.binary() for r in refs])
         total = serialization.serialized_size(pickled, buffers)
         if total <= RayConfig.object_store_inline_max_bytes or self._shm is None:
             data = bytearray(total)
@@ -379,6 +394,8 @@ class CoreWorker:
             env = _env_shm(self.node_id, total)
             self._deliver(oid, env)
             self._push_gcs("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": total})
+        with self._store_lock:
+            self._gcs_registered.add(oid)
         return ObjectRef(oid)
 
     def _push_gcs(self, method: str, data):
@@ -647,6 +664,8 @@ class CoreWorker:
         oids = [r.binary() for r in refs]
         for oid in oids:
             self._store.pop(oid, None)
+            self._gcs_registered.discard(oid)
+            self._owned.discard(oid)
             buf = self._pinned.pop(oid, None)
             if buf is not None:
                 buf.release()
@@ -658,7 +677,11 @@ class CoreWorker:
     def export_function(self, fn) -> str:
         import hashlib
 
-        blob = serialization.dumps_function(fn)
+        blob, refs = serialization.dumps_function(fn)
+        if refs:
+            # ObjectRefs captured in the function's closure are resolvable
+            # by any executor loading it — register them like shared args
+            self._ensure_registered([r.binary() for r in refs])
         fn_id = hashlib.sha256(blob).hexdigest()[:32]
         if fn_id not in self._exported_fns:
             self._call(self._gcs.request("fn.put", {"fn_id": fn_id, "blob": blob}))
@@ -686,8 +709,13 @@ class CoreWorker:
 
     def _pack_one(self, value):
         if isinstance(value, ObjectRef):
+            # the executor will resolve this ref: the directory must know us
+            self._ensure_registered([value.binary()])
             return {"r": value.binary()}
-        pickled, buffers, _ = serialization.serialize(value)
+        pickled, buffers, refs = serialization.serialize(value)
+        if refs:
+            # refs nested inside the value can be resolved by the receiver
+            self._ensure_registered([r.binary() for r in refs])
         total = serialization.serialized_size(pickled, buffers)
         if total <= RayConfig.object_store_inline_max_bytes or self._shm is None:
             data = bytearray(total)
@@ -697,6 +725,9 @@ class CoreWorker:
         oid = new_id()
         env = self.put_serialized_to_shm(oid, pickled, buffers)
         self._deliver(oid, env)
+        with self._store_lock:
+            self._owned.add(oid)
+            self._gcs_registered.add(oid)  # add_location created the record
         return {"r": oid}
 
     def unpack_args(self, packed: Dict[str, Any]):
@@ -738,14 +769,58 @@ class CoreWorker:
         }
         for oid in returns:
             self._make_pending(oid)
+        with self._store_lock:
+            self._owned.update(returns)
         self._submitted[spec["task_id"]] = {"spec": spec, "retries_left": spec.get("max_retries", 0)}
         if self._direct_eligible(spec):
-            self._post(lambda: self._direct_submit(spec))
+            deps = [
+                bytes(p["r"])
+                for p in list(spec["args"]["a"]) + list(spec["args"]["kw"].values())
+                if "r" in p
+            ]
+            if deps:
+                # resolve dependencies owner-side BEFORE pushing to a leased
+                # worker (reference: transport/dependency_resolver.cc). A
+                # worker-side blocking resolve can deadlock: with batched
+                # dispatch the consumer would run in the same executor job
+                # as its producers, whose results only ship in the batch
+                # reply after the consumer finishes.
+                self._post(lambda: self._loop.create_task(self._deps_then_direct(spec, deps)))
+            else:
+                self._post(lambda: self._direct_submit(spec))
         else:
             self._post(
                 lambda: self._loop.create_task(self._gcs.request("task.submit", {"spec": spec}))
             )
         return [ObjectRef(oid) for oid in returns]
+
+    async def _deps_then_direct(self, spec, deps):
+        """Wait until every ref arg is locally known, inline the small
+        ones into the spec, then direct-dispatch. Refs we neither own nor
+        hold locally go to the central scheduler instead (it owns
+        cross-process dependency placement)."""
+        for oid in deps:
+            fut = self._awaitable_for(oid)
+            if fut is not None:
+                env = await fut
+                if env.get("k") == "e":
+                    # a dependency failed: the task inherits its error
+                    # without ever dispatching (reference: task args with
+                    # errors propagate RayTaskError to the child)
+                    self._fail_call(spec, self._rebuild_error(env))
+                    self._submitted.pop(spec["task_id"], None)
+                    return
+            elif oid not in self._store:
+                await self._gcs.request("task.submit", {"spec": spec})
+                return
+        for p in list(spec["args"]["a"]) + list(spec["args"]["kw"].values()):
+            oid = p.get("r")
+            if oid is not None:
+                env = self._store.get(bytes(oid))
+                if env is not None and env.get("k") == "i":
+                    del p["r"]
+                    p["v"] = env["d"]
+        self._direct_submit(spec)
 
     # ------------------------------------------------- direct task dispatch
     # Owner-side worker leases: repeated small tasks skip the central
@@ -780,6 +855,29 @@ class CoreWorker:
             self._owned_flush_scheduled = True
             self._loop.call_soon(self._flush_owned)
 
+    def _ensure_registered(self, oids):
+        """Share-time ownership registration (any thread). The directory
+        only needs a record once a ref can be resolved by ANOTHER process
+        — i.e. when it crosses a process boundary inside args or a put
+        value. Registering returns eagerly at submit time cost a GCS push
+        per call on the hot path (reference keeps ownership in the owner
+        and populates the directory lazily too: ownership-based object
+        directory, reference_count.h ownership model).
+
+        Only oids this worker CREATED are registered: a borrower passing a
+        ref on must NOT claim it (the true owner registered it when the
+        ref first escaped, and obj.register_owned overwrites the owner
+        field)."""
+        need = []
+        with self._store_lock:
+            for oid in oids:
+                if oid in self._gcs_registered or oid not in self._owned:
+                    continue
+                self._gcs_registered.add(oid)
+                need.append(oid)
+        if need:
+            self._loop.call_soon_threadsafe(self._register_owned, need)
+
     def _flush_owned(self):
         self._owned_flush_scheduled = False
         if not self._owned_pending:
@@ -788,8 +886,10 @@ class CoreWorker:
         self._loop.create_task(self._gcs.push("obj.register_owned", {"oids": oids}))
 
     def _direct_submit(self, spec):
-        """Loop-side: enqueue on the shape queue and size the lease pool."""
-        self._register_owned(spec["returns"])
+        """Loop-side: enqueue on the shape queue and size the lease pool.
+        Return oids are NOT registered with the directory here — results
+        ride the reply back to this owner, and a ref that escapes to
+        another process registers at share time (_ensure_registered)."""
         key = self._shape_key(spec)
         st = self._shapes.get(key)
         if st is None:
@@ -803,6 +903,13 @@ class CoreWorker:
         st.event.set()
         self._grow_leases(key, st)
 
+    def _fallback_to_gcs(self, st: "_ShapeState"):
+        """Hand the backlog to the central scheduler when no lease will
+        drain it (denial window / no direct capacity / connect failure)."""
+        while st.queue:
+            spec = st.queue.popleft()
+            self._loop.create_task(self._gcs.request("task.submit", {"spec": spec}))
+
     def _grow_leases(self, key, st: _ShapeState):
         target = min(len(st.queue), RayConfig.max_leases_per_shape)
         if time.monotonic() < st.denied_until:
@@ -811,10 +918,7 @@ class CoreWorker:
             st.acquiring += 1
             self._loop.create_task(self._acquire_lease(key, st))
         if st.queue and not st.leases and not st.acquiring:
-            # nothing will drain this queue (denial window): GCS fallback
-            while st.queue:
-                spec = st.queue.popleft()
-                self._loop.create_task(self._gcs.request("task.submit", {"spec": spec}))
+            self._fallback_to_gcs(st)
 
     async def _raylet(self) -> protocol.Connection:
         if self._raylet_conn is None or self._raylet_conn.closed:
@@ -837,9 +941,7 @@ class CoreWorker:
             if not st.leases and st.acquiring == 0:
                 # no direct capacity at all: hand the backlog to the
                 # central scheduler (cross-node placement lives there)
-                while st.queue:
-                    spec = st.queue.popleft()
-                    self._loop.create_task(self._gcs.request("task.submit", {"spec": spec}))
+                self._fallback_to_gcs(st)
             return
         lease_id = reply["lease_id"]
         try:
@@ -849,21 +951,27 @@ class CoreWorker:
                 await (await self._raylet()).request("lease.release", {"lease_id": lease_id})
             except Exception:
                 pass
+            # the granted worker was unreachable; without this the queue
+            # strands (nothing re-triggers _grow_leases for it)
+            st.denied_until = time.monotonic() + 0.5
+            if not st.leases and st.acquiring == 0:
+                self._fallback_to_gcs(st)
             return
         st.leases.add(lease_id)
         self._loop.create_task(self._lease_drain(key, st, lease_id, conn))
 
     async def _lease_drain(self, key, st: _ShapeState, lease_id: str, conn):
         """One leased worker: drain the shape queue with a small pipeline
-        window (the worker executes serially; the window hides wire +
-        event-loop latency). Lingers briefly when idle, then gives the
-        worker back."""
-        window: collections.deque = collections.deque()  # (spec, reply_fut)
+        window of BATCHES (a backlog coalesces into call.tasks messages —
+        one wire message + one executor hop per batch; the window hides
+        wire + event-loop latency). Lingers briefly when idle, then gives
+        the worker back."""
+        window: collections.deque = collections.deque()  # (specs_batch, reply_fut)
 
         async def _worker_died(extra_specs):
             # everything sent (or about to send) may have executed — spend
             # a retry each and fall back to the central scheduler
-            for spec in [s for s, _ in window] + list(extra_specs):
+            for spec in [s for b, _ in window for s in b] + list(extra_specs):
                 tid = spec["task_id"]
                 self._direct_inflight.pop(tid, None)
                 rec = self._submitted.get(tid)
@@ -880,18 +988,26 @@ class CoreWorker:
         try:
             while True:
                 while st.queue and len(window) < 4:
-                    spec = st.queue.popleft()
-                    if spec.get("cancelled"):
-                        self._fail_call(spec, exceptions.TaskCancelledError(spec.get("name", "")))
-                        self._submitted.pop(spec["task_id"], None)
-                        continue
-                    self._direct_inflight[spec["task_id"]] = conn
+                    batch = []
+                    while st.queue and len(batch) < 8:
+                        spec = st.queue.popleft()
+                        if spec.get("cancelled"):
+                            self._fail_call(spec, exceptions.TaskCancelledError(spec.get("name", "")))
+                            self._submitted.pop(spec["task_id"], None)
+                            continue
+                        self._direct_inflight[spec["task_id"]] = conn
+                        batch.append(spec)
+                    if not batch:
+                        break
                     try:
-                        fut = await conn.request_send("call.task", {"spec": spec})
+                        if len(batch) == 1:
+                            fut = await conn.request_send("call.task", {"spec": batch[0]})
+                        else:
+                            fut = await conn.request_send("call.tasks", {"specs": batch})
                     except (protocol.ConnectionLost, OSError):
-                        await _worker_died([spec])
+                        await _worker_died(batch)
                         return  # lease is dead (raylet reap credits the resources)
-                    window.append((spec, fut))
+                    window.append((batch, fut))
                 if not window:
                     st.event.clear()
                     if not st.queue:  # re-check after clear (no await between)
@@ -900,22 +1016,23 @@ class CoreWorker:
                         except asyncio.TimeoutError:
                             return
                     continue
-                spec, fut = window.popleft()
-                task_id = spec["task_id"]
+                batch, fut = window.popleft()
                 try:
                     reply = await fut
                 except (protocol.ConnectionLost, OSError):
-                    await _worker_died([spec])
+                    await _worker_died(batch)
                     return  # lease is dead (raylet reap credits the resources)
                 except Exception as e:
-                    self._direct_inflight.pop(task_id, None)
-                    self._fail_call(spec, e)
-                    self._submitted.pop(task_id, None)
+                    for spec in batch:
+                        self._direct_inflight.pop(spec["task_id"], None)
+                        self._fail_call(spec, e)
+                        self._submitted.pop(spec["task_id"], None)
                     continue
-                self._direct_inflight.pop(task_id, None)
+                for spec in batch:
+                    self._direct_inflight.pop(spec["task_id"], None)
+                    self._submitted.pop(spec["task_id"], None)
                 for item in reply["results"]:
                     self._deliver(bytes(item["oid"]), item["env"])
-                self._submitted.pop(task_id, None)
         finally:
             st.leases.discard(lease_id)
             try:
@@ -979,6 +1096,8 @@ class CoreWorker:
         }
         for oid in returns:
             self._make_pending(oid)
+        with self._store_lock:
+            self._owned.update(returns)
         # fire-and-forget enqueue: the caller holds refs whose cells are
         # already waitable; the loop does the sending
         self._post(lambda: self._enqueue_actor_call(spec, max_task_retries))
@@ -993,10 +1112,9 @@ class CoreWorker:
         sender = self._actor_senders.get(actor_id)
         if sender is None or sender.done():
             self._actor_senders[actor_id] = self._loop.create_task(self._actor_sender_loop(actor_id))
-        # ownership registration is fire-and-forget: the directory only
-        # needs it before some *other* process resolves the ref, and the
-        # push rides the same ordered GCS stream (micro-batched per loop tick)
-        self._register_owned(spec["returns"])
+        # return oids register with the directory lazily at share time
+        # (results ride the reply back; a per-call GCS push here was a
+        # third of the hot path's syscalls)
 
     def _fail_call(self, spec, exc: BaseException):
         err = _env_err(exc)
@@ -1051,26 +1169,56 @@ class CoreWorker:
                 self._fail_call(spec, e)
                 continue
 
+            # coalesce a backlog into one wire message (amortizes framing,
+            # syscalls and loop wakeups; engages only under pipelining —
+            # a lone call still goes out immediately as call.actor). A
+            # call whose args reference one of OUR still-pending objects
+            # must not share a batch with its producer: the batch reply
+            # (which delivers the producer's result) only ships after the
+            # whole batch executes, so the consumer's arg resolve would
+            # deadlock. Such calls go out as singletons — their worker-side
+            # resolve then overlaps with earlier in-flight replies.
+            def _has_pending_dep(s):
+                with self._store_lock:
+                    return any(
+                        "r" in p and bytes(p["r"]) in self._pending and bytes(p["r"]) in self._owned
+                        for p in list(s["args"]["a"]) + list(s["args"]["kw"].values())
+                    )
+
+            batch = [q.popleft()]
+            if not _has_pending_dep(batch[0][0]):
+                while q and len(batch) < RayConfig.actor_call_batch_max:
+                    if _has_pending_dep(q[0][0]):
+                        break
+                    batch.append(q.popleft())
             try:
-                reply_fut = await conn.request_send("call.actor", {"spec": spec})
+                if len(batch) == 1:
+                    reply_fut = await conn.request_send("call.actor", {"spec": batch[0][0]})
+                else:
+                    reply_fut = await conn.request_send(
+                        "call.actors", {"specs": [s for s, _ in batch]}
+                    )
             except (protocol.ConnectionLost, OSError):
+                # pre-send failure: nothing executed, requeue in order and
+                # wait out the restart (consumes no retries)
+                for item in reversed(batch):
+                    q.appendleft(item)
                 self._actor_addr_cache.pop(actor_id, None)
                 await asyncio.sleep(0.1)
                 continue
-            q.popleft()
             # deliver on the reply callback; only failures spawn a task
             # (a Task per call costs more than the delivery itself)
             reply_fut.add_done_callback(
-                lambda fut, s=spec, r=retries_left: self._on_actor_reply(actor_id, s, r, fut)
+                lambda fut, b=batch: self._on_actor_reply(actor_id, b, fut)
             )
         self._actor_senders.pop(actor_id, None)
 
-    def _on_actor_reply(self, actor_id: str, spec, retries_left: int, fut):
+    def _on_actor_reply(self, actor_id: str, batch, fut):
         exc = fut.exception() if not fut.cancelled() else None
         if fut.cancelled() or exc is not None:
-            asyncio.get_running_loop().create_task(
-                self._actor_reply_failed(actor_id, spec, retries_left, exc)
-            )
+            loop = asyncio.get_running_loop()
+            for spec, retries_left in batch:
+                loop.create_task(self._actor_reply_failed(actor_id, spec, retries_left, exc))
             return
         for item in fut.result()["results"]:
             self._deliver(bytes(item["oid"]), item["env"])

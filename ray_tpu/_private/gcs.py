@@ -767,8 +767,14 @@ class GcsServer:
     async def _rpc_obj_register_owned(self, d, conn):
         owner = self.conn_client.get(conn)
         for oid in d["oids"]:
-            if oid not in self.objects:
+            rec = self.objects.get(oid)
+            if rec is None:
                 self.objects[oid] = {"owner": owner, "inline": None, "locations": set(), "size": 0}
+            else:
+                # a location push (e.g. the executing worker sealing a large
+                # result) may have created the record first with itself as
+                # placeholder owner; the registering owner is authoritative
+                rec["owner"] = owner
         return True
 
     async def _rpc_obj_put_inline(self, d, conn):

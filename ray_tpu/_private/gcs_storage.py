@@ -1,0 +1,105 @@
+"""GCS table persistence: snapshot + append-only WAL in the session dir.
+
+Equivalent of the reference's Redis-backed GCS storage
+(reference: src/ray/gcs/store_client/redis_store_client.cc; restart
+replay of GcsInitData in gcs_server.cc, exercised by
+gcs_client_reconnection_test.cc). Instead of an external Redis, the
+durable tables (kv, function table, actors, named actors, placement
+groups, jobs) append mutations to a write-ahead log; a restarted GCS
+replays snapshot + WAL and raylets/workers reconnect to it.
+
+The object directory and node table are NOT persisted: nodes re-register
+on reconnect (they own that state), and object ownership is replayed by
+each owner from its `_gcs_registered` set — the owner is the authority,
+mirroring the reference's ownership model.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import struct
+
+_REC = struct.Struct("<I")
+
+
+class GcsStorage:
+    """Append-only log of (table, op, payload) records with snapshotting.
+
+    Records are length-prefixed pickles — cheap to append (one write per
+    mutation, no fsync by default; `durable_fsync` opts into fsync per
+    append for machines where losing the last few mutations matters).
+    """
+
+    def __init__(self, session_dir: str, fsync: bool = False):
+        self.dir = os.path.join(session_dir, "gcs_store")
+        os.makedirs(self.dir, exist_ok=True)
+        self.wal_path = os.path.join(self.dir, "wal.log")
+        self.snap_path = os.path.join(self.dir, "snapshot.pkl")
+        self._fsync = fsync
+        self._wal = open(self.wal_path, "ab")
+        self._appends_since_snap = 0
+
+    # ------------------------------------------------------------------ write
+    def append(self, table: str, op: str, payload: Any) -> None:
+        blob = pickle.dumps((table, op, payload), protocol=5)
+        self._wal.write(_REC.pack(len(blob)) + blob)
+        self._wal.flush()
+        if self._fsync:
+            os.fsync(self._wal.fileno())
+        self._appends_since_snap += 1
+
+    def maybe_compact(self, state: Dict[str, Any], every: int = 5000) -> None:
+        """Snapshot the full durable state and truncate the WAL once the
+        log grows past `every` appends since the last snapshot."""
+        if self._appends_since_snap < every:
+            return
+        self.snapshot(state)
+
+    def snapshot(self, state: Dict[str, Any]) -> None:
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f, protocol=5)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        self._wal.close()
+        self._wal = open(self.wal_path, "wb")  # truncate
+        self._wal.flush()
+        self._appends_since_snap = 0
+
+    # ------------------------------------------------------------------- read
+    def load(self) -> Tuple[Optional[Dict[str, Any]], Iterator[Tuple[str, str, Any]]]:
+        """Returns (snapshot_state_or_None, iterator of WAL records)."""
+        snap = None
+        if os.path.exists(self.snap_path):
+            try:
+                with open(self.snap_path, "rb") as f:
+                    snap = pickle.load(f)
+            except Exception:
+                snap = None
+        return snap, self._iter_wal()
+
+    def _iter_wal(self) -> Iterator[Tuple[str, str, Any]]:
+        if not os.path.exists(self.wal_path):
+            return
+        with open(self.wal_path, "rb") as f:
+            while True:
+                hdr = f.read(_REC.size)
+                if len(hdr) < _REC.size:
+                    return
+                (n,) = _REC.unpack(hdr)
+                blob = f.read(n)
+                if len(blob) < n:
+                    return  # torn tail write — ignore (crash mid-append)
+                try:
+                    yield pickle.loads(blob)
+                except Exception:
+                    return
+
+    def close(self) -> None:
+        try:
+            self._wal.close()
+        except Exception:
+            pass

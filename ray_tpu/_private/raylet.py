@@ -399,6 +399,11 @@ class Raylet:
         the owner then pushes tasks straight to the leased worker and the
         scheduler never sees them). Leases are tied to the requesting
         connection: if the owner dies, its leased workers are reclaimed."""
+        # install the reclaim hook BEFORE any await: if the owner dies while
+        # we wait for an idle worker below, teardown must find it installed
+        # or granted leases would leak the worker + GCS-deducted resources
+        if conn.on_close is None:
+            conn.on_close = self._on_owner_conn_close
         admit = await self._gcs.request(
             "lease.admit", {"node_id": self.node_id, "resources": data.get("resources") or {}}
         )
@@ -407,6 +412,9 @@ class Raylet:
         lease_id = admit["lease_id"]
         deadline = time.monotonic() + 10.0
         while True:
+            if conn.closed:
+                await self._gcs.request("lease.done", {"lease_id": lease_id})
+                return {"ok": False, "reason": "owner connection closed"}
             worker = None
             while self.idle:
                 wid = self.idle.popleft()
@@ -417,8 +425,14 @@ class Raylet:
             if worker is not None:
                 worker.lease_id = lease_id
                 self._conn_leases.setdefault(conn, set()).add(lease_id)
-                if conn.on_close is None:
-                    conn.on_close = self._on_owner_conn_close
+                if conn.closed:
+                    # teardown may have raced the grant; reclaim ourselves
+                    # (lease.done is idempotent on the GCS side)
+                    worker.lease_id = None
+                    self._conn_leases.get(conn, set()).discard(lease_id)
+                    self._return_worker(worker)
+                    await self._gcs.request("lease.done", {"lease_id": lease_id})
+                    return {"ok": False, "reason": "owner connection closed"}
                 return {"ok": True, "lease_id": lease_id, "worker_id": worker.worker_id, "addr": worker.addr}
             if time.monotonic() > deadline:
                 await self._gcs.request("lease.done", {"lease_id": lease_id})

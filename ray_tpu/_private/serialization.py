@@ -140,11 +140,21 @@ def from_bytes(data: bytes) -> Any:
     return from_buffer(memoryview(data), zero_copy=False)
 
 
-def dumps_function(fn) -> bytes:
+def dumps_function(fn) -> Tuple[bytes, List[ObjectRef]]:
     """Pickle a function/class for the GCS function table
-    (reference: python/ray/_private/function_manager.py export path)."""
-    return cloudpickle.dumps(fn)
+    (reference: python/ray/_private/function_manager.py export path).
+    Uses the ref-tracking pickler so ObjectRefs captured in closures are
+    reported to the caller — their owner must register them with the
+    directory before an executor can resolve them."""
+    import io
+
+    f = io.BytesIO()
+    p = _Pickler(f, None)
+    p.dump(fn)
+    return f.getvalue(), p.contained_refs
 
 
 def loads_function(data: bytes):
-    return cloudpickle.loads(data)
+    import io
+
+    return _Unpickler(io.BytesIO(data), buffers=None).load()

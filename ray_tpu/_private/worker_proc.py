@@ -33,6 +33,14 @@ from ray_tpu._private.core_worker import CoreWorker, _env_err, _env_inline
 logger = logging.getLogger("ray_tpu.worker")
 
 
+def _cancelled_envs(spec):
+    """One TaskCancelledError envelope per return oid of `spec`."""
+    name = spec.get("name", "")
+    err = _env_err(exceptions.TaskCancelledError(name), name)
+    err["t"] = "TaskCancelledError"
+    return [err] * len(spec["returns"])
+
+
 class Executor:
     def __init__(self, core: CoreWorker):
         self.core = core
@@ -40,6 +48,7 @@ class Executor:
         self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=1, thread_name_prefix="exec")
         self.actor_instance = None
         self.actor_is_async = False
+        self.actor_max_concurrency = 1
         self.actor_semaphore: Optional[asyncio.Semaphore] = None
         self.actor_id: Optional[str] = None
         # per-caller ordering state
@@ -78,6 +87,7 @@ class Executor:
         max_conc = spec.get("max_concurrency") or (1000 if self.actor_is_async else 1)
         if not self.actor_is_async and max_conc > 1:
             self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_conc, thread_name_prefix="actor")
+        self.actor_max_concurrency = max_conc
         self.actor_semaphore = asyncio.Semaphore(max_conc)
         return {"ok": True, "addr": self.core._listen_addr}
 
@@ -86,11 +96,31 @@ class Executor:
         travel back in the reply (no raylet, no GCS on this path)."""
         spec = data["spec"]
         if spec.get("cancelled") or spec["task_id"] in self._cancelled:
-            err = _env_err(exceptions.TaskCancelledError(spec.get("name", "")), spec.get("name", ""))
-            err["t"] = "TaskCancelledError"
-            return {"results": [{"oid": oid, "env": err} for oid in spec["returns"]]}
+            return {"results": [{"oid": oid, "env": env} for oid, env in zip(spec["returns"], _cancelled_envs(spec))]}
         envs = await self._run_user_function(spec)
         return {"results": [{"oid": oid, "env": env} for oid, env in zip(spec["returns"], envs)]}
+
+    async def handle_direct_tasks(self, data) -> Dict[str, Any]:
+        """Batch of direct tasks from one lease drain: one executor hop
+        runs them all sequentially (normal tasks are always sync here)."""
+        results = []
+        runnable = []
+        for spec in data["specs"]:
+            if spec.get("cancelled") or spec["task_id"] in self._cancelled:
+                results.extend(
+                    {"oid": oid, "env": env}
+                    for oid, env in zip(spec["returns"], _cancelled_envs(spec))
+                )
+            else:
+                runnable.append(spec)
+        if runnable:
+            loop = asyncio.get_running_loop()
+            env_lists = await loop.run_in_executor(
+                self.pool, self._exec_sync_batch, runnable, False, loop
+            )
+            for spec, envs in zip(runnable, env_lists):
+                results.extend({"oid": oid, "env": env} for oid, env in zip(spec["returns"], envs))
+        return {"results": results}
 
     async def handle_actor_call(self, data, conn) -> Dict[str, Any]:
         """Direct actor invocation. Calls from one caller arrive in
@@ -103,66 +133,142 @@ class Executor:
             envs = await self._run_user_function(spec, actor=True)
         return {"results": [{"oid": oid, "env": env} for oid, env in zip(spec["returns"], envs)]}
 
+    async def handle_actor_calls(self, data, conn) -> Dict[str, Any]:
+        """Batched pipelined calls from one caller. A strictly-serial sync
+        actor (max_concurrency=1) executes the whole batch in ONE executor
+        hop — same serial semantics, 1/N the loop⇄thread round trips.
+        Concurrent actors (async or threaded) interleave per spec through
+        the semaphore, FIFO order preserved (gather creates tasks in list
+        order). One reply carries every result."""
+        specs = data["specs"]
+        if self.actor_instance is not None and not self.actor_is_async and self.actor_max_concurrency == 1:
+            loop = asyncio.get_running_loop()
+            async with self.actor_semaphore:
+                env_lists = await loop.run_in_executor(
+                    self.pool, self._exec_sync_batch, specs, True, loop
+                )
+            return {
+                "results": [
+                    {"oid": oid, "env": env}
+                    for s, envs in zip(specs, env_lists)
+                    for oid, env in zip(s["returns"], envs)
+                ]
+            }
+        replies = await asyncio.gather(
+            *(self.handle_actor_call({"spec": spec}, conn) for spec in specs)
+        )
+        return {"results": [item for r in replies for item in r["results"]]}
+
+    def _exec_sync_batch(self, specs, actor: bool, loop):
+        """Thread-side batch runner. cancel()'s PyThreadState_SetAsyncExc
+        KeyboardInterrupt is asynchronous: it can land BETWEEN specs
+        (outside any try), which must not fail the remaining tasks — the
+        interrupt's target already returned, so swallow it and keep
+        going.
+
+        Each spec's results are STAGED into this worker's local object
+        cache as they complete: a later task in the batch may block on a
+        `get` of an earlier result (e.g. a ref captured in its closure),
+        and the batch reply that would deliver it to the owner only ships
+        after the whole batch — without staging that is a deadlock. The
+        stage is dropped once the batch returns (the owner serves
+        resolves from then on)."""
+        out = []
+        staged = []
+        try:
+            for spec in specs:
+                appended = False
+                try:
+                    envs = self._exec_sync_one(spec, actor, loop)
+                    out.append(envs)
+                    appended = True
+                    for oid, env in zip(spec["returns"], envs):
+                        self.core._deliver(bytes(oid), env)
+                        staged.append(bytes(oid))
+                except KeyboardInterrupt:
+                    # the interrupt's target already returned (its own try
+                    # converts an in-task KI); landing here means it hit
+                    # between specs or during staging — don't fail the
+                    # rest of the batch
+                    if not appended:
+                        out.append(_cancelled_envs(spec))
+            return out
+        finally:
+            while staged:
+                try:
+                    self.core._store.pop(staged.pop(), None)
+                except KeyboardInterrupt:
+                    continue
+
+    def _exec_sync_one(self, spec, actor: bool, loop):
+        """Thread-side: execute ONE spec fully — unpack → invoke →
+        serialize → error conversion. Runs on a pool thread so pipelined
+        batches can share a single loop⇄thread round trip."""
+        name = spec.get("name") or spec.get("method", "?")
+        try:
+            # the task that owns the pool thread is the one cancel() can
+            # interrupt, so both fields are set HERE, on that thread
+            self._current_thread = threading.current_thread()
+            self._current_task_id = spec["task_id"]
+            try:
+                if spec["task_id"] in self._cancelled:
+                    raise exceptions.TaskCancelledError(spec.get("name", ""))
+                if actor:
+                    fn = getattr(self.actor_instance, spec["method"])
+                else:
+                    fn = self.core.load_function(spec["fn_id"])
+                args, kwargs = self.core.unpack_args(spec["args"])
+                if inspect.iscoroutinefunction(fn):
+                    import asyncio as _a
+
+                    result = _a.run_coroutine_threadsafe(fn(*args, **kwargs), loop).result()
+                else:
+                    result = fn(*args, **kwargs)
+                values = self._split_returns(spec, result)
+                if values is None:
+                    return [self._bad_arity_env(spec, name)] * len(spec["returns"])
+                return [self._to_env_sync(oid, v) for oid, v in zip(spec["returns"], values)]
+            finally:
+                self._current_thread = None
+                self._current_task_id = None
+        except (Exception, KeyboardInterrupt) as e:
+            # KeyboardInterrupt is how cancel() interrupts the user thread
+            # (PyThreadState_SetAsyncExc) — it is a BaseException, so a bare
+            # `except Exception` would let it escape as a handler error and
+            # the owner would retry a cancelled task instead of seeing
+            # TaskCancelledError.
+            tb = traceback.format_exc()
+            logger.info("task %s failed: %s", name, tb)
+            if isinstance(e, (KeyboardInterrupt,)) or spec["task_id"] in self._cancelled:
+                return _cancelled_envs(spec)
+            return [_env_err(e, name)] * len(spec["returns"])
+
     async def _run_user_function(self, spec, actor: bool = False):
         name = spec.get("name") or spec.get("method", "?")
         loop = asyncio.get_running_loop()
         is_async = actor and self.actor_is_async and inspect.iscoroutinefunction(
             getattr(type(self.actor_instance), spec["method"], None)
         )
-        try:
-            if is_async:
-                # async actor: unpack off-loop, run the coroutine on-loop
-                args, kwargs = await loop.run_in_executor(self.pool, self.core.unpack_args, spec["args"])
-                fn = getattr(self.actor_instance, spec["method"])
-                result = await fn(*args, **kwargs)
-                values = self._split_returns(spec, result)
-                if values is None:
-                    return [self._bad_arity_env(spec, name)] * len(spec["returns"])
-                return [await self._to_env(oid, v) for oid, v in zip(spec["returns"], values)]
-
+        if not is_async:
             # sync path: ONE executor hop covering unpack → invoke →
             # serialize (each hop is a loop⇄thread round trip; the 1:1
             # sync actor-call benchmark lives and dies on these)
-            def _run_all():
-                # pipelined handler coroutines may interleave; the task
-                # that owns the pool thread is the one cancel() can
-                # interrupt, so both fields are set HERE, on that thread
-                self._current_thread = threading.current_thread()
-                self._current_task_id = spec["task_id"]
-                try:
-                    if spec["task_id"] in self._cancelled:
-                        raise exceptions.TaskCancelledError(spec.get("name", ""))
-                    if actor:
-                        fn = getattr(self.actor_instance, spec["method"])
-                    else:
-                        fn = self.core.load_function(spec["fn_id"])
-                    args, kwargs = self.core.unpack_args(spec["args"])
-                    if inspect.iscoroutinefunction(fn):
-                        import asyncio as _a
-
-                        result = _a.run_coroutine_threadsafe(fn(*args, **kwargs), loop).result()
-                    else:
-                        result = fn(*args, **kwargs)
-                    values = self._split_returns(spec, result)
-                    if values is None:
-                        return [self._bad_arity_env(spec, name)]
-                    return [self._to_env_sync(oid, v) for oid, v in zip(spec["returns"], values)]
-                finally:
-                    self._current_thread = None
-                    self._current_task_id = None
-
-            envs = await loop.run_in_executor(self.pool, _run_all)
-            if len(envs) == 1 and len(spec["returns"]) > 1:
-                envs = envs * len(spec["returns"])
-            return envs
-        except Exception as e:
+            return await loop.run_in_executor(self.pool, self._exec_sync_one, spec, actor, loop)
+        try:
+            # async actor: unpack off-loop, run the coroutine on-loop
+            args, kwargs = await loop.run_in_executor(self.pool, self.core.unpack_args, spec["args"])
+            fn = getattr(self.actor_instance, spec["method"])
+            result = await fn(*args, **kwargs)
+            values = self._split_returns(spec, result)
+            if values is None:
+                return [self._bad_arity_env(spec, name)] * len(spec["returns"])
+            return [await self._to_env(oid, v) for oid, v in zip(spec["returns"], values)]
+        except (Exception, KeyboardInterrupt) as e:
             tb = traceback.format_exc()
             logger.info("task %s failed: %s", name, tb)
-            err = _env_err(e, name)
             if isinstance(e, (KeyboardInterrupt,)) or spec["task_id"] in self._cancelled:
-                err = _env_err(exceptions.TaskCancelledError(name), name)
-                err["t"] = "TaskCancelledError"
-            return [err] * len(spec["returns"])
+                return _cancelled_envs(spec)
+            return [_env_err(e, name)] * len(spec["returns"])
 
     def _split_returns(self, spec, result):
         n = len(spec["returns"])
@@ -181,7 +287,12 @@ class Executor:
         from ray_tpu._private import serialization
         from ray_tpu._private.config import RayConfig
 
-        pickled, buffers, _ = serialization.serialize(value)
+        pickled, buffers, refs = serialization.serialize(value)
+        if refs:
+            # refs nested in a RESULT escape to the caller: any we own
+            # (created inside this task) must hit the directory before
+            # the caller resolves them (same contract as put/pack_args)
+            self.core._ensure_registered([r.binary() for r in refs])
         total = serialization.serialized_size(pickled, buffers)
         if total <= RayConfig.object_store_inline_max_bytes or self.core._shm is None:
             data = bytearray(total)
@@ -193,7 +304,9 @@ class Executor:
         loop = asyncio.get_running_loop()
 
         def _ser():
-            pickled, buffers, _ = serialization.serialize(value)
+            pickled, buffers, refs = serialization.serialize(value)
+            if refs:
+                self.core._ensure_registered([r.binary() for r in refs])
             total = serialization.serialized_size(pickled, buffers)
             if total <= RayConfig.object_store_inline_max_bytes or self.core._shm is None:
                 data = bytearray(total)
@@ -267,6 +380,11 @@ async def _amain():
 
         _orig_import = builtins.__import__
 
+        # Note the hook only sees builtins.__import__ (importlib.import_module
+        # bypasses it) — that is fine: without a sitecustomize, jax reads the
+        # JAX_PLATFORMS env var itself at backend init, so the pin is only
+        # load-bearing in the sitecustomize case, where jax is already in
+        # sys.modules at worker start and the eager branch above runs instead.
         def _import_hook(name, *args, **kwargs):
             mod = _orig_import(name, *args, **kwargs)
             if name == "jax" or name.startswith("jax."):

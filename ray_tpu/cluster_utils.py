@@ -1,0 +1,125 @@
+"""Multi-node-on-one-machine cluster harness for tests.
+
+Equivalent of the reference's `python/ray/cluster_utils.py:108 Cluster` —
+the load-bearing test asset that makes a distributed runtime testable on
+one box: N raylets + N shm arenas + 1 GCS, all real processes over real
+sockets. `add_node` boots another raylet into the same session;
+`remove_node` SIGKILLs one to exercise node-death fault tolerance.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import node as node_mod
+
+
+class ClusterNode:
+    def __init__(self, name: str, info: Dict[str, Any], proc: subprocess.Popen):
+        self.name = name
+        self.info = info
+        self.proc = proc
+
+    @property
+    def node_id(self) -> str:
+        return self.info["node_id"]
+
+    def __repr__(self):
+        return f"ClusterNode({self.name}, {self.node_id[:8]})"
+
+
+class Cluster:
+    def __init__(
+        self,
+        initialize_head: bool = True,
+        head_node_args: Optional[Dict[str, Any]] = None,
+        connect: bool = False,
+    ):
+        self.session_dir = node_mod.new_session_dir()
+        self.procs = node_mod.NodeProcesses(self.session_dir)
+        self.nodes: List[ClusterNode] = []
+        self._counter = 0
+        if initialize_head:
+            self.add_node(**(head_node_args or {}))
+        if connect:
+            self.connect()
+
+    @property
+    def gcs_address(self) -> Optional[str]:
+        return self.procs.gcs_address
+
+    def add_node(
+        self,
+        num_cpus: int = 1,
+        object_store_memory: int = 64 * 1024 * 1024,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> ClusterNode:
+        res = dict(resources or {})
+        res.setdefault("CPU", float(num_cpus))
+        before = list(self.procs.procs)
+        if not self.nodes:
+            self.procs.start_head(res, object_store_memory, labels=labels)
+            info = self.procs.head_node_info
+            name = "head"
+        else:
+            self._counter += 1
+            name = f"n{self._counter}"
+            info = self.procs.start_raylet(res, object_store_memory, labels=labels, name=name)
+        # the raylet proc is the last one spawned that wasn't there before
+        new_procs = [p for p in self.procs.procs if p not in before]
+        node = ClusterNode(name, info, new_procs[-1])
+        self.nodes.append(node)
+        return node
+
+    def remove_node(self, node: ClusterNode, allow_graceful: bool = False) -> None:
+        """Kill a node's raylet (SIGKILL by default — models machine loss;
+        its workers die with it via PDEATHSIG). The GCS health checker
+        notices within health_check_timeout_s."""
+        if node.proc.poll() is None:
+            try:
+                if allow_graceful:
+                    node.proc.terminate()
+                else:
+                    os.killpg(os.getpgid(node.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    node.proc.kill()
+                except Exception:
+                    pass
+        try:
+            node.proc.wait(timeout=10)
+        except Exception:
+            pass
+        self.nodes = [n for n in self.nodes if n is not node]
+
+    def wait_for_nodes(self, timeout: float = 30.0) -> None:
+        """Block until every added node is ALIVE in the GCS."""
+        import ray_tpu
+
+        deadline = time.monotonic() + timeout
+        want = {n.node_id for n in self.nodes}
+        while time.monotonic() < deadline:
+            alive = {
+                n["node_id"] for n in ray_tpu.nodes() if n.get("state") == "ALIVE"
+            }
+            if want <= alive:
+                return
+            time.sleep(0.2)
+        raise TimeoutError(f"nodes not alive after {timeout}s: {want - alive}")
+
+    def connect(self):
+        import ray_tpu
+
+        return ray_tpu.init(address=f"session:{self.session_dir}")
+
+    def shutdown(self):
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+        self.procs.kill_all()

@@ -164,9 +164,13 @@ class EnvRunnerGroup:
             self.local_runner.set_weights(weights, seq)
             return
         import ray_tpu
+        from ray_tpu._private.worker import get_global_core
 
         ref = ray_tpu.put(weights)
         ray_tpu.get([r.set_weights.remote(ref, seq) for r in self.remote_runners])
+        # one broadcast object per training iteration: free it eagerly or
+        # the store (and its GCS record) grows without bound
+        get_global_core().free([ref])
 
     def stop(self) -> None:
         import ray_tpu

@@ -19,9 +19,11 @@ class PPOLearner(Learner):
         logits = out["logits"]
         vf = out["vf"]
 
-        # numerically stable log-softmax
-        logp_all = logits - jnp.max(logits, axis=-1, keepdims=True)
-        logp_all = logp_all - jnp.log(jnp.sum(jnp.exp(logp_all), axis=-1, keepdims=True))
+        # same log-softmax as the sampler (single_agent_env_runner.py) so
+        # logp and logp_old can never drift between formulas
+        import jax
+
+        logp_all = jax.nn.log_softmax(logits)
         logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=1)[:, 0]
 
         adv = batch["advantages"]

@@ -78,8 +78,14 @@ class Learner:
         n = len(batch["actions"])
         mb = min(self.config.minibatch_size, n)
         if self.mesh is not None:
-            # every device needs an equal shard
-            mb -= mb % self.mesh.devices.size
+            ndev = self.mesh.devices.size
+            if n < ndev:
+                raise ValueError(
+                    f"batch of {n} cannot shard over {ndev} learner devices; "
+                    "raise train_batch_size or lower num_devices_per_learner"
+                )
+            # every device needs an equal, non-empty shard
+            mb = max(ndev, mb - mb % ndev)
         all_stats = []
         for _ in range(self.config.num_epochs):
             perm = self._np_rng.permutation(n)

@@ -1,0 +1,165 @@
+"""Multi-node tests: N raylets + 1 GCS on one machine, real sockets.
+
+Models the reference's multi-node coverage built on
+`python/ray/cluster_utils.py:108 Cluster` (test_multi_node.py,
+test_failure*.py): cross-node object transfer, spread placement,
+node-death actor restart and in-flight task retry.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    # lean worker pools: this box has one core and the module boots 3 raylets
+    os.environ["RAY_TPU_WORKER_POOL_PRESTART"] = "1"
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2, "resources": {"head_mark": 2.0}})
+    c.add_node(num_cpus=2, resources={"spot": 2.0, "n1_mark": 2.0})
+    c.add_node(num_cpus=2, resources={"spot": 2.0, "n2_mark": 2.0})
+    c.connect()
+    c.wait_for_nodes()
+    yield c
+    c.shutdown()
+    os.environ.pop("RAY_TPU_WORKER_POOL_PRESTART", None)
+
+
+def test_nodes_alive(cluster):
+    alive = [n for n in ray_tpu.nodes() if n["state"] == "ALIVE"]
+    assert len(alive) == 3
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU", 0) >= 6
+
+
+def test_cross_node_get(cluster):
+    """Large result produced on a worker node must transfer into the
+    driver's node arena (exercises raylet.fetch + GCS orchestration)."""
+
+    @ray_tpu.remote(resources={"n1_mark": 1})
+    def produce():
+        return np.arange(1_000_000, dtype=np.float64)  # 8 MB -> shm
+
+    arr = ray_tpu.get(produce.remote(), timeout=60)
+    assert arr.shape == (1_000_000,)
+    assert float(arr[-1]) == 999_999.0
+
+
+def test_cross_node_dependency(cluster):
+    """Producer on n1, consumer on n2: the consumer's raylet pulls the
+    block from the producer's node."""
+
+    @ray_tpu.remote(resources={"n1_mark": 1})
+    def produce():
+        return np.ones(500_000, dtype=np.float64)
+
+    @ray_tpu.remote(resources={"n2_mark": 1})
+    def consume(a):
+        import ray_tpu as rt
+
+        return float(a.sum()), rt.get_runtime_context().get_node_id()
+
+    ref = produce.remote()
+    total, consumer_node = ray_tpu.get(consume.remote(ref), timeout=60)
+    assert total == 500_000.0
+    n2 = next(n for n in ray_tpu.nodes() if n["resources_total"].get("n2_mark"))
+    assert consumer_node == n2["node_id"]
+
+
+def test_strict_spread_lands_on_distinct_nodes(cluster):
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.wait(30)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    refs = [
+        where.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i
+            )
+        ).remote()
+        for i in range(3)
+    ]
+    node_ids = ray_tpu.get(refs, timeout=60)
+    assert len(set(node_ids)) == 3, f"bundles shared a node: {node_ids}"
+    remove_placement_group(pg)
+
+
+def test_node_death_actor_restart(cluster):
+    """Kill the raylet hosting an actor: the GCS health checker must
+    detect the death and restart the actor on a surviving node."""
+    target = next(n for n in cluster.nodes if n.name == "n1")
+
+    @ray_tpu.remote(max_restarts=1, resources={"spot": 1})
+    class Stateful:
+        def __init__(self):
+            self.count = 0
+
+        def bump(self):
+            self.count += 1
+            return self.count
+
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Stateful.remote()
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+    first_node = ray_tpu.get(a.node.remote(), timeout=30)
+
+    # place it deterministically? "spot" exists on n1 and n2; kill whichever
+    # node the actor is on and expect a restart on the other.
+    victim = next(n for n in cluster.nodes if n.node_id == first_node)
+    cluster.remove_node(victim)
+
+    deadline = time.monotonic() + 90
+    restarted_on = None
+    while time.monotonic() < deadline:
+        try:
+            restarted_on = ray_tpu.get(a.node.remote(), timeout=15)
+            break
+        except Exception:
+            time.sleep(1)
+    assert restarted_on is not None, "actor never came back after node death"
+    assert restarted_on != first_node
+    # fresh instance: state reset (restart, not migration)
+    assert ray_tpu.get(a.bump.remote(), timeout=30) == 1
+
+
+def test_node_death_task_retry(cluster):
+    """A task running on a killed node retries on a surviving node (soft
+    node affinity pins the first attempt; the retry may go anywhere)."""
+    victim = next((n for n in cluster.nodes if n.name != "head"), None)
+    assert victim is not None, "need a surviving non-head node"
+    marker = "/tmp/mn_retry_%d" % os.getpid()
+
+    @ray_tpu.remote(max_retries=2, num_cpus=1)
+    def flaky(path):
+        # first attempt: runs "forever"; its node dies under it. The
+        # retry (marker file exists) returns immediately.
+        import time as _t
+
+        if not os.path.exists(path):
+            open(path, "w").close()
+            _t.sleep(300)
+        return "retried"
+
+    ref = flaky.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(victim.node_id, soft=True)
+    ).remote(marker)
+    deadline = time.monotonic() + 60
+    while not os.path.exists(marker) and time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert os.path.exists(marker), "task never started"
+    cluster.remove_node(victim)
+    assert ray_tpu.get(ref, timeout=120) == "retried"
