@@ -148,9 +148,25 @@ class CoreWorker:
         self._shm: Optional[ShmStore] = ShmStore(shm_path) if shm_path else None
         self._shm_path = shm_path
         # Objects we've handed out zero-copy views of stay pinned (store
-        # refcount held) until free()/shutdown — eviction must never
-        # invalidate a live numpy view. One pin per (process, object).
+        # refcount held) while live numpy views export the buffer. The pin
+        # drops when the last local ObjectRef dies (retrying while views
+        # survive the ref), or at free()/shutdown.
         self._pinned: Dict[bytes, Any] = {}
+
+        # owner-local reference counting (reference: reference_count.cc
+        # local refs): count of live ObjectRef pyobjects per oid; when the
+        # last one is collected and the object is owned and never escaped
+        # this process (no GCS record), it is freed locally. `_dropped`
+        # marks pending oids whose refs all died before the result arrived
+        # so delivery discards instead of storing forever.
+        self._local_refs: Dict[bytes, int] = {}
+        self._dropped: set = set()
+        self._release_retry: List[Any] = []  # pinned bufs with live views
+        # ref lifecycle events land here LOCK-FREE (deque.append is
+        # atomic): __del__ can run inside cyclic GC triggered while this
+        # very thread holds _store_lock (or any other lock), so the hooks
+        # must not lock or schedule — a periodic loop task drains them.
+        self._ref_events: collections.deque = collections.deque()
 
         # function table cache
         self._fn_cache: Dict[str, Any] = {}
@@ -159,6 +175,10 @@ class CoreWorker:
         # task bookkeeping for owner-side retries
         # task_id -> {"spec": .., "retries_left": int}
         self._submitted: Dict[str, Dict[str, Any]] = {}
+        # lineage: return oid -> creating spec, recorded at completion and
+        # bounded; lost objects are rebuilt by resubmitting the spec
+        # (reference: task_manager.cc lineage retention + resubmission)
+        self._lineage: "collections.OrderedDict[bytes, Dict[str, Any]]" = collections.OrderedDict()
 
         # actor transport: per-actor ordered sender queues
         self._actor_addr_cache: Dict[str, str] = {}
@@ -217,7 +237,16 @@ class CoreWorker:
         self._tcp_server, tcp_addr = await protocol.serve("tcp:0.0.0.0:0", self._handle_peer, name=f"cw-{self.mode}-tcp")
         port = tcp_addr.rsplit(":", 1)[1]
         self._listen_addr = f"unix:{sock};tcp:{node_ip}:{port}"
+        await self._gcs_connect()
+        from ray_tpu._private.object_ref import set_ref_hooks
+
+        set_ref_hooks((self._ref_created, self._ref_deleted))
+        self._loop.create_task(self._ref_gc_loop())
+        self._rejoining = False
+
+    async def _gcs_connect(self):
         self._gcs = await protocol.connect(self.gcs_addr, self._handle_gcs, name="gcs-client")
+        self._gcs.on_close = self._on_gcs_lost
         reply = await self._gcs.request(
             "register",
             {
@@ -232,10 +261,142 @@ class CoreWorker:
         self.job_id = reply.get("job_id")
         RayConfig.load_json(reply["config"])
 
+    async def _on_gcs_lost(self, conn):
+        if self._closed or getattr(self, "_rejoining", False):
+            return  # a rejoin loop is already driving reconnection
+        self._rejoining = True
+        asyncio.get_running_loop().create_task(self._gcs_rejoin())
+
+    async def _gcs_rejoin(self):
+        """The GCS died; a persisted GCS restarts on the same session
+        socket. Reconnect, re-register, and replay what the directory
+        lost: our shared-object records, pubsub subscriptions, and
+        unfinished centrally-scheduled submissions (reference: GCS client
+        reconnection + GcsInitData replay)."""
+        try:
+            deadline = time.monotonic() + RayConfig.health_check_timeout_s * 2
+            while time.monotonic() < deadline and not self._closed:
+                try:
+                    await self._gcs_connect()
+                except (protocol.ConnectionLost, OSError, ConnectionError):
+                    await asyncio.sleep(1.0)
+                    continue
+                if await self._replay_directory():
+                    break
+                # GCS flapped mid-replay — loop and re-register again
+                await asyncio.sleep(1.0)
+        finally:
+            self._rejoining = False
+
+    async def _replay_directory(self) -> bool:
+        """Replay every record the restarted GCS must know. Returns False
+        when the connection drops mid-replay (caller retries whole)."""
+        with self._store_lock:
+            replay = list(self._gcs_registered)
+        logger.info("rejoined GCS; replaying %d directory records", len(replay))
+        try:
+            for oid in replay:
+                env = self._store.get(oid)
+                if env is None:
+                    await self._gcs.push("obj.register_owned", {"oids": [oid]})
+                elif env.get("k") == "i":
+                    await self._gcs.push("obj.put_inline", {"oid": oid, "data": env["d"]})
+                elif env.get("k") == "s":
+                    await self._gcs.push(
+                        "obj.add_location", {"oid": oid, "node_id": env["n"], "size": env.get("size", 0)}
+                    )
+            for channel in list(self._subscriptions):
+                await self._gcs.request("sub.subscribe", {"channel": channel})
+        except Exception:
+            return False
+        # resubmit centrally-scheduled tasks the dead GCS may have dropped.
+        # Direct-dispatch work is unaffected and must NOT be resubmitted:
+        # in-flight pushes (_direct_inflight), specs still queued on a
+        # shape queue, and specs parked in dependency resolution would
+        # otherwise run twice.
+        local = set(self._direct_inflight)
+        local.update(getattr(self, "_dep_waiting", ()))
+        for st in self._shapes.values():
+            local.update(s["task_id"] for s in st.queue)
+        for task_id, rec in list(self._submitted.items()):
+            if task_id not in local and not rec["spec"].get("actor_id"):
+                try:
+                    await self._gcs.request("task.submit", {"spec": rec["spec"]})
+                except Exception:
+                    pass
+        return True
+
+    # ------------------------------------------------ local reference counting
+    def _ref_created(self, oid: bytes):
+        self._ref_events.append((True, oid))
+
+    def _ref_deleted(self, oid: bytes):
+        self._ref_events.append((False, oid))
+
+    async def _ref_gc_loop(self):
+        while not self._closed:
+            await asyncio.sleep(0.2)
+            self._drain_ref_events()
+            if self._release_retry:
+                # pins whose numpy views were still alive at free time:
+                # re-try here so arena space is reclaimed promptly once
+                # the views die, not only at the next unrelated free
+                self._release_retry = [b for b in self._release_retry if not b.try_release()]
+
+    def _drain_ref_events(self):
+        """Loop-side: fold queued create/delete events into counts; free
+        owned, never-shared objects whose count hit zero."""
+        dead: List[bytes] = []
+        with self._store_lock:
+            while self._ref_events:
+                created, oid = self._ref_events.popleft()
+                if created:
+                    self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+                    continue
+                n = self._local_refs.get(oid, 0) - 1
+                if n > 0:
+                    self._local_refs[oid] = n
+                    continue
+                self._local_refs.pop(oid, None)
+                if oid in self._owned and oid not in self._gcs_registered:
+                    # borrowed or escaped objects need an explicit free()
+                    # (or the full distributed protocol) — skip those
+                    dead.append(oid)
+        for oid in dead:
+            self._local_free(oid)
+
+    def _local_free(self, oid: bytes):
+        """Loop-side: reclaim an owned, never-shared object whose last
+        local ref died. Pending results are marked dropped so delivery
+        discards them."""
+        with self._store_lock:
+            if self._local_refs.get(oid):  # ref resurrected meanwhile
+                return
+            pending = oid in self._pending
+            if pending:
+                self._dropped.add(oid)
+            self._store.pop(oid, None)
+            self._owned.discard(oid)
+            self._lineage.pop(oid, None)
+        buf = self._pinned.pop(oid, None)
+        if buf is not None and not buf.try_release():
+            self._release_retry.append(buf)  # numpy views still live
+        if not pending and self._shm is not None:
+            try:
+                self._shm.delete(oid)
+            except Exception:
+                pass
+        # opportunistic sweep of parked pins whose views have since died
+        if self._release_retry:
+            self._release_retry = [b for b in self._release_retry if not b.try_release()]
+
     def shutdown(self):
         if self._closed:
             return
         self._closed = True
+        from ray_tpu._private.object_ref import set_ref_hooks
+
+        set_ref_hooks(None)
 
         async def _aclose():
             for c in self._peer_conns.values():
@@ -292,6 +453,8 @@ class CoreWorker:
         if method == "task.result":
             for item in data["results"]:
                 self._deliver(bytes(item["oid"]), item["env"])
+            if data.get("task_id"):
+                self._record_lineage(data["task_id"])
             return True
         if method == "owner.resolve":
             return await self._serve_owner_resolve(data)
@@ -356,6 +519,20 @@ class CoreWorker:
     def _deliver(self, oid: bytes, env: Dict[str, Any]):
         """Called on the IO loop (or any thread for local puts)."""
         with self._store_lock:
+            if oid in self._dropped:
+                # every local ref died before the result arrived — discard
+                self._dropped.discard(oid)
+                self._pending.pop(oid, None)
+                if env.get("k") == "s":
+                    if self._shm is not None and env.get("n") == self.node_id:
+                        try:
+                            self._shm.delete(oid)
+                        except Exception:
+                            pass
+                    elif env.get("n"):
+                        # sealed on another node's arena: best-effort free
+                        self._loop.create_task(self._free_remote_shm(env["n"], oid))
+                return
             self._store[oid] = env
             cell = self._pending.pop(oid, None)
         if cell is not None:
@@ -551,6 +728,17 @@ class CoreWorker:
             return serialization.from_buffer(memoryview(data), zero_copy=False)
         return self._decode(env)
 
+    async def _free_remote_shm(self, node_id: str, oid: bytes):
+        try:
+            nodes = await self._gcs.request("node.list")
+            node = next((n for n in nodes if n["node_id"] == node_id and n["state"] == "ALIVE"), None)
+            if node is None:
+                return
+            conn = await self._peer(node["addr"])
+            await conn.push("raylet.delete_objects", {"oids": [oid]})
+        except Exception:
+            pass  # the LRU will reclaim it under pressure anyway
+
     async def _afetch_via_raylet(self, oid: bytes, env: Dict[str, Any]) -> bytes:
         nodes = await self._gcs.request("node.list")
         node = next((n for n in nodes if n["node_id"] == env["n"] and n["state"] == "ALIVE"), None)
@@ -613,7 +801,46 @@ class CoreWorker:
             resolved = self._call(self._aget_envs([oids[i] for i in slow], remaining))
             for i, env in zip(slow, resolved):
                 envs[i] = env
-        return [self._decode_ref(oid, env) for oid, env in zip(oids, envs)]
+        out = []
+        for oid, env in zip(oids, envs):
+            try:
+                out.append(self._decode_ref(oid, env))
+            except exceptions.ObjectLostError:
+                # lineage reconstruction: re-run the creating task and
+                # decode the regenerated result (reference:
+                # object_recovery_manager.h:90 RecoverObject →
+                # task_manager resubmit)
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                env = self._recover_object(oid, remaining)
+                out.append(self._decode_ref(oid, env))
+        return out
+
+    def _recover_object(self, oid: bytes, timeout: Optional[float]):
+        """Resubmit the task that created `oid` and wait for the fresh
+        result. Raises ObjectLostError when no lineage is recorded (puts,
+        actor-call results, or lineage evicted)."""
+        spec = self._lineage.get(oid)
+        if spec is None:
+            raise exceptions.ObjectLostError(oid.hex(), "no lineage to reconstruct")
+        logger.info("reconstructing %s via lineage (task %s)", oid.hex()[:12], spec.get("name"))
+        respec = dict(spec, task_id=hex_id(new_id()))
+        with self._store_lock:
+            for roid in respec["returns"]:
+                self._store.pop(roid, None)
+            self._owned.update(respec["returns"])
+        cells = [self._make_pending(roid) for roid in respec["returns"]]
+        buf = self._pinned.pop(oid, None)
+        if buf is not None and not buf.try_release():
+            self._release_retry.append(buf)
+        self._submitted[respec["task_id"]] = {"spec": respec, "retries_left": respec.get("max_retries", 0)}
+        self._call(self._gcs.request("task.submit", {"spec": respec}))
+        cell = next(c for c, roid in zip(cells, respec["returns"]) if roid == oid)
+        if not cell.event.wait(timeout if timeout is not None else 300.0):
+            raise exceptions.GetTimeoutError(f"reconstruction of {oid.hex()} timed out")
+        env = cell.env if cell.env is not None else self._store.get(oid)
+        if env is None or env.get("k") == "e":
+            raise exceptions.ObjectLostError(oid.hex(), "reconstruction failed")
+        return env
 
     def wait(
         self,
@@ -666,6 +893,7 @@ class CoreWorker:
             self._store.pop(oid, None)
             self._gcs_registered.discard(oid)
             self._owned.discard(oid)
+            self._lineage.pop(oid, None)
             buf = self._pinned.pop(oid, None)
             if buf is not None:
                 buf.release()
@@ -799,6 +1027,15 @@ class CoreWorker:
         ones into the spec, then direct-dispatch. Refs we neither own nor
         hold locally go to the central scheduler instead (it owns
         cross-process dependency placement)."""
+        if not hasattr(self, "_dep_waiting"):
+            self._dep_waiting = set()
+        self._dep_waiting.add(spec["task_id"])
+        try:
+            await self._deps_then_direct_inner(spec, deps)
+        finally:
+            self._dep_waiting.discard(spec["task_id"])
+
+    async def _deps_then_direct_inner(self, spec, deps):
         for oid in deps:
             fut = self._awaitable_for(oid)
             if fut is not None:
@@ -1030,7 +1267,7 @@ class CoreWorker:
                     continue
                 for spec in batch:
                     self._direct_inflight.pop(spec["task_id"], None)
-                    self._submitted.pop(spec["task_id"], None)
+                    self._record_lineage(spec["task_id"])
                 for item in reply["results"]:
                     self._deliver(bytes(item["oid"]), item["env"])
         finally:
@@ -1064,7 +1301,24 @@ class CoreWorker:
             self._deliver(oid, err)
 
     def task_completed(self, task_id: str):
-        self._submitted.pop(task_id, None)
+        self._record_lineage(task_id)
+
+    def _record_lineage(self, task_id: str):
+        """Task finished: keep its spec keyed by each return oid so a
+        later loss is reconstructible. Bounded FIFO — very old results
+        lose reconstructibility, matching the reference's lineage
+        eviction (task_manager.cc lineage pinning budget)."""
+        rec = self._submitted.pop(task_id, None)
+        if rec is None:
+            return
+        spec = rec["spec"]
+        if spec.get("actor_id"):
+            return  # actor results are not deterministically replayable
+        for roid in spec["returns"]:
+            self._lineage[roid] = spec
+            self._lineage.move_to_end(roid)
+        while len(self._lineage) > 20000:
+            self._lineage.popitem(last=False)
 
     # ---------------------------------------------------------------- actors
     def create_actor(self, spec: Dict[str, Any]):

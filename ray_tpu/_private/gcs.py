@@ -72,6 +72,22 @@ class KvManager:
     def keys(self, ns: str, prefix: str = "") -> List[str]:
         return [k for k in self._data[ns] if k.startswith(prefix)]
 
+    def dump(self) -> Dict[str, Dict[str, bytes]]:
+        return {ns: dict(d) for ns, d in self._data.items()}
+
+    def load(self, data: Dict[str, Dict[str, bytes]]) -> None:
+        for ns, d in data.items():
+            self._data[ns].update(d)
+
+
+def _persistable_actor(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """Actor record minus live runtime fields (connections, waiters)."""
+    return {k: v for k, v in rec.items() if k not in ("conn", "waiters")}
+
+
+def _persistable_pg(rec: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in rec.items() if k not in ("waiters",)}
+
 
 class PubSub:
     def __init__(self):
@@ -141,6 +157,82 @@ class GcsServer:
         self._server = None
         self.address: Optional[str] = None
 
+        # durable tables: snapshot + WAL in the session dir (reference:
+        # Redis-backed GCS tables, redis_store_client.cc; replayed like
+        # GcsInitData on restart). Node/object tables are NOT persisted —
+        # raylets re-register and owners replay their directory records
+        # on reconnect.
+        from ray_tpu._private.gcs_storage import GcsStorage
+
+        self.storage = GcsStorage(session_dir)
+        self._restore()
+
+    # ---------------------------------------------------------- persistence
+    def _durable_state(self) -> Dict[str, Any]:
+        return {
+            "kv": self.kv.dump(),
+            "actors": {aid: _persistable_actor(rec) for aid, rec in self.actors.items()},
+            "named_actors": dict(self.named_actors),
+            "placement_groups": {pid: _persistable_pg(rec) for pid, rec in self.placement_groups.items()},
+            "jobs": dict(self.jobs),
+        }
+
+    def _persist(self, table: str, op: str, payload) -> None:
+        try:
+            self.storage.append(table, op, payload)
+            # building the full durable state is O(all tables) — only do
+            # it when a snapshot will actually be taken
+            self.storage.maybe_compact(self._durable_state)
+        except Exception:
+            logger.exception("GCS persistence append failed")
+
+    def _restore(self) -> None:
+        snap, wal = self.storage.load()
+        if snap:
+            self.kv.load(snap.get("kv", {}))
+            self.actors.update(snap.get("actors", {}))
+            self.named_actors.update(snap.get("named_actors", {}))
+            self.placement_groups.update(snap.get("placement_groups", {}))
+            self.jobs.update(snap.get("jobs", {}))
+        n = 0
+        for table, op, payload in wal:
+            n += 1
+            if table == "kv":
+                if op == "put":
+                    ns, key, value = payload
+                    self.kv.put(ns, key, value, overwrite=True)
+                else:
+                    ns, key = payload
+                    self.kv.delete(ns, key)
+            elif table == "actors":
+                if op == "put":
+                    self.actors[payload["actor_id"]] = payload
+                else:
+                    self.actors.pop(payload, None)
+            elif table == "named_actors":
+                if op == "put":
+                    self.named_actors[tuple(payload[0])] = payload[1]
+                else:
+                    self.named_actors.pop(tuple(payload), None)
+            elif table == "pgs":
+                if op == "put":
+                    self.placement_groups[payload["pg_id"]] = payload
+                else:
+                    self.placement_groups.pop(payload, None)
+            elif table == "jobs":
+                self.jobs[payload["job_id"]] = payload
+        if snap or n:
+            # restored records carry no live connections/waiters
+            for rec in self.actors.values():
+                rec["conn"] = None
+                rec["waiters"] = []
+            for rec in self.placement_groups.values():
+                rec.setdefault("waiters", [])
+            logger.info(
+                "GCS restored %d actors, %d PGs, %d jobs, %d kv namespaces (+%d WAL records)",
+                len(self.actors), len(self.placement_groups), len(self.jobs), len(self.kv.dump()), n,
+            )
+
     # ------------------------------------------------------------------ serve
     async def start(self):
         sock_path = os.path.join(self.session_dir, "gcs.sock")
@@ -180,10 +272,24 @@ class GcsServer:
         if kind == "raylet":
             node_id = d.get("node_id") or hex_id(new_id())
             info["node_id"] = node_id
+            prior = self.nodes.get(node_id)
+            if prior is not None and prior.get("state") == "ALIVE":
+                # re-registration over a fresh connection (conn flap):
+                # keep the resource ledger — live actors still hold their
+                # allocations on this node
+                prior["conn"] = conn
+                prior["addr"] = d["addr"]
+                prior["last_heartbeat"] = time.time()
+                out = {"client_id": client_id, "config": RayConfig.to_json(),
+                       "session_dir": self.session_dir, "node_id": node_id}
+                return out
             self.nodes[node_id] = {
                 "node_id": node_id,
                 "addr": d["addr"],
                 "node_ip": d.get("node_ip", "127.0.0.1"),
+                # a full ledger on (re)register: after a GCS restart the
+                # deductions for held actor resources are rebuilt lazily
+                # (best effort; the reference replays them from Redis)
                 "resources_total": dict(d.get("resources", {})),
                 "resources_available": dict(d.get("resources", {})),
                 "labels": d.get("labels", {}),
@@ -207,6 +313,7 @@ class GcsServer:
                 "entrypoint": d.get("entrypoint", ""),
             }
             out["job_id"] = job_id
+            self._persist("jobs", "put", self.jobs[job_id])
         return out
 
     async def _on_conn_close(self, conn: protocol.Connection):
@@ -218,12 +325,19 @@ class GcsServer:
         if info is None:
             return
         if info["kind"] == "raylet" and info.get("node_id"):
+            node = self.nodes.get(info["node_id"])
+            if node is not None and node.get("conn") is not conn:
+                # the raylet already re-registered over a NEW connection
+                # (conn flap / GCS restart race): the stale close must not
+                # fail the live node
+                return
             await self._fail_node(info["node_id"], "raylet disconnected")
         elif info["kind"] == "driver":
             job = self.jobs.get(info.get("job_id") or "")
             if job:
                 job["state"] = "FINISHED"
                 job["end_time"] = time.time()
+                self._persist("jobs", "put", job)
             await self._cleanup_driver(client_id, info)
 
     async def _cleanup_driver(self, client_id: str, info):
@@ -237,13 +351,21 @@ class GcsServer:
 
     # ------------------------------------------------------------------- kv
     async def _rpc_kv_put(self, d, conn):
-        return self.kv.put(d.get("ns", "default"), d["key"], d["value"], d.get("overwrite", True))
+        ns = d.get("ns", "default")
+        ok = self.kv.put(ns, d["key"], d["value"], d.get("overwrite", True))
+        if ok:
+            self._persist("kv", "put", (ns, d["key"], d["value"]))
+        return ok
 
     async def _rpc_kv_get(self, d, conn):
         return self.kv.get(d.get("ns", "default"), d["key"])
 
     async def _rpc_kv_del(self, d, conn):
-        return self.kv.delete(d.get("ns", "default"), d["key"])
+        ns = d.get("ns", "default")
+        ok = self.kv.delete(ns, d["key"])
+        if ok:
+            self._persist("kv", "del", (ns, d["key"]))
+        return ok
 
     async def _rpc_kv_keys(self, d, conn):
         return self.kv.keys(d.get("ns", "default"), d.get("prefix", ""))
@@ -253,7 +375,8 @@ class GcsServer:
 
     # ------------------------------------------------------------- functions
     async def _rpc_fn_put(self, d, conn):
-        self.kv.put("fn", d["fn_id"], d["blob"], overwrite=False)
+        if self.kv.put("fn", d["fn_id"], d["blob"], overwrite=False):
+            self._persist("kv", "put", ("fn", d["fn_id"], d["blob"]))
         return True
 
     async def _rpc_fn_get(self, d, conn):
@@ -622,6 +745,9 @@ class GcsServer:
         }
         spec["owner"] = owner
         spec["actor_creation"] = True
+        if name:
+            self._persist("named_actors", "put", ((ns, name), actor_id))
+        self._persist("actors", "put", _persistable_actor(self.actors[actor_id]))
         self.pending_tasks.append(spec)
         self._sched_wakeup.set()
         return True
@@ -652,6 +778,7 @@ class GcsServer:
             if not fut.done():
                 fut.set_result(None)
         actor["waiters"].clear()
+        self._persist("actors", "put", _persistable_actor(actor))
         await self.pubsub.publish("actor", {"event": "alive", "actor_id": d["actor_id"]})
         return True
 
@@ -704,6 +831,8 @@ class GcsServer:
         actor["waiters"].clear()
         if actor.get("name"):
             self.named_actors.pop((actor["namespace"], actor["name"]), None)
+            self._persist("named_actors", "del", (actor["namespace"], actor["name"]))
+        self._persist("actors", "put", _persistable_actor(actor))
         # tell the raylet to kill the worker if it is still around
         node = self.nodes.get(actor.get("node_id") or "")
         if node and node["state"] == "ALIVE" and actor.get("worker_id"):
@@ -875,6 +1004,7 @@ class GcsServer:
         ok = self._try_place_pg(rec)
         if not ok:
             rec["state"] = "PENDING"
+        self._persist("pgs", "put", _persistable_pg(rec))
         return pg_id
 
     def _try_place_pg(self, rec) -> bool:
@@ -938,6 +1068,7 @@ class GcsServer:
         rec["bundle_nodes"] = assignment
         rec["bundle_available"] = [dict(b) for b in bundles]
         rec["state"] = "CREATED"
+        self._persist("pgs", "put", _persistable_pg(rec))
         for fut in rec["waiters"]:
             if not fut.done():
                 fut.set_result(None)
@@ -970,6 +1101,7 @@ class GcsServer:
                     for k, v in b.items():
                         node["resources_available"][k] = node["resources_available"].get(k, 0.0) + v
         rec["state"] = "REMOVED"
+        self._persist("pgs", "del", d["pg_id"])
         self._sched_wakeup.set()
         return True
 

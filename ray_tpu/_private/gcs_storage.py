@@ -50,12 +50,13 @@ class GcsStorage:
             os.fsync(self._wal.fileno())
         self._appends_since_snap += 1
 
-    def maybe_compact(self, state: Dict[str, Any], every: int = 5000) -> None:
+    def maybe_compact(self, state_factory, every: int = 5000) -> None:
         """Snapshot the full durable state and truncate the WAL once the
-        log grows past `every` appends since the last snapshot."""
+        log grows past `every` appends since the last snapshot.
+        `state_factory` is called only when compaction actually runs."""
         if self._appends_since_snap < every:
             return
-        self.snapshot(state)
+        self.snapshot(state_factory())
 
     def snapshot(self, state: Dict[str, Any]) -> None:
         tmp = self.snap_path + ".tmp"

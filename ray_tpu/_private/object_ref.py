@@ -11,6 +11,18 @@ from typing import Any
 
 from ray_tpu._private.ids import ObjectID
 
+# Lifecycle hooks installed by the process's CoreWorker: (on_create(oid),
+# on_delete(oid)). They drive owner-local reference counting — when the
+# last local ObjectRef for an owned, never-shared object is collected,
+# the object is freed (reference: reference_count.cc local-ref tracking;
+# the distributed part of the protocol is out of scope — shared refs are
+# only reclaimed by explicit free()).
+_hooks = [None]
+
+
+def set_ref_hooks(hooks) -> None:
+    _hooks[0] = hooks
+
 
 class ObjectRef:
     __slots__ = ("_id", "__weakref__")
@@ -19,6 +31,17 @@ class ObjectRef:
         if isinstance(object_id, ObjectID):
             object_id = object_id.binary()
         self._id = object_id
+        cb = _hooks[0]
+        if cb is not None:
+            cb[0](self._id)
+
+    def __del__(self):
+        cb = _hooks[0]
+        if cb is not None:
+            try:
+                cb[1](self._id)
+            except Exception:
+                pass
 
     def binary(self) -> bytes:
         return self._id

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import os
 import struct
 from typing import Any, Awaitable, Callable, Dict, Optional
 
@@ -230,7 +231,27 @@ async def serve(
         conn.start()
 
     if addr.startswith("unix:"):
-        server = await asyncio.start_unix_server(_client_connected, path=addr[5:])
+        path = addr[5:]
+        if os.path.exists(path):
+            # a crashed predecessor (e.g. a killed GCS being restarted on
+            # the same session socket) leaves a stale inode behind — but
+            # only steal the address if nothing answers on it (two live
+            # servers on one GCS socket would split the cluster's brain)
+            import socket as _socket
+
+            probe = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            probe.settimeout(0.5)
+            try:
+                probe.connect(path)
+                probe.close()
+                raise OSError(f"unix socket {path} is in use by a live server")
+            except (ConnectionRefusedError, FileNotFoundError, _socket.timeout):
+                probe.close()
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+        server = await asyncio.start_unix_server(_client_connected, path=path)
         resolved = addr
     elif addr.startswith("tcp:"):
         host, port = addr[4:].rsplit(":", 1)

@@ -133,19 +133,7 @@ class Raylet:
         port = tcp_addr.rsplit(":", 1)[1]
         self.addr = tcp_addr = f"tcp:{self.node_ip}:{port}"
 
-        self._gcs = await protocol.connect(self.gcs_addr, self._handle_gcs, name="raylet-gcs")
-        reply = await self._gcs.request(
-            "register",
-            {
-                "kind": "raylet",
-                "pid": os.getpid(),
-                "addr": tcp_addr,
-                "node_ip": self.node_ip,
-                "resources": self.resources,
-                "labels": self.labels,
-                "shm_path": self.shm_path,
-            },
-        )
+        reply = await self._connect_and_register()
         self.node_id = reply["node_id"]
         RayConfig.load_json(reply["config"])
         # drop a discovery file so a colocated driver can find its node
@@ -160,6 +148,24 @@ class Raylet:
             self._start_worker()
         logger.info("raylet %s node=%s up, %d prestarted", self.name, self.node_id, RayConfig.worker_pool_prestart)
 
+    async def _connect_and_register(self):
+        self._gcs = await protocol.connect(self.gcs_addr, self._handle_gcs, name="raylet-gcs")
+        return await self._gcs.request(
+            "register",
+            {
+                "kind": "raylet",
+                "pid": os.getpid(),
+                "addr": self.addr,
+                "node_ip": self.node_ip,
+                # keep our identity across GCS restarts: a persisted GCS
+                # replays actor/PG records that reference this node_id
+                "node_id": getattr(self, "node_id", None),
+                "resources": self.resources,
+                "labels": self.labels,
+                "shm_path": self.shm_path,
+            },
+        )
+
     async def _heartbeat_loop(self):
         while True:
             await asyncio.sleep(RayConfig.health_check_period_s / 2)
@@ -169,8 +175,22 @@ class Raylet:
                     {"node_id": self.node_id, "load": {"num_workers": len(self.workers), "queued": len(self.queued)}},
                 )
             except protocol.ConnectionLost:
-                logger.error("GCS connection lost; exiting")
-                os._exit(1)
+                # a restarted GCS listens on the same session socket: keep
+                # trying to rejoin instead of dying (reference:
+                # gcs_client_reconnection_test.cc — raylets survive GCS
+                # restarts when the GCS is persisted)
+                logger.warning("GCS connection lost; attempting to rejoin")
+                deadline = time.monotonic() + RayConfig.health_check_timeout_s * 2
+                while time.monotonic() < deadline:
+                    try:
+                        await self._connect_and_register()
+                        logger.info("rejoined GCS as node %s", self.node_id)
+                        break
+                    except (protocol.ConnectionLost, OSError, ConnectionError):
+                        await asyncio.sleep(1.0)
+                else:
+                    logger.error("GCS gone for good; exiting")
+                    os._exit(1)
 
     # ------------------------------------------------------------ worker pool
     def _start_worker(self) -> None:

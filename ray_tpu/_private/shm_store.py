@@ -120,6 +120,20 @@ class ShmBuffer:
             self.view.release()
             self._store.release(self._object_id)
 
+    def try_release(self) -> bool:
+        """Release unless zero-copy consumers (numpy views) still export
+        the buffer — memoryview.release() raises BufferError then, which
+        is exactly the liveness signal we need."""
+        if self._released:
+            return True
+        try:
+            self.view.release()
+        except BufferError:
+            return False
+        self._released = True
+        self._store.release(self._object_id)
+        return True
+
     def __del__(self):
         try:
             self.release()

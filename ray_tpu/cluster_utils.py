@@ -97,6 +97,30 @@ class Cluster:
             pass
         self.nodes = [n for n in self.nodes if n is not node]
 
+    def kill_gcs(self) -> None:
+        """SIGKILL the GCS process (head-node metadata authority). With
+        persistence, `restart_gcs` brings the cluster back."""
+        gcs_proc = getattr(self, "_gcs_proc", None) or self.procs.procs[0]
+        try:
+            os.killpg(os.getpgid(gcs_proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            gcs_proc.kill()
+        try:
+            gcs_proc.wait(timeout=10)
+        except Exception:
+            pass
+
+    def restart_gcs(self) -> None:
+        """Start a fresh GCS on the same session dir: it replays its
+        snapshot+WAL and listens on the same unix socket, so raylets and
+        drivers rejoin automatically."""
+        proc, _ = self.procs._spawn(
+            ["-m", "ray_tpu._private.gcs", "--session-dir", self.session_dir, "--port", "0"],
+            "gcs-restarted.log",
+            "GCS_READY",
+        )
+        self._gcs_proc = proc
+
     def wait_for_nodes(self, timeout: float = 30.0) -> None:
         """Block until every added node is ALIVE in the GCS."""
         import ray_tpu
