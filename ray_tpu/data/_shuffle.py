@@ -1,0 +1,118 @@
+"""Distributed 2-stage shuffle primitives for Dataset.
+
+Equivalent of the reference's push-based shuffle
+(reference: python/ray/data/_internal/planner/exchange/ — the
+map-partition / reduce-merge task pattern behind repartition,
+random_shuffle and range-partitioned sort). The driver only touches
+refs: every row moves worker-to-worker through the object store, so no
+operation materializes the dataset in the driver process.
+
+Map stage: each input block is split into M parts (random assignment,
+range partition by sampled boundaries, or contiguous chunks). Reduce
+stage: reducer j concatenates part j of every mapper (+ permutes for
+shuffle / sorts for range partition).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+
+@ray_tpu.remote
+def _map_partition(blk, ops, mode: str, M: int, arg, seed: int):
+    import numpy as np
+
+    from ray_tpu.data.dataset import _apply_ops_local
+
+    blk = _apply_ops_local(blk, ops)
+    n = blk.num_rows
+    if M == 1:
+        # with num_returns=1 the executor treats the return value itself
+        # as the single result — a 1-tuple would arrive as a tuple
+        return blk
+    if mode == "random":
+        rng = np.random.default_rng(seed)
+        assign = rng.integers(0, M, size=n)
+        parts = tuple(blk.take(np.nonzero(assign == j)[0]) for j in range(M))
+    elif mode == "range":
+        key, descending, boundaries = arg
+        col = np.asarray(blk.column(key))
+        idx = np.searchsorted(np.asarray(boundaries), col, side="right")
+        if descending:
+            idx = (M - 1) - idx
+        parts = tuple(blk.take(np.nonzero(idx == j)[0]) for j in range(M))
+    elif mode == "chunk":
+        start, per = arg  # global row offset of this block, rows per output
+        ends = np.arange(n) + start
+        idx = np.minimum(ends // per, M - 1)
+        parts = tuple(blk.take(np.nonzero(idx == j)[0]) for j in range(M))
+    else:
+        raise ValueError(f"unknown partition mode {mode}")
+    return parts
+
+
+@ray_tpu.remote
+def _reduce_merge(mode: str, arg, seed: int, *parts):
+    import numpy as np
+
+    blk = B.concat_blocks(list(parts))
+    if mode == "random":
+        rng = np.random.default_rng(seed)
+        blk = blk.take(rng.permutation(blk.num_rows))
+    elif mode == "range":
+        key, descending = arg
+        blk = blk.sort_by([(key, "descending" if descending else "ascending")])
+    return blk
+
+
+@ray_tpu.remote
+def _block_count(blk, ops):
+    from ray_tpu.data.dataset import _apply_ops_local
+
+    return _apply_ops_local(blk, ops).num_rows
+
+
+@ray_tpu.remote
+def _sample_keys(blk, ops, key: str, k: int, seed: int):
+    import numpy as np
+
+    from ray_tpu.data.dataset import _apply_ops_local
+
+    blk = _apply_ops_local(blk, ops)
+    col = np.asarray(blk.column(key))
+    if len(col) == 0:
+        return col
+    rng = np.random.default_rng(seed)
+    return col[rng.integers(0, len(col), size=min(k, len(col)))]
+
+
+def shuffle_exchange(
+    block_refs: List[Any],
+    ops,
+    mode: str,
+    M: int,
+    arg=None,
+    reduce_arg=None,
+    seed: Optional[int] = None,
+    per_map_args: Optional[List[Any]] = None,
+    ops_ref=None,
+) -> List[Any]:
+    """Run the 2-stage exchange; returns M reduced block refs. Callers
+    that already put the ops chain pass `ops_ref` so it is shared rather
+    than re-put per stage."""
+    base_seed = 0 if seed is None else seed
+    if ops_ref is None:
+        ops_ref = ray_tpu.put(ops) if ops else None
+    parts: List[List[Any]] = []
+    for i, ref in enumerate(block_refs):
+        map_arg = per_map_args[i] if per_map_args is not None else arg
+        out = _map_partition.options(num_returns=M).remote(
+            ref, ops_ref, mode, M, map_arg, base_seed + 17 * i + 1
+        )
+        parts.append(out if isinstance(out, list) else [out])
+    return [
+        _reduce_merge.remote(mode, reduce_arg, base_seed + 31 * j + 7, *(p[j] for p in parts))
+        for j in range(M)
+    ]

@@ -21,10 +21,10 @@ from ray_tpu.data import block as B
 # remote transforms ---------------------------------------------------------
 
 
-@ray_tpu.remote
-def _apply_ops(blk, ops):
-    """Run a chain of (kind, fn) over one block inside a task."""
-    for kind, fn, kw in ops:
+def _apply_ops_local(blk, ops):
+    """Run a chain of (kind, fn) over one block (plain function — shared
+    by the per-block task and the shuffle map stage)."""
+    for kind, fn, kw in ops or []:
         if kind == "map_batches":
             fmt = kw.get("batch_format", "numpy")
             out = fn(B.block_to_batch(blk, fmt))
@@ -53,6 +53,9 @@ def _apply_ops(blk, ops):
         else:
             raise ValueError(f"unknown op {kind}")
     return blk
+
+
+_apply_ops = ray_tpu.remote(_apply_ops_local)
 
 
 @ray_tpu.remote
@@ -117,33 +120,70 @@ class Dataset:
         return self._execute_refs()
 
     # ------------------------------------------------------------ reshaping
+    # All three reshaping ops run as distributed 2-stage exchanges — the
+    # driver only moves refs, never rows (reference: push-based shuffle,
+    # data/_internal/planner/exchange/; replaces the round-1 versions that
+    # concatenated the whole dataset in the driver).
+
     def repartition(self, num_blocks: int) -> "Dataset":
-        tbl = B.concat_blocks(ray_tpu.get(self._execute_refs()))
-        n = tbl.num_rows
-        per = max(1, (n + num_blocks - 1) // num_blocks)
-        refs = [ray_tpu.put(tbl.slice(i * per, per)) for i in builtins.range(num_blocks) if i * per < n or i == 0]
+        from ray_tpu.data._shuffle import _block_count, shuffle_exchange
+
+        if not self._block_refs:
+            return Dataset([])
+        ops_ref = ray_tpu.put(self._ops) if self._ops else None
+        counts = ray_tpu.get([_block_count.remote(r, ops_ref) for r in self._block_refs])
+        total = sum(counts)
+        per = max(1, (total + num_blocks - 1) // num_blocks)
+        offsets = []
+        acc = 0
+        for c in counts:
+            offsets.append((acc, per))
+            acc += c
+        refs = shuffle_exchange(
+            self._block_refs, self._ops, "chunk", num_blocks, per_map_args=offsets, ops_ref=ops_ref
+        )
         return Dataset(refs)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        import numpy as np
+        from ray_tpu.data._shuffle import shuffle_exchange
 
-        tbl = B.concat_blocks(ray_tpu.get(self._execute_refs()))
-        rng = np.random.default_rng(seed)
-        idx = rng.permutation(tbl.num_rows)
-        shuffled = tbl.take(idx)
-        nb = max(1, len(self._block_refs))
-        per = max(1, (tbl.num_rows + nb - 1) // nb)
-        refs = [ray_tpu.put(shuffled.slice(i * per, per)) for i in builtins.range(nb) if i * per < tbl.num_rows or i == 0]
+        if not self._block_refs:
+            return Dataset([])
+        M = max(1, len(self._block_refs))
+        refs = shuffle_exchange(self._block_refs, self._ops, "random", M, seed=seed)
         return Dataset(refs)
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        # sort blocks, then merge (single-node round 1; range-partitioned
-        # sort is the reference's approach for scale)
-        refs = [_sort_block.remote(r, key, descending) for r in self._execute_refs()]
-        merged = B.concat_blocks(ray_tpu.get(refs)).sort_by(
-            [(key, "descending" if descending else "ascending")]
+        """Range-partitioned distributed sort: sample key quantiles, range
+        partition every block, sort each range (reference: data sort via
+        SortTaskSpec boundary sampling)."""
+        import numpy as np
+
+        from ray_tpu.data._shuffle import _sample_keys, shuffle_exchange
+
+        if not self._block_refs:
+            return Dataset([])
+        M = max(1, len(self._block_refs))
+        ops_ref = ray_tpu.put(self._ops) if self._ops else None
+        samples = ray_tpu.get(
+            [_sample_keys.remote(r, ops_ref, key, 64, 11 * i) for i, r in enumerate(self._block_refs)]
         )
-        return Dataset([ray_tpu.put(merged)])
+        allkeys = np.sort(np.concatenate([s for s in samples if len(s)]))
+        if len(allkeys) == 0 or M == 1:
+            boundaries = []
+        else:
+            qs = [len(allkeys) * j // M for j in builtins.range(1, M)]
+            boundaries = list(allkeys[qs])
+        refs = shuffle_exchange(
+            self._block_refs,
+            self._ops,
+            "range",
+            M,
+            arg=(key, descending, boundaries),
+            reduce_arg=(key, descending),
+            ops_ref=ops_ref,
+        )
+        return Dataset(refs)
 
     def union(self, other: "Dataset") -> "Dataset":
         return Dataset(self._execute_refs() + other._execute_refs())
