@@ -50,6 +50,13 @@ class Executor:
         self.actor_is_async = False
         self.actor_max_concurrency = 1
         self.actor_semaphore: Optional[asyncio.Semaphore] = None
+        # user coroutines run on their OWN loop thread, never on the
+        # CoreWorker IO loop: a blocking core API call (get/put/actor
+        # create...) inside an async method would otherwise self-deadlock
+        # — _call schedules onto the very loop the coroutine is holding
+        # (reference analogue: async actors get a dedicated asyncio loop
+        # separate from the C++ core, python/ray/_private/async_compat.py)
+        self._user_loop: Optional[asyncio.AbstractEventLoop] = None
         self.actor_id: Optional[str] = None
         # per-caller ordering state
         self._order: Dict[str, Dict[str, Any]] = {}
@@ -159,6 +166,13 @@ class Executor:
         )
         return {"results": [item for r in replies for item in r["results"]]}
 
+    def _ensure_user_loop(self) -> asyncio.AbstractEventLoop:
+        if self._user_loop is None:
+            self._user_loop = asyncio.new_event_loop()
+            t = threading.Thread(target=self._user_loop.run_forever, daemon=True, name="actor-async")
+            t.start()
+        return self._user_loop
+
     def _exec_sync_batch(self, specs, actor: bool, loop):
         """Thread-side batch runner. cancel()'s PyThreadState_SetAsyncExc
         KeyboardInterrupt is asynchronous: it can land BETWEEN specs
@@ -221,7 +235,11 @@ class Executor:
                 if inspect.iscoroutinefunction(fn):
                     import asyncio as _a
 
-                    result = _a.run_coroutine_threadsafe(fn(*args, **kwargs), loop).result()
+                    # run on the user loop, not the CoreWorker loop: the
+                    # coroutine may call blocking core APIs
+                    result = _a.run_coroutine_threadsafe(
+                        fn(*args, **kwargs), self._ensure_user_loop()
+                    ).result()
                 else:
                     result = fn(*args, **kwargs)
                 values = self._split_returns(spec, result)
@@ -255,10 +273,12 @@ class Executor:
             # sync actor-call benchmark lives and dies on these)
             return await loop.run_in_executor(self.pool, self._exec_sync_one, spec, actor, loop)
         try:
-            # async actor: unpack off-loop, run the coroutine on-loop
+            # async actor: unpack off-loop, run the coroutine on the
+            # dedicated user loop (awaited from here without blocking)
             args, kwargs = await loop.run_in_executor(self.pool, self.core.unpack_args, spec["args"])
             fn = getattr(self.actor_instance, spec["method"])
-            result = await fn(*args, **kwargs)
+            cfut = asyncio.run_coroutine_threadsafe(fn(*args, **kwargs), self._ensure_user_loop())
+            result = await asyncio.wrap_future(cfut)
             values = self._split_returns(spec, result)
             if values is None:
                 return [self._bad_arity_env(spec, name)] * len(spec["returns"])

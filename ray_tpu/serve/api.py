@@ -53,6 +53,7 @@ class Deployment:
         route_prefix: Optional[str] = None,
         ray_actor_options: Optional[dict] = None,
         max_ongoing_requests: int = 16,
+        autoscaling_config: Optional[dict] = None,
     ):
         self._callable = cls_or_fn
         self.name = name or getattr(cls_or_fn, "__name__", "deployment")
@@ -60,6 +61,10 @@ class Deployment:
         self.route_prefix = route_prefix
         self.ray_actor_options = ray_actor_options or {}
         self.max_ongoing_requests = max_ongoing_requests
+        # {"min_replicas", "max_replicas", "target_ongoing_requests",
+        #  "initial_replicas"} — queue-depth autoscaling
+        # (reference: serve autoscaling_config on @serve.deployment)
+        self.autoscaling_config = autoscaling_config
 
     def options(self, **kw) -> "Deployment":
         merged = dict(
@@ -68,6 +73,7 @@ class Deployment:
             route_prefix=self.route_prefix,
             ray_actor_options=self.ray_actor_options,
             max_ongoing_requests=self.max_ongoing_requests,
+            autoscaling_config=self.autoscaling_config,
         )
         merged.update(kw)
         return Deployment(self._callable, **merged)
@@ -104,8 +110,11 @@ def run(app: Application, *, name: str = "default", route_prefix: Optional[str] 
             dep.num_replicas,
             prefix,
             dep.ray_actor_options,
+            dep.autoscaling_config,
         )
     )
+    # fire-and-forget: the controller's reconcile/autoscale loop (idempotent)
+    controller.run_control_loop.remote()
     handle = DeploymentHandle(dep.name, name)
     handle._refresh()
     return handle
@@ -150,10 +159,12 @@ def shutdown():
 # --------------------------------------------------------------- batching
 def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
     """@serve.batch — coalesce concurrent calls into one batched call
-    (reference: python/ray/serve/batching.py)."""
+    (reference: python/ray/serve/batching.py). The leader waits on a
+    condition variable — woken early the instant the batch fills — rather
+    than burning a thread in a sleep/poll loop."""
 
     def deco(fn):
-        lock = threading.Lock()
+        cond = threading.Condition()
         pending: List = []  # (args_item, event, out)
 
         @functools.wraps(fn)
@@ -165,13 +176,19 @@ def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.
                 owner, item = None, self_or_item
             ev = threading.Event()
             slot: Dict[str, Any] = {}
-            with lock:
+            with cond:
                 pending.append((item, ev, slot))
                 leader = len(pending) == 1
+                if len(pending) >= max_batch_size:
+                    cond.notify_all()  # wake the leader early: batch full
             if leader:
                 while True:
-                    time.sleep(batch_wait_timeout_s)
-                    with lock:
+                    with cond:
+                        deadline = time.monotonic() + batch_wait_timeout_s
+                        while len(pending) < max_batch_size:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0 or not cond.wait(timeout=remaining):
+                                break
                         batch_items = pending[:max_batch_size]
                         del pending[: len(batch_items)]
                     if not batch_items:
@@ -186,7 +203,7 @@ def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.
                         for _, e, s in batch_items:
                             s["error"] = exc
                             e.set()
-                    with lock:
+                    with cond:
                         if not pending:
                             break
             if not ev.wait(timeout=30):

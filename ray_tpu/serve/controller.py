@@ -3,6 +3,15 @@
 Equivalent of the reference's ServeController + DeploymentState
 (reference: serve/_private/controller.py:91, deployment_state.py —
 declarative target state → replica actors started/stopped to match).
+
+Async actor: config consumers (handles, proxies) subscribe via
+LONG-POLL (`listen_for_change`, reference: serve/_private/long_poll.py
+LongPollHost) — a request parks on a version mismatch and returns the
+moment the controller bumps it, so replica-set updates push rather than
+poll. A background control loop autoscales deployments on queue depth
+(reference: serve/_private/autoscaling_policy.py — scale toward
+total_ongoing_requests / target_ongoing_requests, clamped to
+[min_replicas, max_replicas]).
 """
 from __future__ import annotations
 
@@ -21,30 +30,39 @@ class Replica:
 
     def __init__(self, cls_or_fn, init_args, init_kwargs):
         import inspect
+        import threading
 
         if inspect.isclass(cls_or_fn):
             self.instance = cls_or_fn(*init_args, **init_kwargs)
         else:
             self.instance = cls_or_fn
         self.num_requests = 0
+        self._ongoing = 0
+        self._ongoing_lock = threading.Lock()
 
     def handle_request(self, method: str, args, kwargs):
-        self.num_requests += 1
-        fn = self.instance if method == "__call__" else getattr(self.instance, method)
-        result = fn(*args, **kwargs)
-        import inspect
+        with self._ongoing_lock:
+            self.num_requests += 1
+            self._ongoing += 1
+        try:
+            fn = self.instance if method == "__call__" else getattr(self.instance, method)
+            result = fn(*args, **kwargs)
+            import inspect
 
-        if inspect.iscoroutine(result):
-            import asyncio
+            if inspect.iscoroutine(result):
+                import asyncio
 
-            result = asyncio.run(result)
-        return result
+                result = asyncio.run(result)
+            return result
+        finally:
+            with self._ongoing_lock:
+                self._ongoing -= 1
 
     def health(self):
         return True
 
     def stats(self):
-        return {"num_requests": self.num_requests}
+        return {"num_requests": self.num_requests, "ongoing": self._ongoing}
 
 
 @ray_tpu.remote
@@ -54,8 +72,67 @@ class ServeControllerActor:
         self.apps: Dict[str, Dict[str, Dict[str, Any]]] = {}
         self.routes: Dict[str, tuple] = {}  # route_prefix -> (app, deployment)
         self._counter = 0
+        # long-poll state: key -> monotonically increasing version; parked
+        # listeners wake on bump (reference: LongPollHost notify_changed)
+        self._versions: Dict[str, int] = {}
+        self._events: Dict[str, Any] = {}
+        self._loop_started = False
 
-    def deploy(
+    # ------------------------------------------------------------ long poll
+    def _bump(self, key: str):
+        import asyncio
+
+        self._versions[key] = self._versions.get(key, 0) + 1
+        ev = self._events.get(key)
+        if ev is not None:
+            ev.set()
+            self._events[key] = asyncio.Event()
+
+    def _event_for(self, key: str):
+        import asyncio
+
+        ev = self._events.get(key)
+        if ev is None:
+            ev = self._events[key] = asyncio.Event()
+        return ev
+
+    async def listen_for_change(self, snapshot: Dict[str, int], timeout_s: float = 30.0):
+        """Park until any key's version moves past the caller's snapshot;
+        returns {key: {"version": v, "data": payload}} for changed keys
+        (empty dict on timeout — caller re-issues)."""
+        import asyncio
+
+        deadline = time.monotonic() + timeout_s
+        while True:
+            changed = {
+                key: {"version": self._versions.get(key, 0), "data": self._payload(key)}
+                for key, ver in snapshot.items()
+                if self._versions.get(key, 0) != ver
+            }
+            if changed:
+                return changed
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {}
+            waiters = [asyncio.ensure_future(self._event_for(key).wait()) for key in snapshot]
+            done, pending = await asyncio.wait(
+                waiters, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+            )
+            for p in pending:
+                p.cancel()
+            if not done:
+                return {}
+
+    def _payload(self, key: str):
+        if key == "routes":
+            return dict(self.routes)
+        if key.startswith("replicas::"):
+            _, app, dep = key.split("::", 2)
+            return self.apps.get(app, {}).get(dep, {}).get("replicas", [])
+        return None
+
+    # ------------------------------------------------------------ deploy
+    async def deploy(
         self,
         app_name: str,
         deployment_name: str,
@@ -65,50 +142,147 @@ class ServeControllerActor:
         num_replicas: int,
         route_prefix: Optional[str],
         ray_actor_options: Optional[dict] = None,
+        autoscaling_config: Optional[dict] = None,
     ):
         import cloudpickle
 
         cls = cloudpickle.loads(cls_blob)
         app = self.apps.setdefault(app_name, {})
         old = app.get(deployment_name)
-        if old:
-            for name in old["replicas"]:
+        rec = {
+            "cls": cls,
+            "init_args": init_args,
+            "init_kwargs": init_kwargs,
+            "replicas": [],
+            "num_replicas": num_replicas,
+            "route_prefix": route_prefix,
+            "ray_actor_options": dict(ray_actor_options or {}),
+            "autoscaling": autoscaling_config,
+            "deploy_time": time.time(),
+        }
+        if autoscaling_config:
+            rec["num_replicas"] = autoscaling_config.get(
+                "initial_replicas", autoscaling_config.get("min_replicas", 1)
+            )
+        # stage new replicas BEFORE committing the record: a failed deploy
+        # (e.g. __init__ raises) must leave the previous version serving
+        import asyncio
+
+        self._scale_to(app_name, deployment_name, rec["num_replicas"], rec=rec)
+        try:
+            await asyncio.gather(
+                *(ray_tpu.get_actor(name).health.remote() for name in rec["replicas"])
+            )
+        except Exception:
+            for name in rec["replicas"]:
                 try:
                     ray_tpu.kill(ray_tpu.get_actor(name))
                 except Exception:
                     pass
-        replicas = []
-        opts = dict(ray_actor_options or {})
-        for i in range(num_replicas):
-            self._counter += 1
-            name = f"SERVE_REPLICA::{app_name}::{deployment_name}::{self._counter}"
-            Replica.options(name=name, max_concurrency=16, **opts).remote(cls, init_args, init_kwargs)
-            replicas.append(name)
-        # wait for readiness
-        for name in replicas:
-            h = ray_tpu.get_actor(name)
-            ray_tpu.get(h.health.remote())
-        app[deployment_name] = {
-            "replicas": replicas,
-            "num_replicas": num_replicas,
-            "route_prefix": route_prefix,
-            "deploy_time": time.time(),
-        }
+            raise
+        app[deployment_name] = rec
+        if old:
+            for name in old["replicas"]:
+                if name not in rec["replicas"]:
+                    try:
+                        ray_tpu.kill(ray_tpu.get_actor(name))
+                    except Exception:
+                        pass
         if route_prefix:
             self.routes[route_prefix] = (app_name, deployment_name)
+            self._bump("routes")
+        self._bump(f"replicas::{app_name}::{deployment_name}")
         return True
 
-    def get_replicas(self, app_name: str, deployment_name: str) -> List[str]:
-        return self.apps.get(app_name, {}).get(deployment_name, {}).get("replicas", [])
+    def _scale_to(self, app_name: str, deployment_name: str, target: int, rec=None):
+        import asyncio
 
-    def get_routes(self) -> Dict[str, tuple]:
+        rec = rec if rec is not None else self.apps[app_name][deployment_name]
+        cur = list(rec["replicas"])
+        while len(cur) < target:
+            self._counter += 1
+            name = f"SERVE_REPLICA::{app_name}::{deployment_name}::{self._counter}"
+            Replica.options(name=name, max_concurrency=16, **rec["ray_actor_options"]).remote(
+                rec["cls"], rec["init_args"], rec["init_kwargs"]
+            )
+            cur.append(name)
+        while len(cur) > target:
+            name = cur.pop()
+            # drain before killing: the replica may still be serving
+            # accepted requests (reference: graceful_shutdown_wait_loop_s)
+            asyncio.ensure_future(self._drain_and_kill(name))
+        rec["replicas"] = cur
+        rec["num_replicas"] = target
+
+    async def _drain_and_kill(self, name: str, timeout_s: float = 15.0):
+        import asyncio
+
+        deadline = time.monotonic() + timeout_s
+        try:
+            h = ray_tpu.get_actor(name)
+            while time.monotonic() < deadline:
+                stats = await h.stats.remote()
+                if stats["ongoing"] == 0:
+                    break
+                await asyncio.sleep(0.25)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(name))
+        except Exception:
+            pass
+
+    # ------------------------------------------------------ autoscale loop
+    async def run_control_loop(self, period_s: float = 1.0):
+        """Queue-depth autoscaling (fire-and-forget from serve.run)."""
+        import asyncio
+
+        if self._loop_started:
+            return
+        self._loop_started = True
+        while True:
+            await asyncio.sleep(period_s)
+            for app_name, deps in list(self.apps.items()):
+                for dep_name, rec in list(deps.items()):
+                    cfg = rec.get("autoscaling")
+                    if not cfg:
+                        continue
+                    try:
+                        await self._autoscale_one(app_name, dep_name, rec, cfg)
+                    except Exception:
+                        import logging
+
+                        logging.getLogger("ray_tpu.serve").warning(
+                            "autoscale cycle failed for %s::%s", app_name, dep_name, exc_info=True
+                        )
+
+    async def _autoscale_one(self, app_name, dep_name, rec, cfg):
+        import asyncio
+
+        stats = await asyncio.gather(
+            *(ray_tpu.get_actor(n).stats.remote() for n in rec["replicas"])
+        )
+        ongoing = sum(s["ongoing"] for s in stats)
+        target_per = max(1e-6, cfg.get("target_ongoing_requests", 2))
+        desired = int(ongoing / target_per + 0.999)
+        desired = max(cfg.get("min_replicas", 1), min(cfg.get("max_replicas", 8), desired))
+        if desired != len(rec["replicas"]):
+            self._scale_to(app_name, dep_name, desired)
+            self._bump(f"replicas::{app_name}::{dep_name}")
+
+    # ------------------------------------------------------------- queries
+    async def get_replicas_versioned(self, app_name: str, deployment_name: str):
+        key = f"replicas::{app_name}::{deployment_name}"
+        return {"version": self._versions.get(key, 0), "data": self._payload(key)}
+
+    async def get_routes(self) -> Dict[str, tuple]:
         return dict(self.routes)
 
-    def delete_app(self, app_name: str):
+    async def delete_app(self, app_name: str):
         app = self.apps.pop(app_name, None)
         if not app:
             return False
-        for dep in app.values():
+        for dep_name, dep in app.items():
             for name in dep["replicas"]:
                 try:
                     ray_tpu.kill(ray_tpu.get_actor(name))
@@ -116,13 +290,19 @@ class ServeControllerActor:
                     pass
             if dep.get("route_prefix"):
                 self.routes.pop(dep["route_prefix"], None)
+            self._bump(f"replicas::{app_name}::{dep_name}")
+        self._bump("routes")
         return True
 
-    def status(self) -> Dict[str, Any]:
+    async def status(self) -> Dict[str, Any]:
         out = {}
         for app_name, deps in self.apps.items():
             out[app_name] = {
-                name: {"num_replicas": d["num_replicas"], "route_prefix": d["route_prefix"]}
+                name: {
+                    "num_replicas": len(d["replicas"]),
+                    "route_prefix": d["route_prefix"],
+                    "autoscaling": bool(d.get("autoscaling")),
+                }
                 for name, d in deps.items()
             }
         return out

@@ -3,7 +3,10 @@
 Equivalent of the reference's handle + router
 (reference: serve/handle.py DeploymentHandle; routing policy
 serve/_private/replica_scheduler/pow_2_scheduler.py:44 — pick two random
-replicas, send to the one with fewer outstanding requests).
+replicas, send to the one with fewer outstanding requests; replica-set
+freshness via long-poll, serve/_private/long_poll.py LongPollClient —
+the controller pushes membership changes the moment they happen instead
+of the handle polling or waiting for a routing failure).
 """
 from __future__ import annotations
 
@@ -37,32 +40,88 @@ class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default"):
         self.deployment_name = deployment_name
         self.app_name = app_name
+        self._replica_names: List[str] = []
         self._replicas: List[Any] = []
         self._outstanding: Dict[int, int] = {}
+        self._version = 0
         self._lock = threading.Lock()
         self._method = "__call__"
+        self._poller: Optional[threading.Thread] = None
+        self._closed = False
 
     # -- replica set management ----------------------------------------
+    def _apply_replicas(self, names: List[str], version: int):
+        handles = []
+        for name in names:
+            try:
+                handles.append(ray_tpu.get_actor(name))
+            except Exception:
+                pass
+        with self._lock:
+            self._replica_names = names
+            self._replicas = handles
+            self._outstanding = {i: 0 for i in range(len(handles))}
+            self._version = version
+
     def _refresh(self):
         from ray_tpu.serve.api import _get_controller
 
         controller = _get_controller()
-        infos = ray_tpu.get(controller.get_replicas.remote(self.app_name, self.deployment_name))
-        with self._lock:
-            self._replicas = [ray_tpu.get_actor(name) for name in infos]
-            self._outstanding = {i: 0 for i in range(len(self._replicas))}
+        info = ray_tpu.get(
+            controller.get_replicas_versioned.remote(self.app_name, self.deployment_name)
+        )
+        self._apply_replicas(info["data"], info["version"])
+        self._ensure_poller()
+
+    def _ensure_poller(self):
+        if self._poller is not None and self._poller.is_alive():
+            return
+        self._poller = threading.Thread(target=self._poll_loop, daemon=True, name="serve-longpoll")
+        self._poller.start()
+
+    def _poll_loop(self):
+        """Long-poll the controller: each request parks server-side until
+        the replica set changes, so updates arrive push-fast with one
+        outstanding RPC instead of periodic polling."""
+        from ray_tpu.serve.api import _get_controller
+
+        key = f"replicas::{self.app_name}::{self.deployment_name}"
+        while not self._closed:
+            try:
+                controller = _get_controller()
+                changed = ray_tpu.get(
+                    controller.listen_for_change.remote({key: self._version}, timeout_s=20.0),
+                    timeout=40.0,
+                )
+                if self._closed:
+                    return
+                if key in changed:
+                    self._apply_replicas(changed[key]["data"], changed[key]["version"])
+            except Exception:
+                if self._closed:
+                    return
+                import time
+
+                time.sleep(1.0)
 
     def options(self, method_name: str = "__call__", **_):
         h = DeploymentHandle(self.deployment_name, self.app_name)
         h._method = method_name
         with self._lock:
+            h._replica_names = list(self._replica_names)
             h._replicas = list(self._replicas)
             h._outstanding = dict(self._outstanding)
+            h._version = self._version
+        if h._replicas:
+            # the snapshot needs its own long-poll subscription or it
+            # would route to killed replicas after the next redeploy
+            h._ensure_poller()
         return h
 
     # -- routing --------------------------------------------------------
     def _pick(self) -> int:
-        """Power of two choices on outstanding counts."""
+        """Power of two choices on outstanding counts
+        (reference: pow_2_scheduler.py:44)."""
         n = len(self._replicas)
         if n == 1:
             return 0
@@ -75,9 +134,11 @@ class DeploymentHandle:
         if not self._replicas:
             raise RuntimeError(f"no replicas for {self.deployment_name}")
         with self._lock:
+            # pick AND read under one lock: the long-poll thread can swap
+            # _replicas for a shorter list at any moment
             idx = self._pick()
             self._outstanding[idx] = self._outstanding.get(idx, 0) + 1
-        replica = self._replicas[idx]
+            replica = self._replicas[idx]
 
         def done():
             with self._lock:
@@ -88,6 +149,12 @@ class DeploymentHandle:
         except Exception:
             done()
             self._refresh()
-            replica = self._replicas[self._pick()]
+            with self._lock:
+                if not self._replicas:
+                    raise RuntimeError(f"no replicas for {self.deployment_name}")
+                replica = self._replicas[self._pick()]
             ref = replica.handle_request.remote(self._method, args, kwargs)
         return DeploymentResponse(ref, on_done=done)
+
+    def close(self):
+        self._closed = True

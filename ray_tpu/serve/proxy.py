@@ -23,12 +23,36 @@ class ProxyActor:
     def __init__(self, port: int = 8000):
         self.port = port
         self.routes: Dict[str, tuple] = {}
+        self._routes_version = 0
         self._handles = {}
         self._runner = None
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._loop.run_forever, daemon=True, name="serve-proxy")
         self._thread.start()
         asyncio.run_coroutine_threadsafe(self._start(), self._loop).result(timeout=30)
+        # route-table freshness via controller long-poll (reference:
+        # LongPollClient in the proxy; updates push instead of per-miss
+        # refresh round trips)
+        self._poller = threading.Thread(target=self._routes_poll_loop, daemon=True, name="proxy-longpoll")
+        self._poller.start()
+
+    def _routes_poll_loop(self):
+        import time as _t
+
+        from ray_tpu.serve.api import _get_controller
+
+        while True:
+            try:
+                controller = _get_controller()
+                changed = ray_tpu.get(
+                    controller.listen_for_change.remote({"routes": self._routes_version}, timeout_s=20.0),
+                    timeout=40.0,
+                )
+                if "routes" in changed:
+                    self.routes = dict(changed["routes"]["data"])
+                    self._routes_version = changed["routes"]["version"]
+            except Exception:
+                _t.sleep(1.0)
 
     async def _start(self):
         from aiohttp import web

@@ -135,3 +135,63 @@ def test_http_proxy(ray_start_regular):
         except Exception:
             time.sleep(0.5)
     assert out == {"result": {"echo": {"msg": "hi"}}}
+
+
+def test_long_poll_replica_updates(ray_start_regular):
+    """Redeploying with more replicas reaches existing handles via the
+    controller long-poll — no routing failure needed to notice."""
+    import time
+
+    @serve.deployment
+    class V:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(V.bind(), name="lp_app")
+    assert h.remote(1).result(timeout=30) == 1
+    serve.run(V.options(num_replicas=3).bind(), name="lp_app")
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and len(h._replicas) != 3:
+        time.sleep(0.2)
+    assert len(h._replicas) == 3
+    assert h.remote(2).result(timeout=30) == 2
+    serve.delete("lp_app")
+
+
+def test_autoscaling_up_under_load(ray_start_regular):
+    """Queue-depth autoscaling: a slow deployment under concurrent load
+    scales past min_replicas, then back down when load stops."""
+    import time
+
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3, "target_ongoing_requests": 1,
+    })
+    class Slow:
+        def __call__(self, x):
+            time.sleep(4.0)  # hold queue depth across several 1s samples
+            return x
+
+    h = serve.run(Slow.bind(), name="as_app")
+    assert h.remote(0).result(timeout=60) == 0
+    # pile on concurrent requests to build queue depth
+    responses = [h.remote(i) for i in range(12)]
+    deadline = time.monotonic() + 60
+    peak = 1
+    while time.monotonic() < deadline:
+        st = serve.status().get("as_app", {}).get("Slow", {})
+        peak = max(peak, st.get("num_replicas", 1))
+        if peak >= 2:
+            break
+        time.sleep(0.5)
+    for r in responses:
+        r.result(timeout=120)
+    assert peak >= 2, f"never scaled up (peak {peak})"
+    # idle: scales back toward min
+    deadline = time.monotonic() + 40
+    while time.monotonic() < deadline:
+        st = serve.status().get("as_app", {}).get("Slow", {})
+        if st.get("num_replicas") == 1:
+            break
+        time.sleep(0.5)
+    assert serve.status()["as_app"]["Slow"]["num_replicas"] == 1
+    serve.delete("as_app")
