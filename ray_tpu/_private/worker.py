@@ -58,6 +58,10 @@ class Worker:
                     return self
                 raise RuntimeError("ray_tpu.init() called twice")
             self.namespace = namespace or "default"
+            if address is None:
+                # submitted jobs and CLI-adjacent drivers are pointed at
+                # their cluster via env (reference: RAY_ADDRESS)
+                address = os.environ.get("RAY_TPU_ADDRESS")
             if address in (None, "local"):
                 session_dir = node_mod.new_session_dir()
                 procs = node_mod.NodeProcesses(session_dir)

@@ -1,0 +1,155 @@
+"""Cluster CLI: start / stop / status / submit.
+
+Equivalent of the reference's `ray start/stop/status/job submit`
+(reference: python/ray/scripts/scripts.py:566 start, :1042 stop). A
+head started here is DETACHED (survives the CLI process); drivers
+connect with `ray_tpu.init(address="auto")` or RAY_TPU_ADDRESS.
+
+    python -m ray_tpu.scripts.cli start --head --num-cpus 8
+    python -m ray_tpu.scripts.cli start --address tcp:HOST:PORT
+    python -m ray_tpu.scripts.cli status
+    python -m ray_tpu.scripts.cli submit -- python my_script.py
+    python -m ray_tpu.scripts.cli stop
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def _latest_session() -> str:
+    path = os.path.realpath("/tmp/ray_tpu/session_latest")
+    if not os.path.isdir(path):
+        print("no running cluster (no /tmp/ray_tpu/session_latest)", file=sys.stderr)
+        sys.exit(1)
+    return path
+
+
+def cmd_start(args):
+    os.environ["RAY_TPU_DETACHED"] = "1"  # children must outlive this CLI
+    from ray_tpu._private import node as node_mod
+
+    if args.head:
+        session_dir = node_mod.new_session_dir()
+        procs = node_mod.NodeProcesses(session_dir)
+        res = node_mod.default_resources(args.num_cpus, args.num_tpus)
+        procs.start_head(res, args.object_store_memory, port=args.port)
+        pids = [p.pid for p in procs.procs]
+        with open(os.path.join(session_dir, "cluster_pids.json"), "w") as f:
+            json.dump(pids, f)
+        print(f"started head: session={session_dir}")
+        print(f"  GCS address: {procs.gcs_address}")
+        print('  connect with: ray_tpu.init(address="auto")')
+        print(f'  or from another machine: ray_tpu.init(address="{procs.gcs_address}")')
+    elif args.address:
+        session_dir = node_mod.new_session_dir()
+        procs = node_mod.NodeProcesses(session_dir)
+        res = node_mod.default_resources(args.num_cpus, args.num_tpus)
+        info = procs.start_raylet(
+            res, args.object_store_memory, name=f"cli{os.getpid()}", gcs_address=args.address
+        )
+        with open(os.path.join(session_dir, "cluster_pids.json"), "w") as f:
+            json.dump([p.pid for p in procs.procs], f)
+        print(f"joined cluster at {args.address} as node {info['node_id']}")
+    else:
+        print("start requires --head or --address", file=sys.stderr)
+        sys.exit(1)
+
+
+def cmd_stop(args):
+    import glob
+
+    stopped = 0
+    for pids_file in glob.glob("/tmp/ray_tpu/session_*/cluster_pids.json"):
+        try:
+            with open(pids_file) as f:
+                pids = json.load(f)
+        except Exception:
+            continue
+        for pid in pids:
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGTERM)
+                stopped += 1
+            except (ProcessLookupError, PermissionError):
+                pass
+        try:
+            os.unlink(pids_file)
+        except OSError:
+            pass
+    time.sleep(1.0)
+    print(f"stopped {stopped} cluster processes")
+
+
+def cmd_status(args):
+    import ray_tpu
+
+    ray_tpu.init(address="auto")
+    from ray_tpu.util import state
+
+    nodes = state.list_nodes()
+    print(f"{len(nodes)} node(s):")
+    for n in nodes:
+        res = n["resources_total"]
+        avail = n["resources_available"]
+        pretty = ", ".join(f"{avail.get(k, 0):g}/{v:g} {k}" for k, v in sorted(res.items()))
+        print(f"  {n['node_id'][:12]} [{n['state']}] {pretty}")
+    actors = [a for a in state.list_actors() if a["state"] == "ALIVE"]
+    print(f"{len(actors)} live actor(s)")
+    jobs = state.list_jobs()
+    print(f"{len(jobs)} job(s): " + ", ".join(f"{j['job_id'][:8]}={j['state']}" for j in jobs))
+    ray_tpu.shutdown()
+
+
+def cmd_submit(args):
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(address=args.address or "auto")
+    entrypoint = " ".join(args.entrypoint)
+    job_id = client.submit_job(entrypoint=entrypoint)
+    print(f"submitted {job_id}: {entrypoint}")
+    if args.wait:
+        status = client.wait_until_finished(job_id, timeout=args.timeout)
+        print(f"{job_id} finished: {status}")
+        print(client.get_job_logs(job_id))
+        sys.exit(0 if status == "SUCCEEDED" else 1)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head node or join a cluster")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", help="GCS address of an existing cluster to join")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--object-store-memory", type=int, default=512 * 1024 * 1024)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop all local cluster processes")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="show cluster nodes/actors/jobs")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("submit", help="submit a job (everything after -- is the entrypoint)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_submit)
+
+    args = parser.parse_args(argv)
+    if getattr(args, "entrypoint", None):
+        args.entrypoint = [a for a in args.entrypoint if a != "--"]
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

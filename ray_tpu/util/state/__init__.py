@@ -1,0 +1,49 @@
+"""Public state API — queryable cluster state.
+
+Equivalent of the reference's `ray.util.state`
+(reference: python/ray/util/state/api.py list_tasks/list_actors/...;
+data source is the GCS state aggregation, dashboard/state_aggregator.py —
+here the `state.*` GCS RPCs serve directly).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.worker import get_global_core
+
+
+def _state(method: str, **kwargs) -> Any:
+    return get_global_core().gcs_request(f"state.{method}", kwargs or {})
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return _state("nodes")
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    return _state("actors")
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _state("tasks", limit=limit)
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _state("objects", limit=limit)
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return _state("jobs")
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _state("placement_groups")
+
+
+def summarize_tasks() -> Dict[str, int]:
+    """Count tasks by last recorded state (reference: `ray summary tasks`)."""
+    counts: Dict[str, int] = {}
+    for ev in list_tasks():
+        st = ev.get("state", "?")
+        counts[st] = counts.get(st, 0) + 1
+    return counts
