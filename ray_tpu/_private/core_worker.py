@@ -714,8 +714,34 @@ class CoreWorker:
             if self._shm is not None and env["n"] == self.node_id:
                 buf = self._pinned.get(oid)
                 if buf is None:
-                    buf = self._shm.get(oid, timeout_ms=30000)
+                    # short grace only: a sealed object is either present
+                    # or gone — a long blocking wait here would eat the
+                    # caller's whole deadline before lineage reconstruction
+                    # ever gets a turn
+                    buf = self._shm.get(oid, timeout_ms=100)
                     if buf is None:
+                        # possibly SPILLED: a resolve makes the directory
+                        # restore it from disk (awaited server-side, so a
+                        # "local" answer means the bytes are back)
+                        try:
+                            reply = self._call(
+                                self._gcs.request("obj.resolve", {"oid": oid, "node_id": self.node_id})
+                            )
+                            if reply.get("status") == "local":
+                                # a restore is awaited server-side, so the
+                                # bytes are already back; if the location
+                                # was just stale (LRU-evicted, not spilled)
+                                # no wait will make it appear
+                                buf = self._shm.get(oid, timeout_ms=500)
+                        except Exception:
+                            pass
+                    if buf is None:
+                        # evicted behind the directory's back: invalidate
+                        # the stale location so later resolvers don't keep
+                        # being pointed at a node that lost the object
+                        self._push_gcs(
+                            "obj.location_gone", {"oid": oid, "node_id": self.node_id}
+                        )
                         raise exceptions.ObjectLostError(oid.hex(), "evicted from local store")
                     # hold the store refcount for the life of this process
                     # (or until free()) so zero-copy views stay valid
@@ -993,6 +1019,7 @@ class CoreWorker:
             "resources": resources or {"CPU": 1.0},
             "max_retries": RayConfig.task_max_retries_default if max_retries is None else max_retries,
             "owner_addr": self._listen_addr,
+            "job_id": self.job_id,
             **(scheduling or {}),
         }
         for oid in returns:
@@ -1322,6 +1349,7 @@ class CoreWorker:
 
     # ---------------------------------------------------------------- actors
     def create_actor(self, spec: Dict[str, Any]):
+        spec.setdefault("job_id", self.job_id)
         self._call(self._gcs.request("actor.create", {"spec": spec}))
 
     def actor_info(self, actor_id: str, wait_ready=False, timeout=60.0):
@@ -1347,6 +1375,7 @@ class CoreWorker:
             "args": self.pack_args(args, kwargs),
             "returns": returns,
             "caller": self.client_id,
+            "job_id": self.job_id,
         }
         for oid in returns:
             self._make_pending(oid)

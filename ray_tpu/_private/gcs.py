@@ -922,16 +922,60 @@ class GcsServer:
         rec["size"] = d.get("size", rec["size"])
         return True
 
+    async def _rpc_obj_location_gone(self, d, conn):
+        """A reader found the object missing at a recorded location
+        (evicted behind the directory's back): drop the stale entry
+        (reference: ADVICE r1 — resolve must not keep answering 'local'
+        for data that no longer exists)."""
+        rec = self.objects.get(bytes(d["oid"]))
+        if rec is not None:
+            rec["locations"].discard(d["node_id"])
+        return True
+
+    async def _rpc_obj_spilled(self, d, conn):
+        """A raylet spilled an object to disk: drop the memory location,
+        remember the file (reference: spilled URL tracking in the object
+        directory)."""
+        oid = bytes(d["oid"])
+        rec = self.objects.setdefault(
+            oid, {"owner": self.conn_client.get(conn), "inline": None, "locations": set(), "size": 0}
+        )
+        rec["locations"].discard(d["node_id"])
+        rec["spilled"] = {"node_id": d["node_id"], "path": d["path"]}
+        rec["size"] = d.get("size", rec["size"])
+        return True
+
+    async def _restore_from_spill(self, oid, rec) -> bool:
+        sp = rec.get("spilled")
+        if not sp:
+            return False
+        node = self.nodes.get(sp["node_id"])
+        if node is None or node["state"] != "ALIVE":
+            rec.pop("spilled", None)
+            return False
+        try:
+            await node["conn"].request(
+                "raylet.restore_spilled", {"oid": oid, "path": sp["path"]}, timeout=60.0
+            )
+        except Exception:
+            return False
+        rec.pop("spilled", None)
+        rec["locations"].add(sp["node_id"])
+        return True
+
     async def _rpc_obj_resolve(self, d, conn):
         """Resolve an object for a requester: inline value, a node that has
-        it, or the owner's address for a direct owner fetch (reference:
-        ownership-based object directory + pull manager)."""
+        it, the spill file restored on demand, or the owner's address for
+        a direct owner fetch (reference: ownership-based object directory
+        + pull manager + restore-from-spill)."""
         oid = d["oid"]
         rec = self.objects.get(oid)
         if rec is None:
             return {"status": "unknown"}
         if rec["inline"] is not None:
             return {"status": "inline", "data": rec["inline"]}
+        if not rec["locations"] and rec.get("spilled"):
+            await self._restore_from_spill(oid, rec)
         requester_node = d.get("node_id")
         if rec["locations"]:
             if requester_node in rec["locations"]:
@@ -970,6 +1014,14 @@ class GcsServer:
                 if node and node["state"] == "ALIVE":
                     try:
                         await node["conn"].push("raylet.delete_objects", {"oids": [oid]})
+                    except Exception:
+                        pass
+            sp = rec.get("spilled")
+            if sp:
+                node = self.nodes.get(sp["node_id"])
+                if node and node["state"] == "ALIVE":
+                    try:
+                        await node["conn"].push("raylet.unlink_spilled", {"path": sp["path"]})
                     except Exception:
                         pass
         return True
@@ -1169,6 +1221,24 @@ class GcsServer:
 
     async def _rpc_state_placement_groups(self, d, conn):
         return await self._rpc_pg_table(d, conn)
+
+    async def _rpc_autoscaler_load(self, d, conn):
+        """Resource demand + node utilization for the autoscaler
+        (reference: GcsAutoscalerStateManager feeding autoscaler v2 —
+        gcs_autoscaler_state_manager.cc)."""
+        return {
+            "pending_shapes": [dict(s.get("resources") or {}) for s in self.pending_tasks],
+            "nodes": [
+                {
+                    "node_id": n["node_id"],
+                    "state": n["state"],
+                    "resources_total": dict(n["resources_total"]),
+                    "resources_available": dict(n["resources_available"]),
+                    "labels": dict(n.get("labels") or {}),
+                }
+                for n in self.nodes.values()
+            ],
+        }
 
 
 async def _amain(args):

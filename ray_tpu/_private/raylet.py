@@ -144,9 +144,80 @@ class Raylet:
                        "addr": tcp_addr}, f)
         asyncio.get_running_loop().create_task(self._heartbeat_loop())
         asyncio.get_running_loop().create_task(self._reap_loop())
+        asyncio.get_running_loop().create_task(self._spill_loop())
         for _ in range(min(RayConfig.worker_pool_prestart, self.max_workers)):
             self._start_worker()
         logger.info("raylet %s node=%s up, %d prestarted", self.name, self.node_id, RayConfig.worker_pool_prestart)
+
+    # ------------------------------------------------------------- spilling
+    @property
+    def _spill_dir(self) -> str:
+        # inside the session dir: spill files share the session's
+        # lifecycle instead of accumulating under a global path
+        d = os.path.join(self.session_dir, "spill", self.node_id or "node")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    async def _spill_loop(self):
+        """Proactive spill-to-disk under arena pressure (reference:
+        LocalObjectManager::SpillObjects, local_object_manager.h:110 →
+        external storage): once usage crosses the spilling threshold,
+        write the coldest evictable objects out and free their arena
+        space — the C++ LRU would otherwise DROP them, forcing lineage
+        rebuilds. Spilled objects restore on demand."""
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                u = self.store.usage()
+                cap = u["capacity_bytes"]
+                if cap == 0 or u["used_bytes"] <= RayConfig.object_spilling_threshold * cap:
+                    continue
+                target = int(0.6 * cap)
+                used = u["used_bytes"]
+                for oid, size in self.store.list_evictable(256):
+                    if used <= target:
+                        break
+                    if await self._spill_one(oid):
+                        used -= size
+            except Exception:
+                logger.exception("spill loop iteration failed")
+
+    async def _spill_one(self, oid: bytes) -> bool:
+        buf = self.store.get(oid, timeout_ms=0)
+        if buf is None:
+            return False
+        path = os.path.join(self._spill_dir, oid.hex())
+        try:
+            with open(path, "wb") as f:
+                f.write(bytes(buf.view))
+            size = buf.size
+        finally:
+            buf.release()
+        self.store.delete(oid)
+        logger.info("spilled %s (%d bytes) to %s", oid.hex()[:12], size, path)
+        await self._gcs.push(
+            "obj.spilled", {"oid": oid, "node_id": self.node_id, "path": path, "size": size}
+        )
+        return True
+
+    async def _restore_spilled(self, data) -> bool:
+        """Read a spilled object back into the arena (reference:
+        restore-on-demand from external storage)."""
+        oid = bytes(data["oid"])
+        if self.store.contains(oid):
+            return True
+        path = data["path"]
+        with open(path, "rb") as f:
+            blob = f.read()
+        self.store.put_bytes(oid, blob)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        await self._gcs.push(
+            "obj.add_location", {"oid": oid, "node_id": self.node_id, "size": len(blob)}
+        )
+        return True
 
     async def _connect_and_register(self):
         self._gcs = await protocol.connect(self.gcs_addr, self._handle_gcs, name="raylet-gcs")
@@ -364,6 +435,14 @@ class Raylet:
             return True
         if method == "raylet.fetch":
             return await self._fetch(data)
+        if method == "raylet.restore_spilled":
+            return await self._restore_spilled(data)
+        if method == "raylet.unlink_spilled":
+            try:
+                os.unlink(data["path"])
+            except OSError:
+                pass
+            return True
         if method == "raylet.delete_objects":
             for oid in data["oids"]:
                 self.store.delete(bytes(oid))

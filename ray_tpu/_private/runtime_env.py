@@ -1,0 +1,145 @@
+"""runtime_env — per-job (and per-task) execution environments.
+
+Equivalent of the reference's runtime_env subsystem
+(reference: python/ray/_private/runtime_env/{working_dir,py_modules,
+plugin}.py and the per-node agent). Scope here: the three most-used
+features, TPU-cluster style —
+
+- ``working_dir``: the driver zips the directory into the GCS KV;
+  every worker extracts it once per job into the session dir, chdirs
+  into it and prepends it to sys.path.
+- ``py_modules``: list of local package/module paths shipped the same
+  way and prepended to sys.path.
+- ``env_vars``: job-level vars applied at worker startup; per-task
+  ``runtime_env={"env_vars": ...}`` overlays around a single execution.
+
+Conda/pip/container isolation is intentionally out of scope (workers
+share the host interpreter; the reference's agent-based materialization
+does not fit a single-image TPU pod).
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import sys
+import zipfile
+from typing import Any, Dict, Optional
+
+_KV_NS = "runtime_env"
+_MAX_ZIP = 100 * 1024 * 1024
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    base = os.path.abspath(path)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        if os.path.isfile(base):
+            z.write(base, os.path.basename(base))
+        else:
+            for root, dirs, files in os.walk(base):
+                dirs[:] = [d for d in dirs if d not in ("__pycache__", ".git")]
+                for fn in files:
+                    full = os.path.join(root, fn)
+                    z.write(full, os.path.relpath(full, base))
+    blob = buf.getvalue()
+    if len(blob) > _MAX_ZIP:
+        raise ValueError(f"runtime_env upload {path} is {len(blob)} bytes (max {_MAX_ZIP})")
+    return blob
+
+
+def publish(core, runtime_env: Dict[str, Any]) -> None:
+    """Driver-side: upload the job's runtime_env to the GCS KV, keyed by
+    job id — concurrent jobs must not clobber each other's envs."""
+    spec: Dict[str, Any] = {"env_vars": dict(runtime_env.get("env_vars") or {})}
+    wd = runtime_env.get("working_dir")
+    if wd:
+        blob = _zip_dir(wd)
+        digest = hashlib.sha256(blob).hexdigest()[:16]
+        core.gcs_request("kv.put", {"ns": _KV_NS, "key": f"pkg_{digest}", "value": blob})
+        spec["working_dir_pkg"] = digest
+    mods = []
+    for mod in runtime_env.get("py_modules") or []:
+        blob = _zip_dir(mod)
+        digest = hashlib.sha256(blob).hexdigest()[:16]
+        core.gcs_request("kv.put", {"ns": _KV_NS, "key": f"pkg_{digest}", "value": blob})
+        mods.append({"digest": digest, "name": os.path.basename(os.path.abspath(mod))})
+    if mods:
+        spec["py_module_pkgs"] = mods
+    core.gcs_request(
+        "kv.put", {"ns": _KV_NS, "key": f"job_{core.job_id}", "value": json.dumps(spec).encode()}
+    )
+
+
+def _materialize_pkg(core, session_dir: str, digest: str, as_module: Optional[str] = None) -> str:
+    """Extract a published package once per node; returns its path."""
+    dest = os.path.join(session_dir, "runtime_env", digest)
+    marker = dest + ".ready"
+    if not os.path.exists(marker):
+        blob = core.gcs_request("kv.get", {"ns": _KV_NS, "key": f"pkg_{digest}"})
+        if blob is None:
+            raise KeyError(f"runtime_env package {digest} not in KV")
+        target = os.path.join(dest, as_module) if as_module else dest
+        os.makedirs(target, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(bytes(blob))) as z:
+            z.extractall(target)
+        with open(marker, "w") as f:
+            f.write("ok")
+    return dest
+
+
+_applied_jobs: set = set()
+
+
+def ensure_job_env(core, session_dir: str, job_id: Optional[str]) -> None:
+    """Worker-side: apply a job's runtime_env once, LAZILY at the first
+    task of that job — prestarted workers boot before any driver has
+    published, so a startup-time fetch would race to an empty key."""
+    if not job_id or job_id in _applied_jobs:
+        return
+    _applied_jobs.add(job_id)
+    try:
+        apply_job_env(core, session_dir, job_id)
+    except Exception:
+        _applied_jobs.discard(job_id)
+        raise
+
+
+def apply_job_env(core, session_dir: str, job_id: str) -> None:
+    blob = core.gcs_request("kv.get", {"ns": _KV_NS, "key": f"job_{job_id}"})
+    if not blob:
+        return
+    spec = json.loads(bytes(blob))
+    for k, v in (spec.get("env_vars") or {}).items():
+        os.environ[k] = str(v)
+    for mod in spec.get("py_module_pkgs") or []:
+        root = _materialize_pkg(core, session_dir, mod["digest"], as_module=mod["name"])
+        if root not in sys.path:
+            sys.path.insert(0, root)
+    digest = spec.get("working_dir_pkg")
+    if digest:
+        wd = _materialize_pkg(core, session_dir, digest)
+        if wd not in sys.path:
+            sys.path.insert(0, wd)
+        os.chdir(wd)
+
+
+class env_overlay:
+    """Context manager applying per-task env_vars around one execution."""
+
+    def __init__(self, env_vars: Optional[Dict[str, str]]):
+        self.env_vars = env_vars or {}
+        self._saved: Dict[str, Optional[str]] = {}
+
+    def __enter__(self):
+        for k, v in self.env_vars.items():
+            self._saved[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+
+    def __exit__(self, *exc):
+        for k, old in self._saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old

@@ -99,6 +99,10 @@ def _load() -> ctypes.CDLL:
         lib.shm_store_usage.argtypes = [ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_uint64)] * 3
         lib.shm_store_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
         lib.shm_store_list.restype = ctypes.c_int
+        lib.shm_store_list_evictable.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ]
+        lib.shm_store_list_evictable.restype = ctypes.c_int
         _lib = lib
     return _lib
 
@@ -229,6 +233,13 @@ class ShmStore:
         buf = ctypes.create_string_buffer(max_n * 16)
         n = self._lib.shm_store_list(self._handle, buf, max_n)
         return [buf.raw[i * 16 : (i + 1) * 16] for i in range(n)]
+
+    def list_evictable(self, max_n: int = 256):
+        """(oid, size) of sealed refcount-0 objects, coldest first."""
+        buf = ctypes.create_string_buffer(max_n * 16)
+        sizes = (ctypes.c_uint64 * max_n)()
+        n = self._lib.shm_store_list_evictable(self._handle, buf, sizes, max_n)
+        return [(buf.raw[i * 16 : (i + 1) * 16], sizes[i]) for i in range(n)]
 
 
 if __name__ == "__main__":

@@ -50,6 +50,7 @@ class Worker:
         labels: Optional[Dict[str, str]] = None,
         namespace: Optional[str] = None,
         ignore_reinit_error: bool = False,
+        runtime_env: Optional[Dict[str, Any]] = None,
         **kwargs,
     ):
         with self._lock:
@@ -105,6 +106,10 @@ class Worker:
             # (reference: runtime_env working_dir; round-1 equivalent)
             blob, _ = serialization.to_bytes([p for p in sys.path if p])
             self.core.gcs_request("kv.put", {"ns": "session", "key": "driver_sys_path", "value": blob})
+            if runtime_env:
+                from ray_tpu._private import runtime_env as renv
+
+                renv.publish(self.core, runtime_env)
             self.mode = "driver"
             import atexit
 

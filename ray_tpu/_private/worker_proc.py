@@ -79,6 +79,9 @@ class Executor:
     async def _create_actor(self, spec) -> Dict[str, Any]:
         try:
             def _construct():
+                from ray_tpu._private.runtime_env import ensure_job_env
+
+                ensure_job_env(self.core, self.core.session_dir, spec.get("job_id"))
                 cls = self.core.load_function(spec["fn_id"])
                 args, kwargs = self.core.unpack_args(spec["args"])
                 return cls(*args, **kwargs)
@@ -227,21 +230,28 @@ class Executor:
             try:
                 if spec["task_id"] in self._cancelled:
                     raise exceptions.TaskCancelledError(spec.get("name", ""))
+                from ray_tpu._private.runtime_env import ensure_job_env, env_overlay
+
+                # job-level runtime_env applied lazily at the job's first
+                # task here (prestarted workers boot before the publish)
+                ensure_job_env(self.core, self.core.session_dir, spec.get("job_id"))
                 if actor:
                     fn = getattr(self.actor_instance, spec["method"])
                 else:
                     fn = self.core.load_function(spec["fn_id"])
                 args, kwargs = self.core.unpack_args(spec["args"])
-                if inspect.iscoroutinefunction(fn):
-                    import asyncio as _a
 
-                    # run on the user loop, not the CoreWorker loop: the
-                    # coroutine may call blocking core APIs
-                    result = _a.run_coroutine_threadsafe(
-                        fn(*args, **kwargs), self._ensure_user_loop()
-                    ).result()
-                else:
-                    result = fn(*args, **kwargs)
+                with env_overlay((spec.get("runtime_env") or {}).get("env_vars")):
+                    if inspect.iscoroutinefunction(fn):
+                        import asyncio as _a
+
+                        # run on the user loop, not the CoreWorker loop: the
+                        # coroutine may call blocking core APIs
+                        result = _a.run_coroutine_threadsafe(
+                            fn(*args, **kwargs), self._ensure_user_loop()
+                        ).result()
+                    else:
+                        result = fn(*args, **kwargs)
                 values = self._split_returns(spec, result)
                 if values is None:
                     return [self._bad_arity_env(spec, name)] * len(spec["returns"])

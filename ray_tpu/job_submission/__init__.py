@@ -83,6 +83,13 @@ class JobSupervisor:
     def _wait(self):
         code = self.proc.wait()
         self._set_status(JobStatus.SUCCEEDED if code == 0 else JobStatus.FAILED, exit_code=code)
+        # terminal: the supervisor exits so it doesn't pin a worker
+        # process forever (reference: JobSupervisor exits after recording
+        # terminal state); clients read status/logs from the KV + log file
+        import time as _t
+
+        _t.sleep(2.0)  # let any in-flight stop()/poll() RPC drain
+        os._exit(0)
 
     def stop(self):
         import signal
@@ -164,6 +171,7 @@ class JobSubmissionClient:
 
     def wait_until_finished(self, job_id: str, timeout: float = 300.0) -> str:
         deadline = time.time() + timeout
+        status = self.get_job_status(job_id)
         while time.time() < deadline:
             status = self.get_job_status(job_id)
             if status in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
@@ -176,10 +184,12 @@ class JobSubmissionClient:
         return ray_tpu.get(sup.stop.remote())
 
     def get_job_logs(self, job_id: str) -> str:
+        # the supervisor exits after the job terminates — fall back to the
+        # log file it left in the session dir
         try:
             sup = ray_tpu.get_actor(f"_job_supervisor:{job_id}")
-            return ray_tpu.get(sup.tail_logs.remote()).decode(errors="replace")
-        except ValueError:
+            return ray_tpu.get(sup.tail_logs.remote(), timeout=10).decode(errors="replace")
+        except Exception:
             rec = self._get_record(job_id)
             if rec and os.path.exists(rec.get("log_path", "")):
                 with open(rec["log_path"], "rb") as f:

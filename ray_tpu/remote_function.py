@@ -29,6 +29,9 @@ def _normalize_resources(opts: Dict[str, Any]) -> Dict[str, float]:
 
 def _scheduling_fields(opts: Dict[str, Any]) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
+    if opts.get("runtime_env"):
+        # per-task env (env_vars overlay; reference: per-task runtime_env)
+        out["runtime_env"] = opts["runtime_env"]
     strategy = opts.get("scheduling_strategy")
     if strategy is not None:
         if isinstance(strategy, str):

@@ -21,14 +21,6 @@ import sys
 import time
 
 
-def _latest_session() -> str:
-    path = os.path.realpath("/tmp/ray_tpu/session_latest")
-    if not os.path.isdir(path):
-        print("no running cluster (no /tmp/ray_tpu/session_latest)", file=sys.stderr)
-        sys.exit(1)
-    return path
-
-
 def cmd_start(args):
     os.environ["RAY_TPU_DETACHED"] = "1"  # children must outlive this CLI
     from ray_tpu._private import node as node_mod
@@ -72,9 +64,13 @@ def cmd_stop(args):
             continue
         for pid in pids:
             try:
+                # PIDs recycle: never kill a process that isn't ours
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    if b"ray_tpu" not in f.read():
+                        continue
                 os.killpg(os.getpgid(pid), signal.SIGTERM)
                 stopped += 1
-            except (ProcessLookupError, PermissionError):
+            except (OSError, ProcessLookupError, PermissionError):
                 pass
         try:
             os.unlink(pids_file)
@@ -105,11 +101,13 @@ def cmd_status(args):
 
 
 def cmd_submit(args):
-    import ray_tpu
+    import shlex
+
     from ray_tpu.job_submission import JobSubmissionClient
 
     client = JobSubmissionClient(address=args.address or "auto")
-    entrypoint = " ".join(args.entrypoint)
+    # preserve argv boundaries through the supervisor's `sh -c`
+    entrypoint = shlex.join(args.entrypoint)
     job_id = client.submit_job(entrypoint=entrypoint)
     print(f"submitted {job_id}: {entrypoint}")
     if args.wait:

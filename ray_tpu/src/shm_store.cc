@@ -518,6 +518,43 @@ void shm_store_usage(void* handle, uint64_t* used, uint64_t* capacity, uint64_t*
   unlock(s);
 }
 
+// List up to max_n evictable (sealed, refcount-0) object ids in LRU order
+// (coldest first) into out (16 bytes each + 8-byte size each in sizes);
+// returns count. Backs the raylet's proactive spiller: these are exactly
+// the objects evict_one() would drop under pressure.
+int shm_store_list_evictable(void* handle, uint8_t* out, uint64_t* sizes, int max_n) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  if (max_n > 256) max_n = 256;
+  // ONE table scan under the lock (an O(max_n * capacity) selection sort
+  // would stall every concurrent get/put for the duration): keep the
+  // max_n coldest entries in a small insertion-sorted window.
+  struct Cand { uint64_t tick; uint64_t size; uint8_t id[kIdLen]; };
+  Cand cand[256];
+  int n = 0;
+  lock(s);
+  Entry* t = table(s);
+  for (uint64_t i = 0; i < s->hdr->table_capacity; i++) {
+    Entry* e = &t[i];
+    if (e->state != kSealed || e->refcount != 0) continue;
+    if (n == max_n && e->lru_tick >= cand[n - 1].tick) continue;
+    int pos = (n < max_n) ? n : max_n - 1;
+    while (pos > 0 && cand[pos - 1].tick > e->lru_tick) {
+      cand[pos] = cand[pos - 1];
+      pos--;
+    }
+    cand[pos].tick = e->lru_tick;
+    cand[pos].size = e->size;
+    memcpy(cand[pos].id, e->id, kIdLen);
+    if (n < max_n) n++;
+  }
+  unlock(s);
+  for (int i = 0; i < n; i++) {
+    memcpy(out + i * kIdLen, cand[i].id, kIdLen);
+    sizes[i] = cand[i].size;
+  }
+  return n;
+}
+
 // List up to max_n sealed object ids into out (16 bytes each); returns count.
 int shm_store_list(void* handle, uint8_t* out, int max_n) {
   Store* s = reinterpret_cast<Store*>(handle);

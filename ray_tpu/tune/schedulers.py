@@ -106,3 +106,70 @@ class MedianStoppingRule(FIFOScheduler):
         mine = avgs[trial_id]
         worse = mine > med if self.mode == "min" else mine < med
         return STOP if worse else CONTINUE
+
+
+EXPLOIT = "EXPLOIT"
+
+
+class PopulationBasedTraining(FIFOScheduler):
+    """PBT: every `perturbation_interval` iterations, a bottom-quantile
+    trial exploits a top-quantile trial — the tuner clones the winner's
+    latest checkpoint and relaunches the loser with a mutated copy of the
+    winner's config (reference: tune/schedulers/pbt.py — same
+    exploit/explore loop; there it hot-swaps in-flight, here the trial
+    restarts from the cloned checkpoint, which is the pbt paper's
+    truncation selection variant).
+    """
+
+    def __init__(
+        self,
+        metric: str = "score",
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 4,
+        quantile_fraction: float = 0.25,
+        hyperparam_mutations: Optional[Dict] = None,
+        seed: Optional[int] = None,
+    ):
+        import random as _random
+
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.quantile = quantile_fraction
+        self.mutations = hyperparam_mutations or {}
+        self.scores: Dict[str, float] = {}
+        self.last_perturb: Dict[str, int] = {}
+        self._rng = _random.Random(seed)
+
+    def on_result(self, trial_id: str, result: Dict):
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        self.scores[trial_id] = float(value)
+        if t - self.last_perturb.get(trial_id, 0) < self.interval or len(self.scores) < 2:
+            return CONTINUE
+        self.last_perturb[trial_id] = t
+        ranked = sorted(self.scores, key=self.scores.get, reverse=(self.mode == "max"))
+        k = max(1, int(len(ranked) * self.quantile))
+        bottom, top = ranked[-k:], ranked[:k]
+        if trial_id in bottom and trial_id not in top:
+            return (EXPLOIT, self._rng.choice(top))
+        return CONTINUE
+
+    def mutate(self, config: Dict) -> Dict:
+        """Explore: perturb each mutable hyperparameter
+        (reference: pbt.py explore — x0.8/x1.2 for numeric, resample
+        for lists/callables)."""
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if callable(spec):
+                out[key] = spec()
+            elif isinstance(spec, (list, tuple)):
+                out[key] = self._rng.choice(list(spec))
+            elif isinstance(out.get(key), (int, float)):
+                factor = self._rng.choice([0.8, 1.2])
+                out[key] = type(out[key])(out[key] * factor)
+        return out

@@ -95,7 +95,8 @@ class _TrialActor:
         self.trial_id = trial_id
         self.queue = queue
 
-    def run(self, fn: Callable, config: Dict[str, Any], storage_dir: str):
+    def run(self, fn: Callable, config: Dict[str, Any], storage_dir: str,
+            restore_checkpoint: Optional[str] = None):
         from ray_tpu.air.session import _Session, _set_session
 
         class _Q:
@@ -112,7 +113,7 @@ class _TrialActor:
         session = _Session(
             0, 1, 0, _Q(self.queue, self.trial_id),
             storage_dir=storage_dir,
-            restore_checkpoint=None,
+            restore_checkpoint=restore_checkpoint,
         )
         _set_session(session)
         try:
@@ -142,6 +143,69 @@ class Tuner:
         self._space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restored: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable) -> "Tuner":
+        """Resume an interrupted run from its experiment dir: completed
+        trials keep their results, unfinished ones re-run with their
+        saved configs (reference: Tuner.restore +
+        tune/execution/experiment_state.py)."""
+        import json
+        import os
+
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            state = json.load(f)
+        tc = TuneConfig(
+            metric=state["metric"], mode=state["mode"],
+            num_samples=state["num_samples"], seed=state.get("seed"),
+        )
+        # the search space must survive the restore or the searcher
+        # could not generate the samples the interrupted run never reached
+        space = {}
+        if state.get("param_space_pkl"):
+            import base64
+
+            import cloudpickle
+
+            space = cloudpickle.loads(base64.b64decode(state["param_space_pkl"]))
+        tuner = cls(trainable, param_space=space, tune_config=tc)
+        tuner._restored = state
+        tuner._restored["path"] = path
+        return tuner
+
+    def _save_experiment_state(self, run_dir, trials, counter):
+        import json
+        import os
+
+        import base64
+
+        import cloudpickle
+
+        tc = self.tune_config
+        state = {
+            "metric": tc.metric,
+            "mode": tc.mode,
+            "num_samples": tc.num_samples,
+            "seed": getattr(tc, "seed", None),
+            "param_space_pkl": base64.b64encode(cloudpickle.dumps(self._space)).decode(),
+            "counter": counter,
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "config": t.config,
+                    "status": t.status,
+                    "metrics": t.metrics,
+                    "history": t.history,
+                    "error": t.error,
+                }
+                for t in trials.values()
+            ],
+        }
+        tmp = os.path.join(run_dir, "experiment_state.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(run_dir, "experiment_state.json"))
 
     def fit(self) -> ResultGrid:
         import os
@@ -155,23 +219,60 @@ class Tuner:
         # one run-scoped directory holds every trial's checkpoints. An
         # unnamed run gets a unique name so trial_00000 etc. never collide
         # with a previous run under the same storage_path.
-        run_dir = getattr(self.run_config, "storage_path", None)
-        name = getattr(self.run_config, "name", None)
-        if run_dir:
-            name = name or f"tune_run_{os.getpid()}_{int(time.time())}"
-            run_dir = os.path.join(os.path.expanduser(run_dir), name)
-            os.makedirs(run_dir, exist_ok=True)
+        if self._restored is not None:
+            run_dir = self._restored["path"]
         else:
-            run_dir = tempfile.mkdtemp(prefix="ray_tpu_tune_")
+            run_dir = getattr(self.run_config, "storage_path", None)
+            name = getattr(self.run_config, "name", None)
+            if run_dir:
+                name = name or f"tune_run_{os.getpid()}_{int(time.time())}"
+                run_dir = os.path.join(os.path.expanduser(run_dir), name)
+                os.makedirs(run_dir, exist_ok=True)
+            else:
+                run_dir = tempfile.mkdtemp(prefix="ray_tpu_tune_")
         self.run_dir = run_dir
 
         trials: Dict[str, TrialResult] = {}
         running: Dict[str, Any] = {}  # trial_id -> (actor, done_ref)
         counter = 0
         exhausted = False
+        relaunch: List[TrialResult] = []  # restored unfinished trials
+
+        if self._restored is not None:
+            counter = self._restored.get("counter", 0)
+            for rec in self._restored["trials"]:
+                t = TrialResult(rec["trial_id"], rec["config"])
+                t.status = rec["status"]
+                t.metrics = rec["metrics"]
+                t.history = rec["history"]
+                t.error = rec.get("error")
+                trials[t.trial_id] = t
+                if t.status in ("PENDING", "RUNNING"):
+                    t.history, t.metrics = [], {}
+                    relaunch.append(t)
+            # fast-forward the (seeded) searcher so continued sampling
+            # doesn't repeat the configs already emitted
+            for i in range(counter):
+                searcher.suggest(f"trial_{i:05d}")
+
+        generations: Dict[str, int] = {}
+
+        def _launch(trial_id, config, restore_from=None):
+            t = trials[trial_id]
+            t.status = "RUNNING"
+            actor = _TrialActor.options(num_cpus=1).remote(trial_id, queue)
+            done = actor.run.remote(
+                self._trainable, config, os.path.join(run_dir, trial_id), restore_from
+            )
+            generations[trial_id] = generations.get(trial_id, 0) + 1
+            running[trial_id] = (actor, done)
 
         def launch_next():
             nonlocal counter, exhausted
+            if relaunch:
+                t = relaunch.pop(0)
+                _launch(t.trial_id, t.config)
+                return True
             if exhausted:
                 return False
             trial_id = f"trial_{counter:05d}"
@@ -180,13 +281,17 @@ class Tuner:
                 exhausted = True
                 return False
             counter += 1
-            t = TrialResult(trial_id, config)
-            t.status = "RUNNING"
-            trials[trial_id] = t
-            actor = _TrialActor.options(num_cpus=1).remote(trial_id, queue)
-            done = actor.run.remote(self._trainable, config, os.path.join(run_dir, trial_id))
-            running[trial_id] = (actor, done)
+            trials[trial_id] = TrialResult(trial_id, config)
+            _launch(trial_id, config)
             return True
+
+        def _latest_checkpoint(trial_id) -> Optional[str]:
+            d = os.path.join(run_dir, trial_id)
+            try:
+                cks = sorted(c for c in os.listdir(d) if c.startswith("checkpoint_"))
+            except OSError:
+                return None
+            return os.path.join(d, cks[-1]) if cks else None
 
         def process_item(item) -> None:
             """Record one reported result and apply the scheduler's decision.
@@ -203,7 +308,8 @@ class Tuner:
             t.metrics = metrics
             if t.status in ("STOPPED", "TERMINATED", "ERROR"):
                 return
-            if scheduler.on_result(tid, metrics) == STOP:
+            decision = scheduler.on_result(tid, metrics)
+            if decision == STOP:
                 t.status = "STOPPED"
                 entry = running.pop(tid, None)
                 if entry is not None:
@@ -211,6 +317,20 @@ class Tuner:
                         ray_tpu.kill(entry[0])
                     except Exception:
                         pass
+            elif isinstance(decision, tuple) and decision[0] == "EXPLOIT":
+                # PBT exploit/explore: restart this trial from the
+                # winner's latest checkpoint with a mutated config
+                source = decision[1]
+                entry = running.pop(tid, None)
+                if entry is None:
+                    return
+                try:
+                    ray_tpu.kill(entry[0])
+                except Exception:
+                    pass
+                new_config = scheduler.mutate(dict(trials[source].config))
+                t.config = new_config
+                _launch(tid, new_config, restore_from=_latest_checkpoint(source))
 
         def drain(block: bool = False, timeout: float = 0.05) -> bool:
             """Process queued reports; returns True if anything arrived."""
@@ -231,12 +351,17 @@ class Tuner:
             drain()
             while len(running) < max_conc and launch_next():
                 pass
-            done_refs = {done: tid for tid, (_, done) in running.items()}
+            # snapshot generation with each ref: a PBT exploit may replace
+            # running[tid] with a fresh launch while this batch is being
+            # processed — a stale ref must not tear the relaunch down
+            done_refs = {done: (tid, generations.get(tid, 0)) for tid, (_, done) in running.items()}
             if not done_refs:
                 continue
             ready, _ = ray_tpu.wait(list(done_refs.keys()), num_returns=1, timeout=0.2)
             for ref in ready:
-                tid = done_refs[ref]
+                tid, gen = done_refs[ref]
+                if generations.get(tid, 0) != gen:
+                    continue  # the trial was relaunched (PBT exploit); stale ref
                 entry = running.pop(tid, None)
                 if entry is None:  # stopped by the scheduler during drain
                     continue
@@ -270,10 +395,12 @@ class Tuner:
                 except Exception:
                     pass
                 searcher.on_trial_complete(tid, t.metrics)
+                self._save_experiment_state(run_dir, trials, counter)
                 while len(running) < max_conc and launch_next():
                     pass
 
         drain()  # results reported just before the last completion
+        self._save_experiment_state(run_dir, trials, counter)
         try:
             queue.shutdown()
         except Exception:

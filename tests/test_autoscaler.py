@@ -1,0 +1,53 @@
+"""Autoscaler tests with the fake local node provider
+(reference: python/ray/tests/test_autoscaler_fake_multinode.py).
+"""
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import LocalNodeProvider, StandardAutoscaler
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture()
+def small_cluster():
+    os.environ["RAY_TPU_WORKER_POOL_PRESTART"] = "1"
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    c.connect()
+    yield c
+    c.shutdown()
+    os.environ.pop("RAY_TPU_WORKER_POOL_PRESTART", None)
+
+
+def test_scale_up_on_demand_then_down_when_idle(small_cluster):
+    """Pending tasks the head can't place launch worker nodes; idle
+    workers terminate after the idle timeout."""
+    provider = LocalNodeProvider(small_cluster, num_cpus=2)
+    autoscaler = StandardAutoscaler(
+        provider, min_workers=0, max_workers=2, idle_timeout_s=3.0,
+        worker_node_config={"num_cpus": 2},
+    )
+
+    @ray_tpu.remote(num_cpus=2)  # can never fit on the 1-CPU head
+    def big(x):
+        time.sleep(1)
+        return x * 10
+
+    refs = [big.remote(i) for i in range(2)]
+    time.sleep(1)  # demand reaches the GCS pending queue
+    report = autoscaler.update()
+    assert report["launched"] >= 1, "no node launched for unmet demand"
+    assert ray_tpu.get(refs, timeout=120) == [0, 10]
+
+    # idle: after the timeout the workers terminate
+    deadline = time.monotonic() + 60
+    terminated = 0
+    while time.monotonic() < deadline:
+        terminated += autoscaler.update()["terminated"]
+        if terminated >= 1 and not provider.non_terminated_nodes():
+            break
+        time.sleep(1)
+    assert terminated >= 1, "idle node never terminated"
+    assert not provider.non_terminated_nodes()

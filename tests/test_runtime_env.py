@@ -1,0 +1,53 @@
+"""runtime_env tests: job env_vars / working_dir / py_modules, per-task
+env overlay (reference: python/ray/tests/test_runtime_env*.py).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_job_runtime_env(tmp_path):
+    """Driver script (fresh process) with a full job runtime_env."""
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload")
+    mod = tmp_path / "envmod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("VALUE = 77\n")
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init(num_cpus=2, object_store_memory=64*1024*1024, runtime_env={\n"
+        f"    'env_vars': {{'JOB_V': 'jv'}}, 'working_dir': {str(wd)!r}, 'py_modules': [{str(mod)!r}],\n"
+        "})\n"
+        "@ray_tpu.remote\n"
+        "def probe():\n"
+        "    import os, envmod\n"
+        "    return (os.environ['JOB_V'], envmod.VALUE, open('data.txt').read())\n"
+        "print('RESULT', ray_tpu.get(probe.remote(), timeout=90))\n"
+        "ray_tpu.shutdown()\n"
+    )
+    r = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True, timeout=180,
+        env={**os.environ, "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")},
+    )
+    assert "RESULT ('jv', 77, 'payload')" in r.stdout, r.stdout + r.stderr
+
+
+def test_per_task_env_vars(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ONLY_HERE": "1"}})
+    def with_env():
+        return os.environ.get("ONLY_HERE")
+
+    @ray_tpu.remote
+    def without_env():
+        return os.environ.get("ONLY_HERE")
+
+    assert ray_tpu.get(with_env.remote(), timeout=60) == "1"
+    assert ray_tpu.get(without_env.remote(), timeout=60) is None
