@@ -37,6 +37,7 @@ __all__ = [
     "cluster_resources",
     "nodes",
     "get_runtime_context",
+    "timeline",
     "method",
     "exceptions",
 ]
@@ -171,6 +172,14 @@ class RuntimeContext:
 
     def get_job_id(self):
         return self.job_id
+
+
+def timeline(filename=None):
+    """Chrome-trace export of task events (reference: ray.timeline,
+    python/ray/_private/state.py:924)."""
+    from ray_tpu.util.timeline import timeline as _tl
+
+    return _tl(filename)
 
 
 def get_runtime_context() -> RuntimeContext:

@@ -41,6 +41,7 @@ _DEFS: Dict[str, Any] = {
     "actor_max_restarts_default": 0,
     # --- gcs ---
     "gcs_port": 0,  # 0 = auto
+    "dashboard_port": 0,  # 0 = auto (bound port written to session/dashboard_url)
     "kv_namespace_default": "default",
     # --- worker ---
     "worker_register_timeout_s": 60.0,

@@ -1297,6 +1297,21 @@ class CoreWorker:
                     self._record_lineage(spec["task_id"])
                 for item in reply["results"]:
                     self._deliver(bytes(item["oid"]), item["env"])
+                # direct tasks never touch the GCS scheduler — report their
+                # events here so the timeline / state API still sees them
+                # (reference: TaskEventBuffer flushing from every worker,
+                # task_event_buffer.h:206); one batched push per reply,
+                # with the worker-measured execution windows
+                now = time.time()
+                timings = reply.get("timings") or {}
+                events = []
+                for spec in batch:
+                    t0, t1 = timings.get(spec["task_id"], (now, now))
+                    events.append({"task_id": spec["task_id"], "name": spec.get("name", ""),
+                                   "state": "RUNNING", "time": t0, "actor_id": None})
+                    events.append({"task_id": spec["task_id"], "name": spec.get("name", ""),
+                                   "state": "FINISHED", "time": t1, "actor_id": None})
+                self._loop.create_task(self._gcs.push("events.report", {"events": events}))
         finally:
             st.leases.discard(lease_id)
             try:

@@ -1,0 +1,116 @@
+"""Dashboard — HTTP observability for the cluster.
+
+Equivalent of the reference's dashboard head
+(reference: dashboard/head.py:81 + dashboard/modules/{node,actor,job,
+metrics}): REST endpoints over the GCS state tables, a Prometheus
+/metrics exposition, and a minimal HTML overview. Runs inside the GCS
+process on its event loop (the reference runs a separate aiohttp
+process; one asyncio service is the TPU-pod-sized equivalent).
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: monospace; margin: 2em; background: #fafafa; }
+ h1 { font-size: 1.3em; } h2 { font-size: 1.05em; margin-top: 1.4em; }
+ table { border-collapse: collapse; }
+ td, th { border: 1px solid #ccc; padding: 4px 10px; text-align: left; }
+ th { background: #eee; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="content">loading…</div>
+<script>
+async function j(p) { return (await fetch(p)).json(); }
+function table(rows, cols) {
+  if (!rows.length) return "<i>none</i>";
+  let h = "<table><tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>";
+  for (const r of rows)
+    h += "<tr>" + cols.map(c => `<td>${JSON.stringify(r[c] ?? "")}</td>`).join("") + "</tr>";
+  return h + "</table>";
+}
+async function render() {
+  const [nodes, actors, jobs] = await Promise.all([
+    j("/api/nodes"), j("/api/actors"), j("/api/jobs")]);
+  document.getElementById("content").innerHTML =
+    "<h2>nodes</h2>" + table(nodes, ["node_id","state","resources_total","resources_available"]) +
+    "<h2>actors</h2>" + table(actors, ["actor_id","name","class_name","state","node_id"]) +
+    "<h2>jobs</h2>" + table(jobs, ["job_id","state","entrypoint"]);
+}
+render(); setInterval(render, 5000);
+</script>
+</body></html>
+"""
+
+
+async def start_dashboard(gcs, port: int) -> Optional[str]:
+    """Attach the dashboard app to the GCS; returns the bound address."""
+    try:
+        from aiohttp import web
+    except ImportError:
+        return None
+
+    async def _json(payload) -> web.Response:
+        return web.Response(text=json.dumps(payload, default=str), content_type="application/json")
+
+    async def index(request):
+        return web.Response(text=_PAGE, content_type="text/html")
+
+    async def api_nodes(request):
+        return await _json(await gcs._rpc_state_nodes({}, None))
+
+    async def api_actors(request):
+        return await _json(await gcs._rpc_state_actors({}, None))
+
+    async def api_jobs(request):
+        return await _json(await gcs._rpc_state_jobs({}, None))
+
+    async def api_tasks(request):
+        return await _json(await gcs._rpc_state_tasks({}, None))
+
+    async def api_objects(request):
+        return await _json(await gcs._rpc_state_objects({}, None))
+
+    async def api_pgs(request):
+        return await _json(await gcs._rpc_state_placement_groups({}, None))
+
+    async def api_cluster(request):
+        return await _json(
+            {
+                "resources_total": await gcs._rpc_cluster_resources({}, None),
+                "resources_available": await gcs._rpc_cluster_available_resources({}, None),
+                "time": time.time(),
+            }
+        )
+
+    async def metrics(request):
+        text = await gcs._rpc_metrics_text({}, None)
+        return web.Response(text=text, content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/", index)
+    app.router.add_get("/api/nodes", api_nodes)
+    app.router.add_get("/api/actors", api_actors)
+    app.router.add_get("/api/jobs", api_jobs)
+    app.router.add_get("/api/tasks", api_tasks)
+    app.router.add_get("/api/objects", api_objects)
+    app.router.add_get("/api/placement_groups", api_pgs)
+    app.router.add_get("/api/cluster", api_cluster)
+    app.router.add_get("/metrics", metrics)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    # localhost only: the endpoints expose unauthenticated cluster state
+    # (reference: the dashboard binds localhost by default for the same
+    # reason); opt into external exposure via RAY_TPU_DASHBOARD_HOST
+    import os as _os
+
+    host = _os.environ.get("RAY_TPU_DASHBOARD_HOST", "127.0.0.1")
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    bound = runner.addresses[0] if runner.addresses else (host, port)
+    return f"http://127.0.0.1:{bound[1] if isinstance(bound, tuple) else port}"

@@ -80,6 +80,12 @@ class KvManager:
             self._data[ns].update(d)
 
 
+def _prom_escape(v: str) -> str:
+    """Prometheus text-format label escaping: one bad value must not
+    corrupt the whole exposition."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _persistable_actor(rec: Dict[str, Any]) -> Dict[str, Any]:
     """Actor record minus live runtime fields (connections, waiters)."""
     return {k: v for k, v in rec.items() if k not in ("conn", "waiters")}
@@ -243,6 +249,16 @@ class GcsServer:
             f.write(tcp_addr + "\n" + f"unix:{sock_path}")
         asyncio.get_running_loop().create_task(self._scheduler_loop())
         asyncio.get_running_loop().create_task(self._health_loop())
+        try:
+            from ray_tpu._private.dashboard import start_dashboard
+
+            url = await start_dashboard(self, RayConfig.dashboard_port)
+            if url:
+                logger.info("dashboard at %s", url)
+                with open(os.path.join(self.session_dir, "dashboard_url"), "w") as f:
+                    f.write(url)
+        except Exception:
+            logger.warning("dashboard failed to start", exc_info=True)
         logger.info("GCS listening on %s and unix:%s", tcp_addr, sock_path)
 
     async def _handle(self, method: str, data: Any, conn: protocol.Connection):
@@ -1221,6 +1237,38 @@ class GcsServer:
 
     async def _rpc_state_placement_groups(self, d, conn):
         return await self._rpc_pg_table(d, conn)
+
+    async def _rpc_metrics_report(self, d, conn):
+        """Per-process metric push (reference: per-node metrics agent
+        aggregation, python/ray/_private/metrics_agent.py:416)."""
+        if not hasattr(self, "metrics"):
+            self.metrics: Dict[str, Any] = {}
+        self.metrics[d["reporter"]] = {"time": time.time(), "metrics": d["metrics"]}
+        return True
+
+    async def _rpc_metrics_text(self, d, conn):
+        """Aggregated Prometheus text exposition of every reporter's
+        metrics (reference: the Prometheus re-export of the agent)."""
+        if not hasattr(self, "metrics"):
+            return ""
+        lines: List[str] = []
+        seen_help: set = set()
+        cutoff = time.time() - 120
+        for reporter, rec in self.metrics.items():
+            if rec["time"] < cutoff:
+                continue
+            for m in rec["metrics"]:
+                if m["name"] not in seen_help:
+                    seen_help.add(m["name"])
+                    lines.append(f"# HELP {m['name']} {m.get('help', '')}")
+                    lines.append(f"# TYPE {m['name']} {m['type']}")
+                for s in m["samples"]:
+                    tags = {**s["tags"], "reporter": reporter[:12]}
+                    label = ",".join(
+                        f'{k}="{_prom_escape(str(v))}"' for k, v in sorted(tags.items())
+                    )
+                    lines.append(f"{s['name']}{{{label}}} {s['value']}")
+        return "\n".join(lines) + "\n"
 
     async def _rpc_autoscaler_load(self, d, conn):
         """Resource demand + node utilization for the autoscaler

@@ -64,7 +64,11 @@ def publish(core, runtime_env: Dict[str, Any]) -> None:
         blob = _zip_dir(mod)
         digest = hashlib.sha256(blob).hexdigest()[:16]
         core.gcs_request("kv.put", {"ns": _KV_NS, "key": f"pkg_{digest}", "value": blob})
-        mods.append({"digest": digest, "name": os.path.basename(os.path.abspath(mod))})
+        mods.append({
+            "digest": digest,
+            "name": os.path.basename(os.path.abspath(mod)),
+            "is_file": os.path.isfile(mod),
+        })
     if mods:
         spec["py_module_pkgs"] = mods
     core.gcs_request(
@@ -89,53 +93,64 @@ def _materialize_pkg(core, session_dir: str, digest: str, as_module: Optional[st
     return dest
 
 
-_applied_jobs: set = set()
+_job_specs: Dict[str, Dict[str, Any]] = {}
 
 
-def ensure_job_env(core, session_dir: str, job_id: Optional[str]) -> None:
-    """Worker-side: apply a job's runtime_env once, LAZILY at the first
-    task of that job — prestarted workers boot before any driver has
-    published, so a startup-time fetch would race to an empty key."""
-    if not job_id or job_id in _applied_jobs:
-        return
-    _applied_jobs.add(job_id)
-    try:
-        apply_job_env(core, session_dir, job_id)
-    except Exception:
-        _applied_jobs.discard(job_id)
-        raise
+def ensure_job_env(core, session_dir: str, job_id: Optional[str]) -> Dict[str, Any]:
+    """Worker-side: materialize a job's runtime_env once, LAZILY at the
+    first task of that job — prestarted workers boot before any driver
+    has published, so a startup-time fetch would race to an empty key.
 
-
-def apply_job_env(core, session_dir: str, job_id: str) -> None:
+    Packages land on sys.path permanently (paths are digest-unique);
+    env_vars and the working-directory chdir are returned for the caller
+    to apply as a PER-EXECUTION overlay, because pooled workers are
+    shared across jobs — a permanent apply would leak one job's
+    environment into another's tasks."""
+    if not job_id:
+        return {}
+    spec = _job_specs.get(job_id)
+    if spec is not None:
+        return spec
     blob = core.gcs_request("kv.get", {"ns": _KV_NS, "key": f"job_{job_id}"})
     if not blob:
-        return
-    spec = json.loads(bytes(blob))
-    for k, v in (spec.get("env_vars") or {}).items():
-        os.environ[k] = str(v)
-    for mod in spec.get("py_module_pkgs") or []:
-        root = _materialize_pkg(core, session_dir, mod["digest"], as_module=mod["name"])
+        _job_specs[job_id] = {}
+        return {}
+    raw = json.loads(bytes(blob))
+    spec = {"env_vars": raw.get("env_vars") or {}}
+    for mod in raw.get("py_module_pkgs") or []:
+        # single-file modules extract at the package root (the file IS the
+        # module); package dirs extract under their package name
+        as_module = None if mod.get("is_file") else mod["name"]
+        root = _materialize_pkg(core, session_dir, mod["digest"], as_module=as_module)
         if root not in sys.path:
             sys.path.insert(0, root)
-    digest = spec.get("working_dir_pkg")
+    digest = raw.get("working_dir_pkg")
     if digest:
         wd = _materialize_pkg(core, session_dir, digest)
         if wd not in sys.path:
             sys.path.insert(0, wd)
-        os.chdir(wd)
+        spec["cwd"] = wd
+    _job_specs[job_id] = spec
+    return spec
 
 
 class env_overlay:
-    """Context manager applying per-task env_vars around one execution."""
+    """Context manager applying env_vars (and optionally a working
+    directory) around one execution, restoring the previous state."""
 
-    def __init__(self, env_vars: Optional[Dict[str, str]]):
+    def __init__(self, env_vars: Optional[Dict[str, str]], cwd: Optional[str] = None):
         self.env_vars = env_vars or {}
+        self.cwd = cwd
         self._saved: Dict[str, Optional[str]] = {}
+        self._saved_cwd: Optional[str] = None
 
     def __enter__(self):
         for k, v in self.env_vars.items():
             self._saved[k] = os.environ.get(k)
             os.environ[k] = str(v)
+        if self.cwd:
+            self._saved_cwd = os.getcwd()
+            os.chdir(self.cwd)
 
     def __exit__(self, *exc):
         for k, old in self._saved.items():
@@ -143,3 +158,8 @@ class env_overlay:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = old
+        if self._saved_cwd is not None:
+            try:
+                os.chdir(self._saved_cwd)
+            except OSError:
+                pass

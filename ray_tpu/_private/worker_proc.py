@@ -79,11 +79,14 @@ class Executor:
     async def _create_actor(self, spec) -> Dict[str, Any]:
         try:
             def _construct():
-                from ray_tpu._private.runtime_env import ensure_job_env
+                from ray_tpu._private.runtime_env import ensure_job_env, env_overlay
 
-                ensure_job_env(self.core, self.core.session_dir, spec.get("job_id"))
+                job_env = ensure_job_env(self.core, self.core.session_dir, spec.get("job_id"))
                 cls = self.core.load_function(spec["fn_id"])
                 args, kwargs = self.core.unpack_args(spec["args"])
+                # an actor worker is bound to its job for life: its env
+                # may apply permanently (constructors often capture cwd)
+                env_overlay(job_env.get("env_vars"), cwd=job_env.get("cwd")).__enter__()
                 return cls(*args, **kwargs)
 
             instance = await asyncio.get_running_loop().run_in_executor(self.pool, _construct)
@@ -123,14 +126,18 @@ class Executor:
                 )
             else:
                 runnable.append(spec)
+        timings = {}
         if runnable:
             loop = asyncio.get_running_loop()
             env_lists = await loop.run_in_executor(
                 self.pool, self._exec_sync_batch, runnable, False, loop
             )
+            timings = getattr(self, "_batch_timings", {})
             for spec, envs in zip(runnable, env_lists):
                 results.extend({"oid": oid, "env": env} for oid, env in zip(spec["returns"], envs))
-        return {"results": results}
+        # real execution windows so the owner can report honest timeline
+        # events for the direct path
+        return {"results": results, "timings": timings}
 
     async def handle_actor_call(self, data, conn) -> Dict[str, Any]:
         """Direct actor invocation. Calls from one caller arrive in
@@ -190,15 +197,20 @@ class Executor:
         after the whole batch — without staging that is a deadlock. The
         stage is dropped once the batch returns (the owner serves
         resolves from then on)."""
+        import time as _time
+
         out = []
         staged = []
+        self._batch_timings = {}
         try:
             for spec in specs:
                 appended = False
+                t0 = _time.time()
                 try:
                     envs = self._exec_sync_one(spec, actor, loop)
                     out.append(envs)
                     appended = True
+                    self._batch_timings[spec["task_id"]] = (t0, _time.time())
                     for oid, env in zip(spec["returns"], envs):
                         self.core._deliver(bytes(oid), env)
                         staged.append(bytes(oid))
@@ -232,16 +244,21 @@ class Executor:
                     raise exceptions.TaskCancelledError(spec.get("name", ""))
                 from ray_tpu._private.runtime_env import ensure_job_env, env_overlay
 
-                # job-level runtime_env applied lazily at the job's first
-                # task here (prestarted workers boot before the publish)
-                ensure_job_env(self.core, self.core.session_dir, spec.get("job_id"))
+                # job runtime_env: packages materialize once (lazily at
+                # the job's first task — prestarted workers boot before
+                # the publish); env_vars and working_dir overlay around
+                # THIS execution only, since pooled workers serve many
+                # jobs and nothing may leak across them
+                job_env = ensure_job_env(self.core, self.core.session_dir, spec.get("job_id"))
                 if actor:
                     fn = getattr(self.actor_instance, spec["method"])
                 else:
                     fn = self.core.load_function(spec["fn_id"])
                 args, kwargs = self.core.unpack_args(spec["args"])
+                merged_env = {**job_env.get("env_vars", {}),
+                              **((spec.get("runtime_env") or {}).get("env_vars") or {})}
 
-                with env_overlay((spec.get("runtime_env") or {}).get("env_vars")):
+                with env_overlay(merged_env, cwd=job_env.get("cwd")):
                     if inspect.iscoroutinefunction(fn):
                         import asyncio as _a
 

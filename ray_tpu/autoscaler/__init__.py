@@ -98,6 +98,13 @@ class StandardAutoscaler:
         launched = terminated = 0
         workers = set(self.provider.non_terminated_nodes())
 
+        # min_workers is a FLOOR on launches, not just a scale-down guard
+        # (reference: StandardAutoscaler maintains min_workers proactively)
+        while len(workers) < self.min_workers:
+            nid = self.provider.create_node(self.worker_node_config)
+            workers.add(nid)
+            launched += 1
+
         # -------- scale up: bin-pack pending shapes onto available slack;
         # whatever doesn't fit demands new nodes
         slack = [

@@ -45,6 +45,7 @@ class JobSupervisor:
         core = get_global_core()
         self.job_id = job_id
         self.entrypoint = entrypoint
+        self._stopping = False
         session_dir = core.session_dir
         self.log_path = os.path.join(session_dir, "logs", f"job-{job_id}.log")
         env = dict(os.environ)
@@ -82,18 +83,24 @@ class JobSupervisor:
 
     def _wait(self):
         code = self.proc.wait()
-        self._set_status(JobStatus.SUCCEEDED if code == 0 else JobStatus.FAILED, exit_code=code)
+        if self._stopping:
+            # a deliberate stop() must not be recorded FAILED just because
+            # SIGTERM's exit code is nonzero
+            self._set_status(JobStatus.STOPPED, exit_code=code)
+        else:
+            self._set_status(JobStatus.SUCCEEDED if code == 0 else JobStatus.FAILED, exit_code=code)
         # terminal: the supervisor exits so it doesn't pin a worker
         # process forever (reference: JobSupervisor exits after recording
         # terminal state); clients read status/logs from the KV + log file
         import time as _t
 
-        _t.sleep(2.0)  # let any in-flight stop()/poll() RPC drain
+        _t.sleep(5.0)  # let any in-flight stop()/poll() RPC drain
         os._exit(0)
 
     def stop(self):
         import signal
 
+        self._stopping = True
         if self.proc.poll() is None:
             try:
                 os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
@@ -103,7 +110,6 @@ class JobSupervisor:
                 self.proc.wait(timeout=10)
             except Exception:
                 self.proc.kill()
-            self._set_status(JobStatus.STOPPED)
         return True
 
     def poll(self):

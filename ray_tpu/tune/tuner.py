@@ -146,17 +146,21 @@ class Tuner:
         self._restored: Optional[Dict[str, Any]] = None
 
     @classmethod
-    def restore(cls, path: str, trainable: Callable) -> "Tuner":
+    def restore(cls, path: str, trainable: Callable,
+                tune_config: Optional[TuneConfig] = None) -> "Tuner":
         """Resume an interrupted run from its experiment dir: completed
         trials keep their results, unfinished ones re-run with their
         saved configs (reference: Tuner.restore +
-        tune/execution/experiment_state.py)."""
+        tune/execution/experiment_state.py). Schedulers and searchers
+        hold live state that the JSON cannot carry — pass the original
+        `tune_config` (with its scheduler/search_alg) to resume under
+        the same policy; otherwise the restored run continues FIFO."""
         import json
         import os
 
         with open(os.path.join(path, "experiment_state.json")) as f:
             state = json.load(f)
-        tc = TuneConfig(
+        tc = tune_config or TuneConfig(
             metric=state["metric"], mode=state["mode"],
             num_samples=state["num_samples"], seed=state.get("seed"),
         )

@@ -1,0 +1,143 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Equivalent of the reference's `ray.util.metrics`
+(reference: python/ray/util/metrics.py backed by the C++ opencensus
+pipeline, src/ray/stats/metric.h:103 → per-node metrics agent →
+Prometheus). Here every process reports its metrics to the GCS on a
+timer and the GCS exposes the Prometheus text format at
+`gcs.metrics_text` (served over HTTP by the dashboard's /metrics).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+_flusher_started = [False]
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    metric_type = "untyped"
+
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry.append(self)
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        return {**self._default_tags, **(tags or {})}
+
+    def _samples(self) -> List[Tuple[str, Dict[str, str], float]]:
+        with self._lock:
+            return [(self.name, dict(k), v) for k, v in self._values.items()]
+
+
+class Counter(Metric):
+    metric_type = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    metric_type = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[_tags_key(self._merged(tags))] = float(value)
+
+
+class Histogram(Metric):
+    """Prometheus-style cumulative histogram."""
+
+    metric_type = "histogram"
+
+    def __init__(self, name: str, description: str = "", boundaries: Sequence[float] = (),
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries) or [0.1, 1.0, 10.0]
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.boundaries) + 1))
+            import bisect
+
+            counts[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def _samples(self):
+        out = []
+        with self._lock:
+            for key, counts in self._counts.items():
+                tags = dict(key)
+                cum = 0
+                for b, c in zip(self.boundaries, counts):
+                    cum += c
+                    out.append((f"{self.name}_bucket", {**tags, "le": str(b)}, float(cum)))
+                total = sum(counts)
+                out.append((f"{self.name}_bucket", {**tags, "le": "+Inf"}, float(total)))
+                out.append((f"{self.name}_count", tags, float(total)))
+                out.append((f"{self.name}_sum", tags, self._sums.get(key, 0.0)))
+        return out
+
+
+def _collect_local() -> List[Dict]:
+    with _registry_lock:
+        metrics = list(_registry)
+    out = []
+    for m in metrics:
+        out.append({
+            "name": m.name,
+            "type": m.metric_type,
+            "help": m.description,
+            "samples": [{"name": n, "tags": t, "value": v} for n, t, v in m._samples()],
+        })
+    return out
+
+
+def _flush_once():
+    from ray_tpu._private.worker import get_global_core
+
+    core = get_global_core()
+    core.gcs_request(
+        "metrics.report", {"reporter": core.worker_id, "metrics": _collect_local()}
+    )
+
+
+def _ensure_flusher():
+    if _flusher_started[0]:
+        return
+    _flusher_started[0] = True
+
+    def _loop():
+        from ray_tpu._private.config import RayConfig
+
+        while True:
+            time.sleep(RayConfig.metrics_report_period_s)
+            try:
+                _flush_once()
+            except Exception:
+                pass
+
+    threading.Thread(target=_loop, daemon=True, name="metrics-flush").start()

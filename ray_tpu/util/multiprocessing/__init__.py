@@ -1,0 +1,92 @@
+"""multiprocessing.Pool API over ray_tpu tasks.
+
+Equivalent of the reference's `ray.util.multiprocessing.Pool`
+(reference: python/ray/util/multiprocessing/pool.py): the standard
+Pool surface (map/starmap/apply/imap/async variants) where each chunk
+is a ray_tpu task, so a Pool spans the cluster rather than one host.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _run_chunk(fn, chunk, star: bool):
+    return [fn(*item) if star else fn(item) for item in chunk]
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any]):
+        self._refs = refs
+
+    def get(self, timeout: Optional[float] = None) -> List[Any]:
+        parts = ray_tpu.get(self._refs, timeout=timeout)
+        return [x for part in parts for x in part]
+
+    def wait(self, timeout: Optional[float] = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=0)
+        return len(ready) == len(self._refs)
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._processes = processes or int(ray_tpu.cluster_resources().get("CPU", 4))
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        for i in range(0, len(items), chunksize):
+            yield items[i : i + chunksize]
+
+    def map_async(self, fn: Callable, iterable: Iterable, chunksize: Optional[int] = None) -> AsyncResult:
+        return AsyncResult([_run_chunk.remote(fn, c, False) for c in self._chunks(iterable, chunksize)])
+
+    def map(self, fn, iterable, chunksize=None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        return AsyncResult([_run_chunk.remote(fn, c, True) for c in self._chunks(iterable, chunksize)])
+
+    def starmap(self, fn, iterable, chunksize=None) -> List[Any]:
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def apply_async(self, fn, args=(), kwds=None) -> AsyncResult:
+        @ray_tpu.remote
+        def _apply(f, a, kw):
+            return [f(*a, **(kw or {}))]
+
+        return AsyncResult([_apply.remote(fn, args, kwds)])
+
+    def apply(self, fn, args=(), kwds=None):
+        return self.apply_async(fn, args, kwds).get()[0]
+
+    def imap(self, fn, iterable, chunksize: Optional[int] = 1):
+        refs = [_run_chunk.remote(fn, c, False) for c in self._chunks(iterable, chunksize)]
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    imap_unordered = imap  # ordering is per-chunk anyway
+
+    def close(self):
+        pass
+
+    def join(self):
+        pass
+
+    def terminate(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

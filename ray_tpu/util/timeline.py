@@ -1,0 +1,59 @@
+"""Chrome-trace timeline export of task events.
+
+Equivalent of the reference's `ray.timeline()`
+(reference: python/ray/_private/state.py:924 — Chrome trace JSON from
+the GCS task-event table; open in chrome://tracing or Perfetto).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.worker import get_global_core
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Task state transitions as Chrome trace events. Each task becomes
+    a duration ("X") event on its worker's row from RUNNING to
+    FINISHED/FAILED, plus instant events for scheduling transitions."""
+    events = get_global_core().gcs_request("state.tasks", {"limit": 100000})
+    starts: Dict[str, Dict[str, Any]] = {}
+    trace: List[Dict[str, Any]] = []
+    for ev in events:
+        tid = ev["task_id"]
+        state = ev["state"]
+        ts_us = ev["time"] * 1e6
+        row = ev.get("worker_id") or ev.get("node_id") or "scheduler"
+        if state == "RUNNING":
+            starts[tid] = ev
+        elif state in ("FINISHED", "FAILED") and tid in starts:
+            st = starts.pop(tid)
+            trace.append(
+                {
+                    "name": st.get("name", "task"),
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": st["time"] * 1e6,
+                    "dur": max(0.0, ts_us - st["time"] * 1e6),
+                    "pid": "ray_tpu",
+                    "tid": (st.get("worker_id") or row)[:12],
+                    "args": {"task_id": tid, "outcome": state},
+                }
+            )
+        else:
+            trace.append(
+                {
+                    "name": f"{ev.get('name', 'task')}:{state}",
+                    "cat": "scheduling",
+                    "ph": "i",
+                    "ts": ts_us,
+                    "pid": "ray_tpu",
+                    "tid": row[:12],
+                    "s": "t",
+                    "args": {"task_id": tid},
+                }
+            )
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -1,0 +1,79 @@
+"""Observability tests: metrics pipeline, dashboard HTTP, timeline export,
+multiprocessing Pool (reference: ray.util.metrics / dashboard modules /
+ray.timeline / ray.util.multiprocessing).
+"""
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+def test_metrics_pipeline(ray_start_regular):
+    from ray_tpu._private.worker import get_global_core
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_requests_total", "requests", tag_keys=("route",))
+    c.inc(3, tags={"route": "/a"})
+    g = metrics.Gauge("test_queue_depth", "depth")
+    g.set(7)
+    h = metrics.Histogram("test_latency_s", "latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(5.0)
+    metrics._flush_once()
+    text = get_global_core().gcs_request("metrics.text", {})
+    assert 'test_requests_total{reporter=' in text or "test_requests_total{" in text
+    assert "test_queue_depth" in text
+    assert "test_latency_s_bucket" in text
+    assert "# TYPE test_requests_total counter" in text
+
+
+def test_dashboard_http(ray_start_regular):
+    from ray_tpu._private.worker import global_worker
+
+    url_file = os.path.join(global_worker.session_dir, "dashboard_url")
+    deadline = time.time() + 20
+    while time.time() < deadline and not os.path.exists(url_file):
+        time.sleep(0.5)
+    assert os.path.exists(url_file), "dashboard never started"
+    base = open(url_file).read().strip()
+    nodes = json.load(urllib.request.urlopen(base + "/api/nodes", timeout=20))
+    assert nodes and nodes[0]["state"] == "ALIVE"
+    page = urllib.request.urlopen(base + "/", timeout=20).read().decode()
+    assert "ray_tpu dashboard" in page
+    metrics_text = urllib.request.urlopen(base + "/metrics", timeout=20).read().decode()
+    assert isinstance(metrics_text, str)
+
+
+def test_timeline_export(ray_start_regular, tmp_path):
+    from ray_tpu.util.timeline import timeline
+
+    @ray_tpu.remote
+    def traced(x):
+        return x + 1
+
+    ray_tpu.get([traced.remote(i) for i in range(3)], timeout=60)
+    time.sleep(1)
+    path = str(tmp_path / "trace.json")
+    events = timeline(path)
+    assert os.path.exists(path)
+    data = json.load(open(path))
+    assert isinstance(data, list)
+    assert any(e.get("ph") == "X" and e.get("name") == "traced" for e in data) or any(
+        "traced" in str(e.get("name")) for e in data
+    )
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as p:
+        assert p.map(lambda x: x * x, range(10)) == [x * x for x in range(10)]
+        assert p.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        assert p.apply(lambda a: a * 10, (4,)) == 40
+        assert list(p.imap(lambda x: -x, [1, 2, 3])) == [-1, -2, -3]
+        r = p.map_async(lambda x: x + 1, range(5))
+        assert r.get(timeout=60) == [1, 2, 3, 4, 5]
