@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.bc.bc import BC, BCConfig  # noqa: F401

@@ -1,0 +1,144 @@
+"""BC — Behavior Cloning (offline RL).
+
+Equivalent of the reference's BC algorithm
+(reference: rllib/algorithms/bc/bc.py — supervised learning on expert
+(obs, action) pairs through the same RLModule/Learner stack as the
+online algorithms; a BCConfig.offline_data dataset replaces the
+EnvRunnerGroup sampling loop).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner.learner import Learner
+
+
+class BCLearner(Learner):
+    """Negative log-likelihood of expert actions under the policy."""
+
+    def compute_loss(self, params, batch):
+        import jax
+
+        out = self.module.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(out["logits"])
+        logp = jnp.take_along_axis(logp_all, batch["actions"][:, None], axis=1)[:, 0]
+        loss = -jnp.mean(logp)
+        probs = jnp.exp(logp_all)
+        entropy = -jnp.mean(jnp.sum(probs * logp_all, axis=-1))
+        accuracy = jnp.mean((jnp.argmax(out["logits"], axis=-1) == batch["actions"]).astype(jnp.float32))
+        return loss, {"total_loss": loss, "entropy": entropy, "accuracy": accuracy}
+
+
+class BCConfig(AlgorithmConfig):
+    learner_class = BCLearner
+
+    def __init__(self):
+        super().__init__()
+        self.offline_data: Dict[str, Any] = {}  # {"obs": [N, ...], "actions": [N]}
+        self.num_epochs = 1
+
+    def offline(self, data=None):
+        """data: {"obs": array, "actions": array} expert transitions, or a
+        ray_tpu.data Dataset with those columns."""
+        if data is not None:
+            self.offline_data = data
+        return self
+
+    def copy(self) -> "BCConfig":
+        # the dataset may be huge: share it by reference instead of
+        # deep-copying it through build() (and pickling it into every
+        # checkpoint via save_to_path)
+        data, self.offline_data = self.offline_data, {}
+        try:
+            out = super().copy()
+        finally:
+            self.offline_data = data
+        out.offline_data = data
+        return out
+
+
+class BC(Algorithm):
+    config_class = BCConfig
+
+    def __init__(self, config):
+        from ray_tpu.rllib.core.learner.learner_group import LearnerGroup
+        from ray_tpu.rllib.utils.env import env_spaces
+
+        data = config.offline_data
+        if not (hasattr(data, "iter_batches") or ("obs" in data and "actions" in data)):
+            raise ValueError(
+                "BC requires expert data: BCConfig().offline({'obs': ..., 'actions': ...}) "
+                "or a ray_tpu.data Dataset with those columns"
+            )
+        # offline: no env stepping — spaces come from the env spec; the
+        # base Algorithm bookkeeping (_iteration, _weights_seq, inference
+        # cache contract) is shared, only the sampling side is replaced
+        self.config = config
+        self.env_runner_group = None
+        self._spaces = env_spaces(config)
+        self.learner_group = LearnerGroup(config, *self._spaces)
+        self._iteration = 0
+        self._weights_seq = 0
+        self._env_steps_lifetime = 0
+        self._recent_returns: list = []
+        if hasattr(data, "iter_batches"):  # a ray_tpu.data Dataset
+            obs_parts, act_parts = [], []
+            for b in data.iter_batches(batch_size=4096, batch_format="numpy"):
+                obs_parts.append(np.asarray(b["obs"]))
+                act_parts.append(np.asarray(b["actions"]))
+            data = {"obs": np.concatenate(obs_parts), "actions": np.concatenate(act_parts)}
+        self._batch = {
+            "obs": np.asarray(data["obs"], dtype=np.float32),
+            "actions": np.asarray(data["actions"], dtype=np.int64),
+        }
+        self._eval_module = None
+
+    def training_step(self) -> Dict[str, Any]:
+        stats = self.learner_group.update(self._batch)
+        self._weights_seq += 1  # inference caches invalidate per train()
+        return {"learner": stats, "episode_return_mean": float("nan"),
+                "num_offline_samples": len(self._batch["actions"])}
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax
+        import time
+
+        if getattr(self, "_infer_cache_seq", None) != self._weights_seq:
+            if self._eval_module is None:
+                self._eval_module = self.config.build_module(*self._spaces)
+            self._infer_weights = self.learner_group.get_weights()
+            self._infer_cache_seq = self._weights_seq
+        out = self._eval_module.forward(self._infer_weights, jnp.asarray(obs, dtype=jnp.float32)[None])
+        if explore:
+            key = jax.random.PRNGKey(int(time.monotonic_ns() % (2**31)))
+            return int(jax.random.categorical(key, out["logits"])[0])
+        return int(jnp.argmax(out["logits"], axis=-1)[0])
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        """Greedy rollouts of the cloned policy."""
+        from ray_tpu.rllib.utils.env import make_single_env
+
+        env = make_single_env(self.config)
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=1000 + ep)
+            total, done = 0.0, False
+            while not done:
+                action = self.compute_single_action(obs)
+                obs, r, term, trunc, _ = env.step(action)
+                total += float(r)
+                done = term or trunc
+            returns.append(total)
+        env.close()
+        return {"episode_return_mean": float(np.mean(returns)), "episodes": returns}
+
+    def stop(self) -> None:
+        self.learner_group.stop()
+
+
+BCConfig.algo_class = BC
