@@ -8,7 +8,10 @@ multi-learner gradient reduction is an ICI psum under pjit (or
 lockstep pytree averaging across learner actors on separate hosts).
 """
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup  # noqa: F401
 from ray_tpu.rllib.core.rl_module import RLModule, DiscreteMLPModule  # noqa: F401

@@ -46,6 +46,10 @@ class AlgorithmConfig:
         # module
         self.module_class = None
         self.model_config: Dict[str, Any] = {"hidden": (64, 64)}
+        # runner class (value-based algos swap in the off-policy runner)
+        self.env_runner_cls = None
+        # "complete" → flat GAE batches; "time_major" → (E, T) sequences
+        self.batch_mode = "complete"
         # misc
         self.seed = 0
 
@@ -130,15 +134,16 @@ class EnvRunnerGroup:
     def __init__(self, config):
         from ray_tpu.rllib.env.single_agent_env_runner import SingleAgentEnvRunner
 
+        runner_cls = config.env_runner_cls or SingleAgentEnvRunner
         self.config = config
-        self.local_runner: Optional[SingleAgentEnvRunner] = None
+        self.local_runner = None
         self.remote_runners: List[Any] = []
         if config.num_env_runners == 0:
-            self.local_runner = SingleAgentEnvRunner(config, worker_index=0)
+            self.local_runner = runner_cls(config, worker_index=0)
         else:
             import ray_tpu
 
-            remote_cls = ray_tpu.remote(SingleAgentEnvRunner)
+            remote_cls = ray_tpu.remote(runner_cls)
             self.remote_runners = [
                 remote_cls.options(num_cpus=config.num_cpus_per_env_runner).remote(config, worker_index=i + 1)
                 for i in range(config.num_env_runners)
@@ -159,15 +164,15 @@ class EnvRunnerGroup:
 
         return ray_tpu.get([r.sample.remote() for r in self.remote_runners], timeout=300)
 
-    def sync_weights(self, weights, seq: int) -> None:
+    def sync_weights(self, weights, seq: int, **vars) -> None:
         if self.local_runner is not None:
-            self.local_runner.set_weights(weights, seq)
+            self.local_runner.set_weights(weights, seq, **vars)
             return
         import ray_tpu
         from ray_tpu._private.worker import get_global_core
 
         ref = ray_tpu.put(weights)
-        ray_tpu.get([r.set_weights.remote(ref, seq) for r in self.remote_runners])
+        ray_tpu.get([r.set_weights.remote(ref, seq, **vars) for r in self.remote_runners])
         # one broadcast object per training iteration: free it eagerly or
         # the store (and its GCS record) grows without bound
         get_global_core().free([ref])

@@ -1,0 +1,42 @@
+"""APPO — asynchronous PPO: the PPO clipped surrogate on v-trace targets.
+
+Equivalent of the reference's APPO
+(reference: rllib/algorithms/appo/appo.py — IMPALA's architecture with
+PPO's clip objective). Shares IMPALA's runner path (time-major
+sequences, one-generation-stale weights), v-trace and value/entropy
+terms; only the policy term differs — a clipped importance-ratio
+surrogate, which tolerates re-epoching over the batch.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.impala.impala import IMPALA, IMPALAConfig, IMPALALearner
+
+
+class APPOLearner(IMPALALearner):
+    def _pg_loss(self, target_logp, behavior_logp, pg_adv, valid, n):
+        cfg = self.config
+        ratio = jnp.exp(target_logp - behavior_logp)
+        surr = jnp.minimum(
+            ratio * pg_adv,
+            jnp.clip(ratio, 1.0 - cfg.clip_param, 1.0 + cfg.clip_param) * pg_adv,
+        )
+        return -jnp.sum(surr * valid) / n
+
+
+class APPOConfig(IMPALAConfig):
+    learner_class = APPOLearner
+
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.num_epochs = 2  # the clip objective tolerates re-epoching
+        self.minibatch_size = 32  # sequences per minibatch
+
+
+class APPO(IMPALA):
+    config_class = APPOConfig
+
+
+APPOConfig.algo_class = APPO

@@ -1,0 +1,110 @@
+"""DQN — value-based off-policy learning with replay.
+
+Equivalent of the reference's DQN/DQNConfig
+(reference: rllib/algorithms/dqn/dqn.py: training_step samples into an
+(optionally prioritized) replay buffer, then runs TD updates at a
+sample/train ratio, syncing target nets and runner weights). Epsilon
+decays against the global sampled-step count, pushed to runners with
+each weight sync.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn.dqn_learner import DQNLearner
+from ray_tpu.rllib.env.off_policy_env_runner import OffPolicyEnvRunner
+
+
+class DQNConfig(AlgorithmConfig):
+    learner_class = DQNLearner
+
+    def __init__(self):
+        super().__init__()
+        self.env_runner_cls = OffPolicyEnvRunner
+        self.lr = 5e-4
+        self.train_batch_size = 32  # per TD update (replay sample size)
+        self.replay_buffer_capacity = 50_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.target_network_update_freq = 120  # in updates
+        self.double_q = True
+        self.prioritized_replay = False
+        self.per_alpha = 0.6
+        self.per_beta = 0.4
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.02
+        self.epsilon_timesteps = 10_000
+        # TD updates per training_step = sampled_steps * training_intensity / batch
+        self.training_intensity = 1.0
+        self.rollout_fragment_length = 4
+        self.num_envs_per_env_runner = 8
+
+
+class DQN(Algorithm):
+    config_class = DQNConfig
+
+    def __init__(self, config):
+        super().__init__(config)
+        from ray_tpu.rllib.utils.replay_buffers import (
+            PrioritizedReplayBuffer,
+            ReplayBuffer,
+        )
+
+        if config.prioritized_replay and config.num_learners > 0:
+            raise ValueError(
+                "prioritized_replay requires the local learner (num_learners=0): "
+                "remote lockstep learners do not return per-sample TD errors, so "
+                "priorities would silently never update"
+            )
+        if config.prioritized_replay:
+            self.replay = PrioritizedReplayBuffer(
+                config.replay_buffer_capacity,
+                alpha=config.per_alpha,
+                beta=config.per_beta,
+                seed=config.seed,
+            )
+        else:
+            self.replay = ReplayBuffer(config.replay_buffer_capacity, seed=config.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+
+        # 1. weights + global step (for epsilon) out to the samplers
+        self._weights_seq += 1
+        self.env_runner_group.sync_weights(
+            self.learner_group.get_weights(),
+            self._weights_seq,
+            global_step=self._env_steps_lifetime,
+        )
+
+        # 2. sample one round of fragments into the replay buffer
+        samples = self.env_runner_group.sample()
+        sampled = 0
+        for s in samples:
+            self.replay.add(s["batch"])
+            sampled += s["metrics"]["num_env_steps"]
+
+        results = self._fold_sample_metrics(samples)
+        results["epsilon"] = samples[0]["metrics"].get("epsilon")
+
+        # 3. TD updates at the configured intensity (stats averaged over
+        # all updates this iteration, like the epoch-SGD learners)
+        acc: Dict[str, list] = {}
+        if len(self.replay) >= cfg.num_steps_sampled_before_learning_starts:
+            num_updates = max(1, int(sampled * cfg.training_intensity / cfg.train_batch_size))
+            use_per = cfg.prioritized_replay
+            for _ in range(num_updates):
+                batch = self.replay.sample(cfg.train_batch_size)
+                for k, v in self.learner_group.update_once(batch).items():
+                    acc.setdefault(k, []).append(v)
+                if use_per:
+                    td = self.learner_group.get_td_errors()
+                    if td is not None:
+                        self.replay.update_priorities(td)
+        results["learner"] = {k: float(np.mean(v)) for k, v in acc.items()}
+        return results
+
+
+DQNConfig.algo_class = DQN

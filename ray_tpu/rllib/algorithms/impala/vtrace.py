@@ -1,0 +1,73 @@
+"""V-trace off-policy correction (Espeholt et al. 2018, IMPALA).
+
+Equivalent of the reference's vtrace math
+(reference: rllib/algorithms/impala/vtrace_torch.py — importance-
+weighted multi-step value targets with clipped rho/c). Jax-native: the
+backward recursion is a `lax.scan` in reverse over the time axis, so
+the whole correction compiles into the learner's single jitted update
+— no per-step python, MXU-friendly batched gathers around it.
+
+Shapes: all inputs (E, T). `next_values` must be V(true next obs) at
+every step — i.e. computed from the runner's `next_obs` buffer, NOT
+from obs[t+1], which after an autoreset belongs to the next episode.
+That makes truncation exact: at a truncated step the delta bootstraps
+from V(terminal obs) while `dones` cuts the recursion, so nothing
+leaks across episode boundaries.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def vtrace(
+    behavior_logp,
+    target_logp,
+    rewards,
+    values,
+    next_values,
+    terminateds,
+    dones,
+    gamma: float,
+    rho_bar: float = 1.0,
+    c_bar: float = 1.0,
+    lambda_: float = 1.0,
+):
+    """Returns (vs, pg_advantages), both (E, T).
+
+    `terminateds` cuts the bootstrap (true episode end); `dones` cuts the
+    recursion (end OR truncation — the following frame belongs to a new
+    episode). Invalid autoreset frames are harmless: their deltas never
+    propagate past the preceding done, and callers mask their loss terms.
+    """
+    rho = jnp.exp(target_logp - behavior_logp)
+    clipped_rho = jnp.minimum(rho, rho_bar)
+    cs = lambda_ * jnp.minimum(rho, c_bar)
+
+    live_next = next_values * (1.0 - terminateds.astype(jnp.float32))
+    discounts = gamma * (1.0 - dones.astype(jnp.float32))
+
+    deltas = clipped_rho * (rewards + gamma * live_next - values)
+
+    def backward(acc, xs):
+        delta_t, disc_t, c_t = xs
+        acc = delta_t + disc_t * c_t * acc
+        return acc, acc
+
+    # scan over time, reversed; carry per env-row (E,)
+    _, acc_seq = jax.lax.scan(
+        backward,
+        jnp.zeros_like(values[:, 0]),
+        (deltas.T, discounts.T, cs.T),
+        reverse=True,
+    )
+    vs_minus_v = acc_seq.T  # (E, T)
+    vs = values + vs_minus_v
+
+    # pg advantage bootstraps from vs_{t+1} inside an episode and from the
+    # true next-state value at episode edges (done ⇒ the following row is
+    # another episode; terminated ⇒ zero via live_next)
+    vs_next = jnp.concatenate([vs[:, 1:], live_next[:, -1:]], axis=1)
+    vs_next = jnp.where(dones, live_next, vs_next)
+    pg_adv = clipped_rho * (rewards + gamma * vs_next - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)

@@ -98,6 +98,15 @@ class Learner:
                 all_stats.append(stats)
         return {k: float(np.mean([np.asarray(s[k]) for s in all_stats])) for k in all_stats[0]} if all_stats else {}
 
+    def update_once(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """ONE gradient step on the whole `batch` (the local counterpart of
+        LearnerGroup.update_once's lockstep step; off-policy learners
+        override with their own single-step machinery)."""
+        if self._batch_sharding is not None:
+            batch = self._jax.device_put(batch, self._batch_sharding)
+        self.params, self.opt_state, stats = self._update_step(self.params, self.opt_state, batch)
+        return {k: float(np.asarray(v)) for k, v in stats.items()}
+
     # -- distributed (LearnerGroup-coordinated) update -----------------------
     def shuffled_minibatches(self, batch, num_steps: int):
         """Deterministic minibatch index plan for lockstep multi-learner SGD."""

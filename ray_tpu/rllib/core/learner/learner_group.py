@@ -40,6 +40,9 @@ class _LearnerActor:
         self.learner.apply_grads(grads)
         return True
 
+    def grads_on(self, batch):
+        return self.learner.compute_grads(batch)
+
     def update(self, batch):
         return self.learner.update(batch)
 
@@ -80,29 +83,63 @@ class LearnerGroup:
             ]
 
     # -- update ---------------------------------------------------------------
-    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
-        if self._local is not None:
-            return self._local.update(batch)
+    def _shards(self, batch: Dict[str, np.ndarray]):
+        """Split `batch` row-wise across workers (remainder distributed,
+        never an empty shard — empty shards would mean NaN losses averaged
+        into every worker's params). Workers with no rows are skipped."""
+        n = len(batch["actions"])
+        splits = np.array_split(np.arange(n), len(self._workers))
+        out = []
+        for w, idx in zip(self._workers, splits):
+            if len(idx):
+                out.append((w, {k: v[idx] for k, v in batch.items()}))
+        return out
+
+    def _average_and_apply(self, results) -> Dict[str, float]:
+        """Average (grads, stats) pytrees from workers, apply in lockstep."""
         import jax
         import ray_tpu
 
-        n = len(batch["actions"])
-        shard_size = n // len(self._workers)
+        grads = [g for g, _ in results]
+        stats = [s for _, s in results]
+        avg = jax.tree.map(lambda *gs: np.mean(np.stack(gs), axis=0), *grads)
+        ray_tpu.get([w.apply_grads.remote(avg) for w in self._workers])
+        return {k: float(np.mean([s[k] for s in stats])) for k in stats[0]} if stats else {}
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if self._local is not None:
+            return self._local.update(batch)
+        import ray_tpu
+
+        shards = self._shards(batch)
+        shard_size = min(len(s["actions"]) for _, s in shards)
         mb = min(self.config.minibatch_size, shard_size)
         num_steps = self.config.num_epochs * max(1, shard_size // mb)
-        shards = [
-            {k: v[i * shard_size : (i + 1) * shard_size] for k, v in batch.items()}
-            for i in range(len(self._workers))
-        ]
-        ray_tpu.get([w.set_batch_and_plan.remote(s, num_steps) for w, s in zip(self._workers, shards)])
-        all_stats = []
+        ray_tpu.get([w.set_batch_and_plan.remote(s, num_steps) for w, s in shards])
+        all_stats = {}
         for step in range(num_steps):
-            results = ray_tpu.get([w.grad_step.remote(step) for w in self._workers])
-            grads = [g for g, _ in results]
-            all_stats.extend(s for _, s in results)
-            avg = jax.tree.map(lambda *gs: np.mean(np.stack(gs), axis=0), *grads)
-            ray_tpu.get([w.apply_grads.remote(avg) for w in self._workers])
-        return {k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]} if all_stats else {}
+            results = ray_tpu.get([w.grad_step.remote(step) for w, _ in shards])
+            step_stats = self._average_and_apply(results)
+            for k, v in step_stats.items():
+                all_stats.setdefault(k, []).append(v)
+        return {k: float(np.mean(v)) for k, v in all_stats.items()}
+
+    def update_once(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """ONE lockstep gradient step on `batch` (off-policy algos call this
+        once per replay sample, vs update()'s epochs of minibatch SGD)."""
+        if self._local is not None:
+            return self._local.update_once(batch)
+        import ray_tpu
+
+        shards = self._shards(batch)
+        results = ray_tpu.get([w.grads_on.remote(s) for w, s in shards])
+        return self._average_and_apply(results)
+
+    def get_td_errors(self):
+        """Per-sample TD errors from the last update (PER; local learner only)."""
+        if self._local is not None:
+            return getattr(self._local, "td_errors", None)
+        return None
 
     # -- weights / state --------------------------------------------------------
     def get_weights(self):

@@ -9,12 +9,52 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
+import numpy as np
+
 
 class EnvRunner:
     def sample(self) -> Dict[str, Any]:
         """Collect one rollout fragment; returns a flat train batch plus
         sampling metrics under the "metrics" key."""
         raise NotImplementedError
+
+    # -- shared per-env episode accounting --------------------------------
+    # The gymnasium>=1.0 autoreset ordering invariants live HERE, once:
+    # rewards only accrue to live envs (the frame after a done carries a
+    # stale action), an episode completes on `done & live`, and envs that
+    # were reset this step (prev_done) start their accounting fresh.
+
+    def _init_episode_accounting(self, num_envs: int) -> None:
+        self._ep_return = np.zeros((num_envs,), dtype=np.float64)
+        self._ep_len = np.zeros((num_envs,), dtype=np.int64)
+        self._completed_returns: list = []
+        self._completed_lengths: list = []
+
+    def _account_step(self, reward, done, prev_done) -> np.ndarray:
+        """Fold one vector-env step into the running accounts; returns the
+        `live` mask (frames that carry a real transition)."""
+        live = ~prev_done
+        self._ep_return[live] += reward[live]
+        self._ep_len[live] += 1
+        for e in np.nonzero(done & live)[0]:
+            self._completed_returns.append(float(self._ep_return[e]))
+            self._completed_lengths.append(int(self._ep_len[e]))
+            self._ep_return[e] = 0.0
+            self._ep_len[e] = 0
+        self._ep_return[prev_done] = 0.0
+        self._ep_len[prev_done] = 0
+        return live
+
+    def _drain_episode_metrics(self, num_env_steps: int, weights_seq: int) -> Dict[str, Any]:
+        metrics = {
+            "num_env_steps": int(num_env_steps),
+            "episode_returns": self._completed_returns,
+            "episode_lengths": self._completed_lengths,
+            "weights_seq": weights_seq,
+        }
+        self._completed_returns = []
+        self._completed_lengths = []
+        return metrics
 
     def get_weights(self) -> Any:
         raise NotImplementedError

@@ -1,0 +1,112 @@
+"""OffPolicyEnvRunner — epsilon-greedy transition collection for
+value-based algorithms (DQN family).
+
+Counterpart of the reference's SingleAgentEnvRunner when driven by a
+DQN config (reference: rllib/env/single_agent_env_runner.py with the
+EpsilonGreedy exploration connector,
+rllib/connectors/module_to_env/...). Returns flat
+(obs, action, reward, next_obs, terminated) transitions; the
+autoreset frames of gymnasium>=1.0 vector envs (see
+single_agent_env_runner.py for the masking rationale) are dropped.
+Epsilon decays linearly against the GLOBAL env-step count, which the
+Algorithm pushes down with the weight sync.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env.env_runner import EnvRunner
+
+
+class OffPolicyEnvRunner(EnvRunner):
+    def __init__(self, config, worker_index: int = 0):
+        import jax
+
+        self.config = config
+        self.worker_index = worker_index
+        self._jax = jax
+        from ray_tpu.rllib.utils.env import make_vector_env
+
+        self.env = make_vector_env(config)
+        self.num_envs = config.num_envs_per_env_runner
+        self.module = config.build_module(
+            self.env.single_observation_space, self.env.single_action_space
+        )
+        self._rng = jax.random.PRNGKey(config.seed + 1000 * (worker_index + 1))
+        self.params = self.module.init_params(self._rng)
+        self._weights_seq = 0
+        self._global_step = 0  # pushed by the Algorithm with sync_weights
+
+        self._q_fn = jax.jit(lambda params, obs: self.module.forward(params, obs)["logits"])
+        self._np_rng = np.random.default_rng(config.seed + 77 * (worker_index + 1))
+
+        self._obs, _ = self.env.reset(seed=config.seed + 10_000 * (worker_index + 1))
+        self._prev_done = np.zeros((self.num_envs,), dtype=bool)
+        self._init_episode_accounting(self.num_envs)
+
+    # -- weights / vars ------------------------------------------------------
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, weights, seq: Optional[int] = None, global_step: Optional[int] = None) -> None:
+        self.params = self._jax.tree.map(np.asarray, weights)
+        if seq is not None:
+            self._weights_seq = seq
+        if global_step is not None:
+            self._global_step = int(global_step)
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._global_step / max(1, c.epsilon_timesteps))
+        return float(c.epsilon_initial + frac * (c.epsilon_final - c.epsilon_initial))
+
+    # -- sampling ------------------------------------------------------------
+    def sample(self) -> Dict[str, Any]:
+        T = self.config.rollout_fragment_length
+        E = self.num_envs
+        eps = self._epsilon()
+        obs_shape = self.env.single_observation_space.shape
+
+        obs_l, act_l, rew_l, next_l, term_l = [], [], [], [], []
+        obs = self._obs
+        prev_done = self._prev_done
+        for _ in range(T):
+            q = np.asarray(self._q_fn(self.params, obs.astype(np.float32)))
+            action = q.argmax(axis=-1)
+            explore = self._np_rng.random(E) < eps
+            action = np.where(
+                explore, self._np_rng.integers(0, q.shape[-1], size=E), action
+            ).astype(np.int64)
+
+            next_obs, reward, terminated, truncated, _ = self.env.step(action)
+            done = terminated | truncated
+            live = self._account_step(np.asarray(reward), done, prev_done)
+            # keep only real frames (autoreset frames carry a stale action)
+            obs_l.append(obs[live].astype(np.float32))
+            act_l.append(action[live])
+            rew_l.append(np.asarray(reward, np.float32)[live])
+            next_l.append(next_obs[live].astype(np.float32))
+            term_l.append(np.asarray(terminated, bool)[live])
+
+            obs = next_obs
+            prev_done = done
+        self._obs = obs
+        self._prev_done = prev_done
+
+        batch = {
+            "obs": np.concatenate(obs_l).reshape((-1,) + obs_shape),
+            "actions": np.concatenate(act_l),
+            "rewards": np.concatenate(rew_l),
+            "next_obs": np.concatenate(next_l).reshape((-1,) + obs_shape),
+            "terminateds": np.concatenate(term_l),
+        }
+        n = len(batch["actions"])
+        self._global_step += n  # local estimate between syncs
+        metrics = self._drain_episode_metrics(n, self._weights_seq)
+        metrics["epsilon"] = eps
+        return {"batch": batch, "metrics": metrics}
+
+    def stop(self) -> None:
+        self.env.close()

@@ -55,10 +55,7 @@ class SingleAgentEnvRunner(EnvRunner):
         self._obs, _ = self.env.reset(seed=seed)
         self._prev_done = np.zeros((self.num_envs,), dtype=bool)
         # Running per-env episode accounting (survives fragment edges).
-        self._ep_return = np.zeros((self.num_envs,), dtype=np.float64)
-        self._ep_len = np.zeros((self.num_envs,), dtype=np.int64)
-        self._completed_returns: list = []
-        self._completed_lengths: list = []
+        self._init_episode_accounting(self.num_envs)
 
     @staticmethod
     def _make_env(config):
@@ -109,22 +106,32 @@ class SingleAgentEnvRunner(EnvRunner):
             done_buf[:, t] = done
             next_obs_buf[:, t] = next_obs
 
-            live = ~prev_done
-            self._ep_return[live] += reward[live]
-            self._ep_len[live] += 1
-            for e in np.nonzero(done & live)[0]:
-                self._completed_returns.append(float(self._ep_return[e]))
-                self._completed_lengths.append(int(self._ep_len[e]))
-                self._ep_return[e] = 0.0
-                self._ep_len[e] = 0
-            # Envs that were reset this step (prev_done) start fresh now.
-            self._ep_return[prev_done] = 0.0
-            self._ep_len[prev_done] = 0
+            self._account_step(reward, done, prev_done)
 
             obs = next_obs
             prev_done = done
         self._obs = obs
         self._prev_done = prev_done
+
+        if getattr(self.config, "batch_mode", "complete") == "time_major":
+            # sequence batches for v-trace learners (IMPALA/APPO): no GAE —
+            # the learner computes values under ITS OWN params and applies
+            # the off-policy correction (reference: rllib vtrace over
+            # time-major SampleBatches, algorithms/impala/)
+            metrics = self._drain_episode_metrics(valid_buf.sum(), self._weights_seq)
+            return {
+                "batch": {
+                    "obs": obs_buf,
+                    "actions": act_buf,
+                    "behavior_logp": logp_buf,
+                    "rewards": rew_buf,
+                    "terminateds": term_buf,
+                    "dones": done_buf,
+                    "valid": valid_buf,
+                    "next_obs": next_obs_buf,
+                },
+                "metrics": metrics,
+            }
 
         # next_values[e,t] = V(obs returned at t) — the true next state,
         # terminal states included (masked by `terminateds` inside GAE).
@@ -151,14 +158,7 @@ class SingleAgentEnvRunner(EnvRunner):
         }
         # report-and-clear: each completed episode is reported exactly once;
         # smoothing over a trailing window happens in the Algorithm.
-        metrics = {
-            "num_env_steps": int(mask.sum()),
-            "episode_returns": self._completed_returns,
-            "episode_lengths": self._completed_lengths,
-            "weights_seq": self._weights_seq,
-        }
-        self._completed_returns = []
-        self._completed_lengths = []
+        metrics = self._drain_episode_metrics(mask.sum(), self._weights_seq)
         return {"batch": batch, "metrics": metrics}
 
     def stop(self) -> None:

@@ -1,0 +1,129 @@
+"""Replay buffers for off-policy algorithms.
+
+Equivalent of the reference's replay buffer utilities
+(reference: rllib/utils/replay_buffers/replay_buffer.py and
+prioritized_replay_buffer.py). Storage is preallocated numpy ring
+buffers keyed by field — batches come out as flat dicts of contiguous
+arrays, ready for a single device_put into the jitted learner step.
+The prioritized variant uses a segment (sum) tree for O(log n)
+proportional sampling, like the reference's sum-segment-tree
+(reference: rllib/utils/replay_buffers/utils.py segment trees).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class ReplayBuffer:
+    """Uniform-sampling ring buffer over transition dicts."""
+
+    def __init__(self, capacity: int = 100_000, seed: int = 0):
+        self.capacity = int(capacity)
+        self._storage: Dict[str, np.ndarray] = {}
+        self._next = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def _ensure_storage(self, batch: Dict[str, np.ndarray]) -> None:
+        if self._storage:
+            return
+        for k, v in batch.items():
+            v = np.asarray(v)
+            self._storage[k] = np.empty((self.capacity,) + v.shape[1:], dtype=v.dtype)
+
+    def add(self, batch: Dict[str, np.ndarray]) -> None:
+        """Append a batch of transitions (each value shaped (N, ...))."""
+        self._ensure_storage(batch)
+        n = len(next(iter(batch.values())))
+        idx = (self._next + np.arange(n)) % self.capacity
+        for k, v in batch.items():
+            self._storage[k][idx] = np.asarray(v)
+        self._next = int((self._next + n) % self.capacity)
+        self._size = int(min(self._size + n, self.capacity))
+        self._on_add(idx)
+
+    def _on_add(self, idx: np.ndarray) -> None:  # PER hook
+        pass
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return {k: v[idx] for k, v in self._storage.items()}
+
+
+class _SumTree:
+    """Flat-array binary sum tree; leaves padded to a power of two so
+    every root-to-leaf path has equal depth."""
+
+    def __init__(self, capacity: int):
+        self.capacity = 1 << (max(1, capacity) - 1).bit_length()
+        self.tree = np.zeros(2 * self.capacity, dtype=np.float64)
+
+    def set(self, idx: np.ndarray, value: np.ndarray) -> None:
+        i = np.asarray(idx) + self.capacity
+        self.tree[i] = value
+        i //= 2
+        # propagate sums up; vectorized per level (dedupe parents)
+        while i[0] >= 1 if len(i) else False:
+            i = np.unique(i)
+            self.tree[i] = self.tree[2 * i] + self.tree[2 * i + 1]
+            if i[0] == 1:
+                break
+            i //= 2
+
+    def total(self) -> float:
+        return float(self.tree[1])
+
+    def find(self, prefix_sums: np.ndarray) -> np.ndarray:
+        """Leaf indices whose cumulative-sum interval contains each prefix."""
+        idx = np.ones(len(prefix_sums), dtype=np.int64)
+        s = prefix_sums.astype(np.float64).copy()
+        while idx[0] < self.capacity:
+            left = 2 * idx
+            go_right = s > self.tree[left]
+            s -= np.where(go_right, self.tree[left], 0.0)
+            idx = np.where(go_right, left + 1, left)
+        return idx - self.capacity
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized experience replay (Schaul et al. 2015;
+    reference: rllib/utils/replay_buffers/prioritized_replay_buffer.py)."""
+
+    def __init__(self, capacity: int = 100_000, alpha: float = 0.6,
+                 beta: float = 0.4, seed: int = 0, eps: float = 1e-6):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self.eps = eps
+        self._tree = _SumTree(int(capacity))
+        self._max_priority = 1.0
+        self._last_idx: Optional[np.ndarray] = None
+
+    def _on_add(self, idx: np.ndarray) -> None:
+        self._tree.set(idx, np.full(len(idx), self._max_priority ** self.alpha))
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        total = self._tree.total()
+        prefixes = self._rng.random(batch_size) * total
+        idx = np.clip(self._tree.find(prefixes), 0, self._size - 1)
+        self._last_idx = idx
+        probs = self._tree.tree[idx + self._tree.capacity] / max(total, 1e-12)
+        weights = (self._size * np.maximum(probs, 1e-12)) ** (-self.beta)
+        weights /= weights.max()
+        out = {k: v[idx] for k, v in self._storage.items()}
+        out["weights"] = weights.astype(np.float32)
+        return out
+
+    def update_priorities(self, td_errors: np.ndarray) -> None:
+        """Re-prioritize the transitions returned by the last sample()."""
+        if self._last_idx is None:
+            return
+        prios = (np.abs(np.asarray(td_errors, np.float64)) + self.eps) ** self.alpha
+        self._tree.set(self._last_idx, prios)
+        self._max_priority = max(self._max_priority, float(np.abs(td_errors).max() + self.eps))
+        self._last_idx = None
