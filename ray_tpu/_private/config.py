@@ -39,6 +39,10 @@ _DEFS: Dict[str, Any] = {
     "health_check_timeout_s": 30.0,
     "task_max_retries_default": 3,
     "actor_max_restarts_default": 0,
+    # --- memory monitor / OOM defense ---
+    "memory_usage_threshold": 0.95,  # kill-above fraction (reference default)
+    "memory_monitor_refresh_ms": 250,  # 0 disables the monitor
+    "task_oom_retries": 15,  # OOM kills get their own budget; -1 = infinite
     # --- gcs ---
     "gcs_port": 0,  # 0 = auto
     "dashboard_port": 0,  # 0 = auto (bound port written to session/dashboard_url)

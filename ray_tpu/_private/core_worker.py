@@ -1326,15 +1326,34 @@ class CoreWorker:
         rec = self._submitted.get(data["task_id"])
         if rec is None:
             return
-        if data.get("retriable") and rec["retries_left"] > 0 and not data.get("cancelled"):
-            rec["retries_left"] -= 1
-            logger.info("retrying task %s (%d retries left)", data["task_id"], rec["retries_left"])
-            await self._gcs.request("task.submit", {"spec": rec["spec"]})
-            return
+        if data.get("retriable") and not data.get("cancelled"):
+            if data.get("oom"):
+                # OOM kills spend their own budget (reference:
+                # task_manager.cc separate oom retry counter) — a memory-
+                # pressure victim shouldn't burn its crash retries
+                left = rec.setdefault("oom_retries_left", RayConfig.task_oom_retries)
+                if left != 0:
+                    if left > 0:
+                        rec["oom_retries_left"] = left - 1
+                    logger.info(
+                        "retrying OOM-killed task %s (%s oom retries left)",
+                        data["task_id"], "inf" if left < 0 else left - 1,
+                    )
+                    await self._gcs.request("task.submit", {"spec": rec["spec"]})
+                    return
+            elif rec["retries_left"] > 0:
+                rec["retries_left"] -= 1
+                logger.info("retrying task %s (%d retries left)", data["task_id"], rec["retries_left"])
+                await self._gcs.request("task.submit", {"spec": rec["spec"]})
+                return
         self._submitted.pop(data["task_id"], None)
         if data.get("cancelled"):
             err = _env_err(exceptions.TaskCancelledError(rec["spec"].get("name", "")), rec["spec"].get("name", ""))
             err["t"] = "TaskCancelledError"
+        elif data.get("oom"):
+            err = _env_err(
+                exceptions.OutOfMemoryError(f"task failed: {data.get('error')}"), rec["spec"].get("name", "")
+            )
         else:
             err = _env_err(
                 exceptions.WorkerCrashedError(f"task failed: {data.get('error')}"), rec["spec"].get("name", "")

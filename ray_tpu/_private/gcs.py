@@ -672,10 +672,12 @@ class GcsServer:
         return True
 
     async def _rpc_task_failed(self, d, conn):
-        await self._task_failed(d["task_id"], d.get("error", "unknown"), d.get("retriable", True))
+        await self._task_failed(
+            d["task_id"], d.get("error", "unknown"), d.get("retriable", True), oom=d.get("oom", False)
+        )
         return True
 
-    async def _task_failed(self, task_id: str, error: str, retriable: bool):
+    async def _task_failed(self, task_id: str, error: str, retriable: bool, oom: bool = False):
         rec = self._release_task_resources(task_id)
         if rec is None:
             return
@@ -689,7 +691,7 @@ class GcsServer:
         if owner is not None:
             try:
                 await owner["conn"].push(
-                    "task.failed", {"task_id": task_id, "error": error, "retriable": retriable}
+                    "task.failed", {"task_id": task_id, "error": error, "retriable": retriable, "oom": oom}
                 )
             except Exception:
                 pass

@@ -1,0 +1,107 @@
+"""Node memory monitor + OOM worker-killing policy.
+
+Equivalent of the reference's MemoryMonitor
+(reference: src/ray/common/memory_monitor.h:52 — periodic node/cgroup
+memory sampling feeding policy-driven worker kills in the raylet,
+src/ray/raylet/worker_killing_policy.h:34) . The raylet samples usage
+every `memory_monitor_refresh_ms`; above `memory_usage_threshold` it
+SIGKILLs the victim chosen by the retriable-latest-first policy
+(reference: worker_killing_policy_retriable_fifo.cc — prefer workers
+whose tasks can be retried, newest first, so long-running work and
+non-retriable tasks survive). OOM kills are reported to the owner with
+an `oom` flag and retried against a separate `task_oom_retries` budget
+(reference: task_manager.cc OOM retry counter distinct from
+max_retries).
+
+Fault injection: when RAY_TPU_MEMORY_USAGE_FILE is set, usage is read
+as a float fraction from that file — tests drive the monitor without
+actually exhausting node memory (reference analogue: memory pressure
+chaos in nightly tests).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+_CGROUP_CUR = "/sys/fs/cgroup/memory.current"
+_CGROUP_MAX = "/sys/fs/cgroup/memory.max"
+_CGROUP_V1_CUR = "/sys/fs/cgroup/memory/memory.usage_in_bytes"
+_CGROUP_V1_MAX = "/sys/fs/cgroup/memory/memory.limit_in_bytes"
+_MEMINFO = "/proc/meminfo"
+_IMPLAUSIBLE_LIMIT = 1 << 60  # cgroup "max"/unset sentinels exceed this
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            txt = f.read().strip()
+        if txt == "max":
+            return None
+        v = int(txt)
+        return v if 0 < v < _IMPLAUSIBLE_LIMIT else None
+    except (OSError, ValueError):
+        return None
+
+
+def _meminfo() -> Tuple[int, int]:
+    """(available_bytes, total_bytes) from /proc/meminfo."""
+    total = avail = 0
+    with open(_MEMINFO) as f:
+        for line in f:
+            if line.startswith("MemTotal:"):
+                total = int(line.split()[1]) * 1024
+            elif line.startswith("MemAvailable:"):
+                avail = int(line.split()[1]) * 1024
+            if total and avail:
+                break
+    return avail, total
+
+
+class MemoryMonitor:
+    """Samples node (or cgroup, when limited) memory usage."""
+
+    def __init__(self):
+        self._fake_path = os.environ.get("RAY_TPU_MEMORY_USAGE_FILE")
+
+    def usage_fraction(self) -> float:
+        """Used/total in [0,1]; prefers the cgroup limit when one is set
+        (containers), else node-wide MemAvailable."""
+        if self._fake_path:
+            try:
+                with open(self._fake_path) as f:
+                    return float(f.read().strip())
+            except (OSError, ValueError):
+                return 0.0
+        cur = _read_int(_CGROUP_CUR) or _read_int(_CGROUP_V1_CUR)
+        lim = _read_int(_CGROUP_MAX) or _read_int(_CGROUP_V1_MAX)
+        if cur is not None and lim:
+            return cur / lim
+        avail, total = _meminfo()
+        if not total:
+            return 0.0
+        return 1.0 - avail / total
+
+
+def pick_oom_victim(workers: List[Any]) -> Optional[Any]:
+    """Retriable-latest-first policy over raylet WorkerHandles
+    (reference: worker_killing_policy_retriable_fifo.cc). Only workers
+    currently running a RETRIABLE normal task are candidates — killing
+    them reclaims memory at the cost of a retry, while actors and
+    non-retriable tasks are spared. Newest task first: it has the least
+    sunk work."""
+    candidates = [
+        h
+        for h in workers
+        if h.current_task is not None
+        and not h.current_task.get("actor_creation")
+        and h.current_task.get("max_retries", 0) != 0
+    ]
+    if candidates:
+        return max(candidates, key=lambda h: h.current_task.get("_dispatched_at", 0.0))
+    # fallback: a direct-dispatch (leased) worker — its owner detects the
+    # broken connection and transparently re-routes in-flight tasks
+    # through the central scheduler (core_worker._lease_drain _worker_died)
+    leased = [h for h in workers if h.lease_id is not None]
+    if leased:
+        return max(leased, key=lambda h: h.idle_since)
+    return None

@@ -76,6 +76,7 @@ class WorkerHandle:
         self.lease_id: Optional[str] = None  # leased to an owner for direct dispatch
         self.registered = asyncio.Event()
         self.idle_since = time.time()
+        self.oom_killed = False  # set by the memory monitor before SIGKILL
 
 
 class Raylet:
@@ -145,6 +146,8 @@ class Raylet:
         asyncio.get_running_loop().create_task(self._heartbeat_loop())
         asyncio.get_running_loop().create_task(self._reap_loop())
         asyncio.get_running_loop().create_task(self._spill_loop())
+        if RayConfig.memory_monitor_refresh_ms > 0:
+            asyncio.get_running_loop().create_task(self._memory_monitor_loop())
         for _ in range(min(RayConfig.worker_pool_prestart, self.max_workers)):
             self._start_worker()
         logger.info("raylet %s node=%s up, %d prestarted", self.name, self.node_id, RayConfig.worker_pool_prestart)
@@ -218,6 +221,43 @@ class Raylet:
             "obj.add_location", {"oid": oid, "node_id": self.node_id, "size": len(blob)}
         )
         return True
+
+    async def _memory_monitor_loop(self):
+        """Kill a policy-chosen worker when node memory crosses the
+        threshold (reference: MemoryMonitor → worker_killing_policy in the
+        raylet; memory_monitor.py for the policy)."""
+        from ray_tpu._private.memory_monitor import MemoryMonitor, pick_oom_victim
+
+        monitor = MemoryMonitor()
+        period = RayConfig.memory_monitor_refresh_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            try:
+                frac = monitor.usage_fraction()
+                if frac < RayConfig.memory_usage_threshold:
+                    continue
+                victim = pick_oom_victim(list(self.workers.values()))
+                if victim is None:
+                    logger.warning(
+                        "memory pressure %.2f above threshold but no retriable-task "
+                        "worker to kill", frac,
+                    )
+                    await asyncio.sleep(1.0)
+                    continue
+                victim.oom_killed = True
+                logger.warning(
+                    "memory pressure %.2f: OOM-killing worker %s (task %s)",
+                    frac, victim.worker_id[:12],
+                    (victim.current_task or {}).get("name", "?"),
+                )
+                try:
+                    victim.proc.kill()
+                except ProcessLookupError:
+                    pass
+                # let the kill land + reap before sampling again
+                await asyncio.sleep(max(period, 0.5))
+            except Exception:
+                logger.exception("memory monitor iteration failed")
 
     async def _connect_and_register(self):
         self._gcs = await protocol.connect(self.gcs_addr, self._handle_gcs, name="raylet-gcs")
@@ -328,16 +368,16 @@ class Raylet:
                     await h.conn.close()
                 if h.current_task is not None:
                     spec = h.current_task
-                    if spec.get("actor_creation"):
-                        await self._gcs.request(
-                            "task.failed",
-                            {"task_id": spec["task_id"], "error": f"worker died (exit {code})", "retriable": True},
-                        )
-                    else:
-                        await self._gcs.request(
-                            "task.failed",
-                            {"task_id": spec["task_id"], "error": f"worker died (exit {code})", "retriable": True},
-                        )
+                    err = (
+                        "worker killed by the memory monitor (node OOM defense)"
+                        if h.oom_killed
+                        else f"worker died (exit {code})"
+                    )
+                    await self._gcs.request(
+                        "task.failed",
+                        {"task_id": spec["task_id"], "error": err, "retriable": True,
+                         "oom": h.oom_killed},
+                    )
                 elif h.is_actor and h.actor_id:
                     await self._gcs.request(
                         "actor.died", {"actor_id": h.actor_id, "reason": f"worker process exited ({code})"}
@@ -366,6 +406,7 @@ class Raylet:
             asyncio.get_running_loop().create_task(self._run_on_worker(worker, spec))
 
     async def _run_on_worker(self, h: WorkerHandle, spec: Dict[str, Any]):
+        spec["_dispatched_at"] = time.monotonic()  # OOM policy: newest-first
         h.current_task = spec
         try:
             await self._gcs.request("task.worker_assigned", {"task_id": spec["task_id"], "worker_id": h.worker_id})

@@ -134,7 +134,8 @@ class EnvRunnerGroup:
     def __init__(self, config):
         from ray_tpu.rllib.env.single_agent_env_runner import SingleAgentEnvRunner
 
-        runner_cls = config.env_runner_cls or SingleAgentEnvRunner
+        # getattr: configs unpickled from older checkpoints predate the attr
+        runner_cls = getattr(config, "env_runner_cls", None) or SingleAgentEnvRunner
         self.config = config
         self.local_runner = None
         self.remote_runners: List[Any] = []

@@ -46,18 +46,19 @@ class DQN(Algorithm):
     config_class = DQNConfig
 
     def __init__(self, config):
+        if config.prioritized_replay and config.num_learners > 0:
+            # validate BEFORE super().__init__ spawns runner/learner actors
+            raise ValueError(
+                "prioritized_replay requires the local learner (num_learners=0): "
+                "remote lockstep learners do not return per-sample TD errors, so "
+                "priorities would silently never update"
+            )
         super().__init__(config)
         from ray_tpu.rllib.utils.replay_buffers import (
             PrioritizedReplayBuffer,
             ReplayBuffer,
         )
 
-        if config.prioritized_replay and config.num_learners > 0:
-            raise ValueError(
-                "prioritized_replay requires the local learner (num_learners=0): "
-                "remote lockstep learners do not return per-sample TD errors, so "
-                "priorities would silently never update"
-            )
         if config.prioritized_replay:
             self.replay = PrioritizedReplayBuffer(
                 config.replay_buffer_capacity,

@@ -184,3 +184,104 @@ ray_tpu.shutdown()
     assert "RESTORED OK" in r.stdout, r.stdout + r.stderr
     spilled = int(next(l.split()[1] for l in r.stdout.splitlines() if l.startswith("SPILLED")))
     assert spilled >= 1, "nothing was ever spilled"
+
+
+def test_memory_monitor_readings():
+    """MemoryMonitor reads real node/cgroup usage as a sane fraction, and
+    honors the fault-injection file override."""
+    from ray_tpu._private.memory_monitor import MemoryMonitor
+
+    m = MemoryMonitor()
+    frac = m.usage_fraction()
+    assert 0.0 < frac < 1.0, frac
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".usage", delete=False) as f:
+        f.write("0.87")
+        path = f.name
+    os.environ["RAY_TPU_MEMORY_USAGE_FILE"] = path
+    try:
+        assert MemoryMonitor().usage_fraction() == pytest.approx(0.87)
+    finally:
+        os.environ.pop("RAY_TPU_MEMORY_USAGE_FILE", None)
+        os.unlink(path)
+
+
+def test_oom_victim_policy():
+    """Retriable-latest-first: actors and non-retriable tasks are spared;
+    the newest retriable task dies first; leased workers are the fallback."""
+    from ray_tpu._private.memory_monitor import pick_oom_victim
+
+    class H:
+        def __init__(self, task=None, lease=None, idle=0.0):
+            self.current_task = task
+            self.lease_id = lease
+            self.idle_since = idle
+
+    actor = H(task={"actor_creation": True, "max_retries": 0, "_dispatched_at": 9.0})
+    nonretriable = H(task={"max_retries": 0, "_dispatched_at": 8.0})
+    old = H(task={"max_retries": 3, "_dispatched_at": 1.0})
+    new = H(task={"max_retries": 3, "_dispatched_at": 2.0})
+    assert pick_oom_victim([actor, nonretriable, old, new]) is new
+    assert pick_oom_victim([actor, nonretriable, old]) is old
+    leased = H(lease=abs, idle=5.0)
+    assert pick_oom_victim([actor, nonretriable, leased]) is leased
+    assert pick_oom_victim([actor, nonretriable]) is None
+    assert pick_oom_victim([]) is None
+
+
+def test_oom_kill_and_retry():
+    """Memory pressure above the threshold OOM-kills the worker running a
+    retriable task; the task is retried and completes once pressure drops
+    (reference: MemoryMonitor + retriable worker killing + OOM retries)."""
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        usage = os.path.join(td, "usage")
+        marker = os.path.join(td, "marker")
+        with open(usage, "w") as f:
+            f.write("0.10")
+        code = f"""
+import os, time
+import ray_tpu
+ray_tpu.init(num_cpus=2, object_store_memory=64*1024*1024)
+
+@ray_tpu.remote(max_retries=3)
+def victim(marker):
+    if not os.path.exists(marker):
+        open(marker, "w").close()
+        time.sleep(120)  # the monitor kills us here
+        return "survived"
+    return "retried"
+
+ref = victim.remote({marker!r})
+# wait until the first attempt is running (marker written), then spike memory
+deadline = time.time() + 60
+while not os.path.exists({marker!r}) and time.time() < deadline:
+    time.sleep(0.2)
+assert os.path.exists({marker!r}), "task never started"
+time.sleep(0.5)
+with open({usage!r}, "w") as f:
+    f.write("0.99")
+time.sleep(2.0)
+with open({usage!r}, "w") as f:
+    f.write("0.10")
+result = ray_tpu.get(ref, timeout=90)
+assert result == "retried", result
+print("OOM RETRY OK")
+ray_tpu.shutdown()
+"""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [_sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=240,
+            env={**os.environ,
+                 "RAY_TPU_MEMORY_USAGE_FILE": usage,
+                 "RAY_TPU_MEMORY_MONITOR_REFRESH_MS": "100",
+                 "RAY_TPU_WORKER_POOL_PRESTART": "1",
+                 "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")},
+        )
+        assert "OOM RETRY OK" in r.stdout, r.stdout + "\n" + r.stderr
