@@ -426,6 +426,27 @@ class GcsServer:
                 out[k] += v
         return dict(out)
 
+    async def _rpc_node_set_resource(self, d, conn):
+        """Dynamically resize one custom resource on a node (reference:
+        python/ray/experimental/dynamic_resources.py set_resource →
+        NodeManager resource update). Availability moves by the same
+        delta so in-use amounts are preserved; capacity 0 deletes."""
+        node = self.nodes.get(d["node_id"]) if d.get("node_id") else next(
+            (n for n in self.nodes.values() if n["state"] == "ALIVE"), None
+        )
+        if node is None:
+            raise KeyError(f"no such node: {d.get('node_id')}")
+        name, cap = d["resource_name"], float(d["capacity"])
+        old = node["resources_total"].get(name, 0.0)
+        if cap <= 0:
+            node["resources_total"].pop(name, None)
+            node["resources_available"].pop(name, None)
+        else:
+            node["resources_total"][name] = cap
+            node["resources_available"][name] = node["resources_available"].get(name, old) + (cap - old)
+        self._sched_wakeup.set()
+        return True
+
     async def _rpc_heartbeat(self, d, conn):
         node = self.nodes.get(d["node_id"])
         if node:
@@ -608,7 +629,14 @@ class GcsServer:
             node = self.nodes.get(node_id)
             if node and node["state"] == "ALIVE":
                 for k, v in req.items():
-                    node["resources_available"][k] = node["resources_available"].get(k, 0.0) + v
+                    # resource deleted (node.set_resource 0) while in use:
+                    # don't resurrect phantom availability
+                    if k not in node["resources_total"]:
+                        continue
+                    node["resources_available"][k] = min(
+                        node["resources_available"].get(k, 0.0) + v,
+                        node["resources_total"][k],
+                    )
 
     async def _dispatch(self, spec: Dict[str, Any], node_id: str):
         node = self.nodes[node_id]

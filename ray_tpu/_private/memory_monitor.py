@@ -98,9 +98,13 @@ def pick_oom_victim(workers: List[Any]) -> Optional[Any]:
     ]
     if candidates:
         return max(candidates, key=lambda h: h.current_task.get("_dispatched_at", 0.0))
-    # fallback: a direct-dispatch (leased) worker — its owner detects the
-    # broken connection and transparently re-routes in-flight tasks
-    # through the central scheduler (core_worker._lease_drain _worker_died)
+    # fallback: a direct-dispatch (leased) worker. The owner detects the
+    # broken connection and re-routes in-flight RETRIABLE tasks through
+    # the central scheduler (core_worker._lease_drain _worker_died); a
+    # non-retriable task caught on the leased worker fails with
+    # WorkerCrashedError — the raylet cannot see lease-pushed task specs,
+    # and the reference's memory-pressure kills can likewise take down
+    # whatever the chosen worker was running
     leased = [h for h in workers if h.lease_id is not None]
     if leased:
         return max(leased, key=lambda h: h.idle_since)

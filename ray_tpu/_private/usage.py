@@ -1,0 +1,61 @@
+"""Usage stats (opt-in, local-only).
+
+Equivalent of the reference's usage-stats subsystem
+(reference: python/ray/_private/usage/usage_lib.py — cluster metadata
+and feature-usage tags collected at shutdown and reported). This image
+has zero egress, so collection writes a JSON record into the session
+directory instead of phoning home; the tag API and the enablement env
+var match the reference's shape (RAY_TPU_USAGE_STATS_ENABLED, default
+off — the reference defaults on with an opt-out; a local-only record
+defaults off to avoid surprising files).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict
+
+_lock = threading.Lock()
+_tags: Dict[str, str] = {}
+_features: set = set()
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "0") in ("1", "true", "True")
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    """Tag this session's usage record (reference:
+    usage_lib.record_extra_usage_tag)."""
+    with _lock:
+        _tags[str(key)] = str(value)
+
+
+def record_library_usage(library: str) -> None:
+    """Mark a library (data/train/tune/serve/rllib) as used this session
+    (reference: usage_lib.record_library_usage)."""
+    with _lock:
+        _features.add(str(library))
+
+
+def write_usage_record(session_dir: str) -> str:
+    """Flush the usage record to <session>/usage_stats.json; no-op
+    unless enabled."""
+    if not usage_stats_enabled():
+        return ""
+    with _lock:
+        record = {
+            "ts": time.time(),
+            "libraries": sorted(_features),
+            "tags": dict(_tags),
+            "ray_tpu_version": "0.2.0",
+        }
+    path = os.path.join(session_dir, "usage_stats.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(record, f)
+    except OSError:
+        return ""
+    return path

@@ -4,7 +4,8 @@ Lazy logical plan → block-parallel execution on tasks, Arrow blocks in
 the shared-memory object store, streaming iteration with bounded
 in-flight blocks (reference: data/_internal/execution/streaming_executor.py).
 """
-from ray_tpu.data.dataset import Dataset  # noqa: F401
+from ray_tpu.data.dataset import DataIterator, Dataset  # noqa: F401
+from ray_tpu.data import preprocessors  # noqa: F401
 from ray_tpu.data.read_api import (  # noqa: F401
     from_arrow,
     from_items,

@@ -50,7 +50,18 @@ def block_to_batch(block: pa.Table, batch_format: str):
     if batch_format in ("numpy", "default"):
         import numpy as np
 
-        return {name: np.asarray(col) for name, col in zip(block.column_names, block.columns)}
+        out = {}
+        for name, col in zip(block.column_names, block.columns):
+            arr = np.asarray(col)
+            if arr.dtype == object and len(arr) and arr[0] is not None:
+                # list<numeric> columns (tensor features): restack into a
+                # contiguous 2-D array instead of a ragged object array
+                try:
+                    arr = np.stack([np.asarray(v) for v in arr])
+                except (ValueError, TypeError):
+                    pass  # genuinely ragged / non-numeric: keep objects
+            out[name] = arr
+        return out
     raise ValueError(f"unknown batch_format {batch_format}")
 
 

@@ -68,6 +68,16 @@ def _merge_blocks(*blks):
     return B.concat_blocks(list(blks))
 
 
+@ray_tpu.remote
+def _block_num_rows(blk):
+    return blk.num_rows
+
+
+@ray_tpu.remote
+def _slice_rows(blk, start, stop):
+    return blk.slice(start, stop - start)
+
+
 class Dataset:
     """Lazy dataset over block refs + a pending op chain."""
 
@@ -244,6 +254,88 @@ class Dataset:
         if leftover is not None and leftover.num_rows > 0 and not drop_last:
             yield B.block_to_batch(leftover, batch_format)
 
+    def iter_torch_batches(self, *, batch_size: int = 256, device=None,
+                           dtypes=None, drop_last: bool = False) -> Iterator[Any]:
+        """Batches as dicts of torch tensors (reference:
+        data/iterator.py iter_torch_batches). CPU torch by default."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size, batch_format="numpy", drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                t = torch.as_tensor(v)
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                if device is not None:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
+    def streaming_split(self, n: int, *, equal: bool = False) -> List["DataIterator"]:
+        """N iterators over disjoint subsets, one per train worker
+        (reference: dataset.streaming_split feeding Train). Default:
+        round-robin block assignment (zero data movement). equal=True
+        re-slices at ROW granularity so every split gets exactly
+        total//n rows — SPMD trainers need equal per-worker step counts;
+        only boundary blocks are cut, the rest are reused by reference."""
+        refs = self._execute_refs()
+        if not equal:
+            splits = [[r for j, r in enumerate(refs) if j % n == i] for i in builtins.range(n)]
+            return [DataIterator(Dataset(s)) for s in splits]
+
+        counts = ray_tpu.get([_block_num_rows.remote(r) for r in refs])
+        per = sum(counts) // n
+        splits, cur, need = [], [], per
+        it = iter([(r, c) for r, c in zip(refs, counts) if c > 0])
+        carry = None  # (ref, offset, remaining)
+        while len(splits) < n:
+            if need == 0:
+                splits.append(cur)
+                cur, need = [], per
+                continue
+            if carry is None:
+                nxt = next(it, None)
+                if nxt is None:
+                    splits.append(cur)
+                    cur, need = [], per
+                    continue
+                carry = (nxt[0], 0, nxt[1])
+            ref, off, rem = carry
+            take = min(rem, need)
+            if off == 0 and take == rem:
+                cur.append(ref)  # whole block, no copy
+            else:
+                cur.append(_slice_rows.remote(ref, off, off + take))
+            need -= take
+            carry = (ref, off + take, rem - take) if rem > take else None
+        return [DataIterator(Dataset(s)) for s in splits]
+
+    def limit(self, n: int) -> "Dataset":
+        """First n rows (materializes only the needed prefix of blocks)."""
+        out, have = [], 0
+        for ref in self._execute_refs():
+            if have >= n:
+                break
+            blk = ray_tpu.get(ref)
+            take = min(blk.num_rows, n - have)
+            out.append(blk.slice(0, take))
+            have += take
+        return Dataset([ray_tpu.put(b) for b in out])
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of equal-length datasets (reference:
+        dataset.zip); blocks are realigned by repartitioning both sides
+        to matching row windows."""
+        left = B.concat_blocks(ray_tpu.get(self._execute_refs()))
+        right = B.concat_blocks(ray_tpu.get(other._execute_refs()))
+        if left.num_rows != right.num_rows:
+            raise ValueError(f"zip requires equal row counts ({left.num_rows} vs {right.num_rows})")
+        for name in right.column_names:
+            col = right.column(name)
+            out_name = name if name not in left.column_names else name + "_1"
+            left = left.append_column(out_name, col)
+        return Dataset([ray_tpu.put(left)])
+
     def iter_rows(self) -> Iterator[Dict]:
         for ref in self._execute_refs():
             for row in B.block_rows(ray_tpu.get(ref)):
@@ -302,3 +394,20 @@ class Dataset:
 
     def __repr__(self):
         return f"Dataset(num_blocks={len(self._block_refs)}, ops={len(self._ops)})"
+
+
+class DataIterator:
+    """One consumer's streaming view of a dataset split (reference:
+    data/iterator.py DataIterator handed out by streaming_split)."""
+
+    def __init__(self, ds: Dataset):
+        self._ds = ds
+
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        return self._ds.iter_batches(**kw)
+
+    def iter_torch_batches(self, **kw) -> Iterator[Any]:
+        return self._ds.iter_torch_batches(**kw)
+
+    def count(self) -> int:
+        return self._ds.count()

@@ -1,0 +1,249 @@
+"""Dataset preprocessors: fit distributed statistics, transform lazily.
+
+Equivalent of the reference's preprocessor library
+(reference: python/ray/data/preprocessors/ — scaler.py, encoder.py,
+imputer.py, concatenator.py, chain.py). Fit aggregates per-column
+statistics with one task per block combined on the driver (numbers
+only — never rows); transform is a lazy `map_batches` so it fuses into
+the dataset's per-block pipeline and streams, TPU-style: the output of
+`Concatenator` is a single contiguous float matrix per batch, ready
+for `device_put` without row-wise python.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+
+class Preprocessor:
+    """fit(ds) learns state; transform(ds) appends a lazy batch op."""
+
+    _fitted = False
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} must be fit before transform")
+        fn = self._transform_batch  # bound method pickles with the state
+        return ds.map_batches(fn, batch_format="numpy")
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def transform_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return self._transform_batch(dict(batch))
+
+    # subclass hooks
+    def _needs_fit(self) -> bool:
+        return True
+
+    def _fit(self, ds) -> None:
+        raise NotImplementedError
+
+    def _transform_batch(self, batch):
+        raise NotImplementedError
+
+
+@ray_tpu.remote
+def _column_moments(blk, ops, columns):
+    """(count, sum, sumsq, min, max) per column for one block."""
+    from ray_tpu.data.dataset import _apply_ops_local
+
+    blk = _apply_ops_local(blk, ops)
+    out = {}
+    for c in columns:
+        v = np.asarray(blk.column(c).to_numpy(zero_copy_only=False), dtype=np.float64)
+        v = v[~np.isnan(v)]
+        if len(v) == 0:
+            out[c] = (0, 0.0, 0.0, np.inf, -np.inf)
+        else:
+            out[c] = (len(v), float(v.sum()), float((v * v).sum()), float(v.min()), float(v.max()))
+    return out
+
+
+@ray_tpu.remote
+def _column_uniques(blk, ops, columns):
+    from ray_tpu.data.dataset import _apply_ops_local
+
+    blk = _apply_ops_local(blk, ops)
+    return {c: list(set(blk.column(c).to_pylist())) for c in columns}
+
+
+def _gather_moments(ds, columns) -> Dict[str, Dict[str, float]]:
+    ops = ray_tpu.put(ds._ops) if ds._ops else None
+    parts = ray_tpu.get([_column_moments.remote(r, ops, columns) for r in ds._block_refs])
+    stats = {}
+    for c in columns:
+        n = sum(p[c][0] for p in parts)
+        s = sum(p[c][1] for p in parts)
+        ss = sum(p[c][2] for p in parts)
+        mn = min(p[c][3] for p in parts)
+        mx = max(p[c][4] for p in parts)
+        mean = s / n if n else 0.0
+        var = max(ss / n - mean * mean, 0.0) if n else 0.0
+        stats[c] = {"count": n, "mean": mean, "std": var**0.5, "min": mn, "max": mx}
+    return stats
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (reference: preprocessors/scaler.py)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, Dict[str, float]] = {}
+
+    def _fit(self, ds):
+        self.stats_ = _gather_moments(ds, self.columns)
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            st = self.stats_[c]
+            std = st["std"] or 1.0
+            batch[c] = (np.asarray(batch[c], np.float64) - st["mean"]) / std
+        return batch
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column (reference: scaler.py)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, Dict[str, float]] = {}
+
+    def _fit(self, ds):
+        self.stats_ = _gather_moments(ds, self.columns)
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            st = self.stats_[c]
+            span = (st["max"] - st["min"]) or 1.0
+            batch[c] = (np.asarray(batch[c], np.float64) - st["min"]) / span
+        return batch
+
+
+class LabelEncoder(Preprocessor):
+    """Map category values to dense int codes (reference: encoder.py)."""
+
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.mapping_: Dict[Any, int] = {}
+
+    def _fit(self, ds):
+        ops = ray_tpu.put(ds._ops) if ds._ops else None
+        parts = ray_tpu.get(
+            [_column_uniques.remote(r, ops, [self.label_column]) for r in ds._block_refs]
+        )
+        values = sorted({v for p in parts for v in p[self.label_column]}, key=str)
+        self.mapping_ = {v: i for i, v in enumerate(values)}
+
+    def _transform_batch(self, batch):
+        m = self.mapping_
+        batch[self.label_column] = np.asarray([m[v] for v in batch[self.label_column]], np.int64)
+        return batch
+
+
+class OneHotEncoder(Preprocessor):
+    """Expand each category column into 0/1 indicator columns
+    (reference: encoder.py OneHotEncoder)."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.categories_: Dict[str, List[Any]] = {}
+
+    def _fit(self, ds):
+        ops = ray_tpu.put(ds._ops) if ds._ops else None
+        parts = ray_tpu.get([_column_uniques.remote(r, ops, self.columns) for r in ds._block_refs])
+        for c in self.columns:
+            self.categories_[c] = sorted({v for p in parts for v in p[c]}, key=str)
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            vals = batch.pop(c)
+            for cat in self.categories_[c]:
+                batch[f"{c}_{cat}"] = np.asarray([v == cat for v in vals], np.int8)
+        return batch
+
+
+class SimpleImputer(Preprocessor):
+    """Fill NaNs with the column mean or a constant (reference: imputer.py)."""
+
+    def __init__(self, columns: List[str], strategy: str = "mean", fill_value: Optional[float] = None):
+        if strategy not in ("mean", "constant"):
+            raise ValueError(f"unknown imputing strategy {strategy!r}")
+        self.columns = columns
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: Dict[str, Dict[str, float]] = {}
+
+    def _needs_fit(self):
+        return self.strategy == "mean"
+
+    def _fit(self, ds):
+        if self.strategy == "mean":
+            self.stats_ = _gather_moments(ds, self.columns)
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            fill = self.stats_[c]["mean"] if self.strategy == "mean" else self.fill_value
+            v = np.asarray(batch[c], np.float64)
+            batch[c] = np.where(np.isnan(v), fill, v)
+        return batch
+
+
+class Concatenator(Preprocessor):
+    """Merge numeric columns into one contiguous float feature matrix —
+    the device_put-ready layout (reference: concatenator.py)."""
+
+    def __init__(self, columns: List[str], output_column_name: str = "concat_out",
+                 dtype=np.float32, exclude: Optional[List[str]] = None):
+        self.columns = columns
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+        self.exclude = exclude or []
+
+    def _needs_fit(self):
+        return False
+
+    def _fit(self, ds):
+        pass
+
+    def _transform_batch(self, batch):
+        cols = [c for c in self.columns if c not in self.exclude]
+        mat = np.stack([np.asarray(batch.pop(c), self.dtype) for c in cols], axis=1)
+        batch[self.output_column_name] = mat
+        return batch
+
+
+class Chain(Preprocessor):
+    """Run preprocessors in sequence; fit respects upstream transforms
+    (reference: chain.py)."""
+
+    def __init__(self, *preprocessors: Preprocessor):
+        self.preprocessors = list(preprocessors)
+
+    def _needs_fit(self):
+        return any(p._needs_fit() for p in self.preprocessors)
+
+    def fit(self, ds) -> "Chain":
+        for p in self.preprocessors:
+            ds = p.fit(ds).transform(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds
+
+    def transform_batch(self, batch):
+        for p in self.preprocessors:
+            batch = p.transform_batch(batch)
+        return batch

@@ -1,0 +1,24 @@
+"""Dynamic custom resources.
+
+Equivalent of the reference's experimental dynamic resources
+(reference: python/ray/experimental/dynamic_resources.py set_resource —
+resize a node's custom resource capacity at runtime; the scheduler
+re-evaluates queued tasks against the new totals).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private.worker import get_global_core
+
+
+def set_resource(resource_name: str, capacity: float, node_id: Optional[str] = None) -> None:
+    """Set `resource_name` to `capacity` on a node (first alive node when
+    node_id is omitted). capacity=0 deletes the resource."""
+    if resource_name in ("CPU", "GPU", "TPU", "memory"):
+        raise ValueError(f"cannot dynamically resize built-in resource {resource_name!r}")
+    core = get_global_core()
+    core.gcs_request(
+        "node.set_resource",
+        {"node_id": node_id, "resource_name": resource_name, "capacity": float(capacity)},
+    )

@@ -1,0 +1,99 @@
+"""Multi-process progress bars.
+
+Equivalent of the reference's tqdm_ray
+(reference: python/ray/experimental/tqdm_ray.py — worker processes emit
+structured progress records; a driver-side manager renders one
+consolidated bar per (process, description) without interleaving
+stdout). Here workers throttle updates through a named manager actor
+and the driver prints carriage-return bars.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+import ray_tpu
+
+_MANAGER_NAME = "_tqdm_ray_manager"
+
+
+@ray_tpu.remote
+class _TqdmManager:
+    def __init__(self):
+        self.bars: Dict[str, Dict] = {}
+        self._last_render = 0.0
+
+    def update(self, bar_id: str, desc: str, completed: int, total: Optional[int], closed: bool):
+        if closed:
+            self.bars.pop(bar_id, None)
+        else:
+            self.bars[bar_id] = {"desc": desc, "completed": completed, "total": total}
+        now = time.monotonic()
+        if now - self._last_render > 0.1 or closed:
+            self._last_render = now
+            self._render()
+        return True
+
+    def _render(self):
+        parts = []
+        for b in self.bars.values():
+            if b["total"]:
+                pct = 100.0 * b["completed"] / b["total"]
+                parts.append(f"{b['desc']}: {b['completed']}/{b['total']} ({pct:.0f}%)")
+            else:
+                parts.append(f"{b['desc']}: {b['completed']}")
+        if parts:
+            print("\r" + " | ".join(parts), end="", flush=True)
+        else:
+            print("\r", end="", flush=True)
+
+    def snapshot(self):
+        return dict(self.bars)
+
+
+def _manager():
+    try:
+        return ray_tpu.get_actor(_MANAGER_NAME)
+    except ValueError:
+        try:
+            return _TqdmManager.options(name=_MANAGER_NAME, lifetime="detached", num_cpus=0).remote()
+        except Exception:
+            return ray_tpu.get_actor(_MANAGER_NAME)
+
+
+class tqdm:
+    """Drop-in-ish tqdm: iterable wrapper + manual update()/close()."""
+
+    def __init__(self, iterable=None, desc: str = "", total: Optional[int] = None):
+        self._iterable = iterable
+        self.desc = desc or "progress"
+        self.total = total if total is not None else (len(iterable) if hasattr(iterable, "__len__") else None)
+        self.completed = 0
+        self._id = f"{os.getpid()}:{id(self)}"
+        self._mgr = _manager()
+        self._last_push = 0.0
+        self._push(force=True)
+
+    def _push(self, force: bool = False, closed: bool = False):
+        now = time.monotonic()
+        if not force and now - self._last_push < 0.1:
+            return
+        self._last_push = now
+        try:
+            self._mgr.update.remote(self._id, self.desc, self.completed, self.total, closed)
+        except Exception:
+            pass
+
+    def update(self, n: int = 1):
+        self.completed += n
+        self._push()
+
+    def close(self):
+        self._push(force=True, closed=True)
+
+    def __iter__(self):
+        for item in self._iterable:
+            yield item
+            self.update(1)
+        self.close()

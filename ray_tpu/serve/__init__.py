@@ -19,3 +19,4 @@ from ray_tpu.serve.api import (  # noqa: F401
     status,
 )
 from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401

@@ -44,6 +44,12 @@ class Replica:
         with self._ongoing_lock:
             self.num_requests += 1
             self._ongoing += 1
+        model_id = kwargs.pop("_serve_multiplexed_model_id", "")
+        token = None
+        if model_id:
+            from ray_tpu.serve.multiplex import _set_model_id
+
+            token = _set_model_id(model_id)
         try:
             fn = self.instance if method == "__call__" else getattr(self.instance, method)
             result = fn(*args, **kwargs)
@@ -55,6 +61,10 @@ class Replica:
                 result = asyncio.run(result)
             return result
         finally:
+            if token is not None:
+                from ray_tpu.serve.multiplex import _current_model_id
+
+                _current_model_id.reset(token)
             with self._ongoing_lock:
                 self._ongoing -= 1
 

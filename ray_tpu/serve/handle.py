@@ -46,6 +46,7 @@ class DeploymentHandle:
         self._version = 0
         self._lock = threading.Lock()
         self._method = "__call__"
+        self._model_id = ""  # multiplexing: routes with model affinity
         self._poller: Optional[threading.Thread] = None
         self._closed = False
 
@@ -104,9 +105,10 @@ class DeploymentHandle:
 
                 time.sleep(1.0)
 
-    def options(self, method_name: str = "__call__", **_):
+    def options(self, method_name: str = "__call__", multiplexed_model_id: str = "", **_):
         h = DeploymentHandle(self.deployment_name, self.app_name)
         h._method = method_name
+        h._model_id = multiplexed_model_id
         with self._lock:
             h._replica_names = list(self._replica_names)
             h._replicas = list(self._replicas)
@@ -121,11 +123,25 @@ class DeploymentHandle:
     # -- routing --------------------------------------------------------
     def _pick(self) -> int:
         """Power of two choices on outstanding counts
-        (reference: pow_2_scheduler.py:44)."""
+        (reference: pow_2_scheduler.py:44). With a multiplexed model id,
+        the two candidates come from rendezvous hashing on the model id
+        instead of randomness, so each model sticks to a stable pair of
+        replicas and their multiplex LRUs keep hitting (reference:
+        pow_2_scheduler's multiplexed-model-id preference)."""
         n = len(self._replicas)
         if n == 1:
             return 0
-        a, b = random.sample(range(n), 2)
+        if self._model_id:
+            import hashlib
+
+            def score(i):
+                h = hashlib.md5(f"{self._model_id}|{self._replica_names[i]}".encode())
+                return h.digest()
+
+            ranked = sorted(range(n), key=score)
+            a, b = ranked[0], ranked[1]
+        else:
+            a, b = random.sample(range(n), 2)
         return a if self._outstanding.get(a, 0) <= self._outstanding.get(b, 0) else b
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
@@ -144,6 +160,8 @@ class DeploymentHandle:
             with self._lock:
                 self._outstanding[idx] = max(0, self._outstanding.get(idx, 1) - 1)
 
+        if self._model_id:
+            kwargs = {**kwargs, "_serve_multiplexed_model_id": self._model_id}
         try:
             ref = replica.handle_request.remote(self._method, args, kwargs)
         except Exception:

@@ -1,0 +1,118 @@
+"""Model multiplexing: many models share a replica pool.
+
+Equivalent of the reference's serve.multiplexed / get_multiplexed_model_id
+(reference: python/ray/serve/multiplex.py _ModelMultiplexWrapper — a
+per-replica LRU of loaded models keyed by the request's model id; and
+api.py get_multiplexed_model_id). Routing affinity comes from
+rendezvous hashing on the model id (handle.py) so the same model keeps
+landing on the same replicas and the LRU actually hits — the reference
+gets the same effect by reporting loaded-model sets through long-poll;
+hashing needs no state push and behaves identically under a stable
+replica set, which on a TPU serving pod it is.
+"""
+from __future__ import annotations
+
+import collections
+import contextvars
+import functools
+import inspect
+import threading
+from typing import Any, Callable, Optional
+
+_current_model_id: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default=""
+)
+_mux_states: dict = {}  # (module, qualname) -> {"lock", "cache"}, per process
+
+
+def get_multiplexed_model_id() -> str:
+    """The model id of the request currently being handled
+    (reference: serve/api.py get_multiplexed_model_id)."""
+    return _current_model_id.get()
+
+
+def _set_model_id(model_id: str):
+    return _current_model_id.set(model_id)
+
+
+def _run_coroutine(coro):
+    """Run an async model loader to completion whether or not the caller
+    is already inside an event loop (an async deployment handler runs
+    under asyncio.run in the replica — a nested asyncio.run would raise)."""
+    import asyncio
+
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return asyncio.run(coro)
+    result: dict = {}
+
+    def runner():
+        try:
+            result["value"] = asyncio.run(coro)
+        except BaseException as e:
+            result["error"] = e
+
+    t = threading.Thread(target=runner, name="multiplex-loader")
+    t.start()
+    t.join()
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorate a model-loader method `def get_model(self, model_id)`;
+    calls are LRU-cached per replica, evicting the least-recently-used
+    model beyond `max_num_models_per_replica`."""
+
+    def deco(fn: Callable):
+        # LRU state lives OUTSIDE the function/class (created lazily per
+        # process, keyed by the wrapped function): a closure-captured
+        # threading.Lock would make the deployment class unpicklable for
+        # serve.run's cloudpickle ship to the controller
+        state_key = (fn.__module__, fn.__qualname__)
+
+        def _state():
+            st = _mux_states.get(state_key)
+            if st is None:
+                st = _mux_states[state_key] = {
+                    "lock": threading.Lock(),
+                    "cache": collections.OrderedDict(),
+                }
+            return st
+
+        @functools.wraps(fn)
+        def wrapper(self_or_id, *rest):
+            if rest:
+                owner, model_id = self_or_id, rest[0]
+            else:
+                owner, model_id = None, self_or_id
+            st = _state()
+            lock, cache = st["lock"], st["cache"]
+            with lock:
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+            model = fn(owner, model_id) if owner is not None else fn(model_id)
+            if inspect.iscoroutine(model):
+                model = _run_coroutine(model)
+            with lock:
+                cache[model_id] = model
+                cache.move_to_end(model_id)
+                while len(cache) > max_num_models_per_replica:
+                    evicted_id, evicted = cache.popitem(last=False)
+                    del_fn = getattr(evicted, "__del__", None)
+                    if del_fn is not None:
+                        try:
+                            del_fn()
+                        except Exception:
+                            pass
+            return model
+
+        wrapper._multiplexed_state = _state  # introspection / tests
+        return wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

@@ -6,6 +6,9 @@ configs, schedulers (ASHA/median) stop poor trials early.
 """
 from ray_tpu.air.session import report  # noqa: F401  (tune.report == train.report)
 from ray_tpu.tune.search import (  # noqa: F401
+    ConcurrencyLimiter,
+    Repeater,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,

@@ -76,6 +76,37 @@ class AsyncHyperBandScheduler(FIFOScheduler):
 ASHAScheduler = AsyncHyperBandScheduler
 
 
+class HyperBandScheduler(FIFOScheduler):
+    """Bracketed successive halving: trials round-robin across brackets
+    whose grace periods are g·rf^s, so some trials get long low-pressure
+    runs while others face aggressive early rungs (reference:
+    tune/schedulers/hyperband.py; realized here as ASHA-per-bracket —
+    the asynchronous variant of the same rung math, which needs no
+    pause/resume coordination)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration", max_t: int = 81,
+                 reduction_factor: float = 3.0):
+        self.num_brackets = max(1, int(math.log(max_t, reduction_factor)))
+        self.brackets = [
+            AsyncHyperBandScheduler(
+                metric=metric, mode=mode, time_attr=time_attr, max_t=max_t,
+                grace_period=max(1, int(reduction_factor**s)),
+                reduction_factor=reduction_factor,
+            )
+            for s in range(self.num_brackets)
+        ]
+        self._bracket_of: Dict[str, int] = {}
+        self._next = 0
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        b = self._bracket_of.get(trial_id)
+        if b is None:
+            b = self._bracket_of[trial_id] = self._next % self.num_brackets
+            self._next += 1
+        return self.brackets[b].on_result(trial_id, result)
+
+
 class MedianStoppingRule(FIFOScheduler):
     """Stop trials below the median of running averages
     (reference: tune/schedulers/median_stopping_rule.py)."""

@@ -99,6 +99,12 @@ def sample_from(fn) -> SampleFrom:
     return SampleFrom(fn)
 
 
+# suggest() sentinel: "no config right now, ask again later" — distinct
+# from None, which means the search is exhausted (reference:
+# tune/search/searcher.py Searcher.FINISHED vs deferred suggestions)
+PENDING = "__pending__"
+
+
 class Searcher:
     """Interface (reference: tune/search/searcher.py)."""
 
@@ -107,6 +113,166 @@ class Searcher:
 
     def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None):
         pass
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap in-flight suggestions from any searcher (reference:
+    tune/search/concurrency_limiter.py). suggest() yields PENDING while
+    `max_concurrent` earlier suggestions are unresolved."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    @property
+    def total_trials(self):
+        return getattr(self.searcher, "total_trials", None)
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return PENDING
+        config = self.searcher.suggest(trial_id)
+        if config is None or config == PENDING:
+            return config
+        self._live.add(trial_id)
+        return config
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result)
+
+
+class Repeater(Searcher):
+    """Run each underlying config `repeat` times and report the averaged
+    metric to the wrapped searcher once the whole group finishes
+    (reference: tune/search/repeater.py — variance reduction for noisy
+    objectives)."""
+
+    def __init__(self, searcher: Searcher, repeat: int, metric: str = "score"):
+        self.searcher = searcher
+        self.repeat = repeat
+        self.metric = metric
+        self._pending_config: Optional[Dict] = None
+        self._emitted = 0
+        self._group_of: Dict[str, str] = {}  # trial_id -> group lead trial_id
+        self._groups: Dict[str, Dict] = {}  # lead -> {"want", "got", "vals"}
+
+    @property
+    def total_trials(self):
+        inner = getattr(self.searcher, "total_trials", None)
+        return None if inner is None else inner * self.repeat
+
+    def suggest(self, trial_id: str):
+        if self._pending_config is None:
+            config = self.searcher.suggest(trial_id)
+            if config is None or config == PENDING:
+                return config
+            self._pending_config = config
+            self._emitted = 0
+            self._lead = trial_id
+            self._groups[trial_id] = {"want": self.repeat, "got": 0, "vals": []}
+        self._group_of[trial_id] = self._lead
+        self._emitted += 1
+        config = dict(self._pending_config)
+        if self._emitted >= self.repeat:
+            self._pending_config = None
+        return config
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None):
+        lead = self._group_of.pop(trial_id, None)
+        if lead is None:
+            return
+        g = self._groups[lead]
+        g["got"] += 1
+        if result and self.metric in result:
+            g["vals"].append(float(result[self.metric]))
+        if g["got"] >= g["want"]:
+            avg = sum(g["vals"]) / len(g["vals"]) if g["vals"] else None
+            self.searcher.on_trial_complete(
+                lead, {self.metric: avg} if avg is not None else None
+            )
+            del self._groups[lead]
+
+
+class TPESearcher(Searcher):
+    """Native tree-structured-Parzen-style searcher (the model behind the
+    reference's HyperOptSearch, tune/search/hyperopt/): split observed
+    trials into good/bad by quantile, model each numeric dimension as a
+    gaussian mixture over the good points, and pick the candidate that
+    maximizes the good/bad density ratio. Categorical dimensions sample
+    from smoothed good-set frequencies."""
+
+    def __init__(self, param_space: Dict[str, Any], metric: str = "score",
+                 mode: str = "max", n_startup: int = 8, n_candidates: int = 24,
+                 gamma: float = 0.25, seed: Optional[int] = None):
+        self.param_space = param_space
+        self.metric = metric
+        self.mode = mode
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.gamma = gamma
+        self.rng = random.Random(seed)
+        self._configs: Dict[str, Dict] = {}
+        self._history: List[Any] = []  # (score, config)
+
+    def _random_config(self) -> Dict[str, Any]:
+        out = {}
+        for k, v in self.param_space.items():
+            if isinstance(v, GridSearch):
+                out[k] = self.rng.choice(v.values)
+            elif isinstance(v, Domain):
+                out[k] = v.sample(self.rng)
+            else:
+                out[k] = v
+        return out
+
+    @staticmethod
+    def _kde_logpdf(x: float, points: List[float], bw: float) -> float:
+        if not points:
+            return 0.0
+        acc = 0.0
+        for p in points:
+            z = (x - p) / bw
+            acc += math.exp(-0.5 * z * z)
+        return math.log(acc / (len(points) * bw) + 1e-12)
+
+    def suggest(self, trial_id: str):
+        if len(self._history) < self.n_startup:
+            config = self._random_config()
+        else:
+            ordered = sorted(self._history, key=lambda t: t[0], reverse=(self.mode == "max"))
+            n_good = max(2, int(len(ordered) * self.gamma))
+            good = [c for _, c in ordered[:n_good]]
+            bad = [c for _, c in ordered[n_good:]] or good
+            best, best_score = None, -math.inf
+            for _ in range(self.n_candidates):
+                cand = self._random_config()
+                score = 0.0
+                for k, v in self.param_space.items():
+                    if isinstance(v, (Uniform, LogUniform, Randint, QRandint)):
+                        lo, hi = float(v.low), float(v.high)
+                        xform = math.log if isinstance(v, LogUniform) else float
+                        bw = max((xform(hi) - xform(lo)) / 5.0, 1e-9)
+                        x = xform(cand[k])
+                        score += self._kde_logpdf(x, [xform(c[k]) for c in good], bw)
+                        score -= self._kde_logpdf(x, [xform(c[k]) for c in bad], bw)
+                    elif isinstance(v, Categorical):
+                        n_cat = len(v.categories)
+                        g = ([c[k] for c in good].count(cand[k]) + 1) / (len(good) + n_cat)
+                        b = ([c[k] for c in bad].count(cand[k]) + 1) / (len(bad) + n_cat)
+                        score += math.log(g / b)
+                if score > best_score:
+                    best, best_score = cand, score
+            config = best
+        self._configs[trial_id] = config
+        return config
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict] = None):
+        config = self._configs.pop(trial_id, None)
+        if config is None or not result or self.metric not in result:
+            return
+        self._history.append((float(result[self.metric]), config))
 
 
 class BasicVariantGenerator(Searcher):

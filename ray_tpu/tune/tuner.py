@@ -14,6 +14,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.air.config import RunConfig
+from ray_tpu.tune import search
 from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
 from ray_tpu.tune.search import BasicVariantGenerator, Searcher
 from ray_tpu.util.queue import Empty, Queue
@@ -279,11 +280,21 @@ class Tuner:
                 return True
             if exhausted:
                 return False
+            # self-limiting searchers (BasicVariantGenerator) expose
+            # total_trials; open-ended ones (TPE, external integrations)
+            # are capped by num_samples (reference: TuneConfig.num_samples
+            # bounds any search algorithm)
+            cap = getattr(searcher, "total_trials", None) or tc.num_samples
+            if counter >= cap:
+                exhausted = True
+                return False
             trial_id = f"trial_{counter:05d}"
             config = searcher.suggest(trial_id)
             if config is None:
                 exhausted = True
                 return False
+            if config == search.PENDING:
+                return False  # limiter/deferred searcher: retry next tick
             counter += 1
             trials[trial_id] = TrialResult(trial_id, config)
             _launch(trial_id, config)
@@ -321,6 +332,10 @@ class Tuner:
                         ray_tpu.kill(entry[0])
                     except Exception:
                         pass
+                # a stopped trial is resolved: the searcher must hear about
+                # it or a ConcurrencyLimiter slot / Repeater group leaks
+                # and the run stalls returning PENDING forever
+                searcher.on_trial_complete(tid, t.metrics)
             elif isinstance(decision, tuple) and decision[0] == "EXPLOIT":
                 # PBT exploit/explore: restart this trial from the
                 # winner's latest checkpoint with a mutated config

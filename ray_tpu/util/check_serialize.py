@@ -1,0 +1,75 @@
+"""Serializability inspection.
+
+Equivalent of the reference's `ray.util.check_serialize`
+(reference: python/ray/util/check_serialize.py
+inspect_serializability) — walk an object's closure/attribute graph to
+find WHICH nested member fails to pickle, instead of surfacing one
+opaque TypeError from the middle of a task submission.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Optional, Set, Tuple
+
+import cloudpickle
+
+
+class FailureTuple:
+    """One unserializable leaf: the object, its name, and who holds it."""
+
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailureTuple(name={self.name!r}, parent={type(self.parent).__name__})"
+
+
+def _try_pickle(obj: Any) -> bool:
+    try:
+        cloudpickle.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
+def _scan(obj: Any, name: str, parent: Any, failures, seen: Set[int], depth: int):
+    if id(obj) in seen or depth > 6:
+        return
+    seen.add(id(obj))
+    if _try_pickle(obj):
+        return
+    children = []
+    if inspect.isfunction(obj):
+        closure = inspect.getclosurevars(obj)
+        children = list(closure.nonlocals.items()) + list(closure.globals.items())
+    elif hasattr(obj, "__dict__") and not inspect.isclass(obj):
+        children = list(vars(obj).items())
+    elif isinstance(obj, dict):
+        children = list(obj.items())
+    elif isinstance(obj, (list, tuple, set)):
+        children = [(f"[{i}]", v) for i, v in enumerate(obj)]
+    found_deeper = False
+    for child_name, child in children:
+        if not _try_pickle(child):
+            found_deeper = True
+            _scan(child, str(child_name), obj, failures, seen, depth + 1)
+    if not found_deeper:
+        failures.append(FailureTuple(obj, name, parent))
+
+
+def inspect_serializability(obj: Any, name: Optional[str] = None) -> Tuple[bool, Set[FailureTuple]]:
+    """Returns (serializable, failures). Prints a short report like the
+    reference helper."""
+    name = name or getattr(obj, "__name__", repr(obj)[:40])
+    if _try_pickle(obj):
+        return True, set()
+    failures: list = []
+    _scan(obj, name, None, failures, set(), 0)
+    print(f"{'=' * 50}\nSerialization check for {name!r}: FAILED")
+    for f in failures:
+        print(f"  cannot pickle {f.name!r} "
+              f"(type {type(f.obj).__name__}) held by {type(f.parent).__name__ if f.parent is not None else 'top level'}")
+    print("=" * 50)
+    return False, set(failures)

@@ -1,0 +1,26 @@
+"""joblib backend running Parallel() workloads on the cluster.
+
+Equivalent of the reference's joblib integration
+(reference: python/ray/util/joblib/__init__.py register_ray +
+ray_backend.py RayBackend): `register_ray()` registers a joblib
+parallel backend that fans batches out as tasks, so
+`with joblib.parallel_backend("ray_tpu"): Parallel()(...)` runs
+scikit-learn-style workloads on the cluster unchanged.
+"""
+from __future__ import annotations
+
+
+def register_ray() -> None:
+    """Register the 'ray_tpu' joblib backend (import-guarded: joblib is
+    optional in this image)."""
+    try:
+        from joblib.parallel import register_parallel_backend
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("joblib is not installed") from e
+
+    from ray_tpu.util.joblib.backend import RayTpuBackend
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)
+
+
+__all__ = ["register_ray"]

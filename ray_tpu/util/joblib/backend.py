@@ -1,0 +1,66 @@
+"""The joblib ParallelBackend over ray_tpu tasks.
+
+Equivalent of the reference's RayBackend
+(reference: python/ray/util/joblib/ray_backend.py — batches of joblib
+callables become remote tasks; results come back through the object
+store). Implements joblib's submit/retrieve_result_callback protocol
+(joblib >= 1.3): each BatchedCalls ships as one task, and a waiter
+thread fires joblib's completion callback when the object resolves.
+"""
+from __future__ import annotations
+
+import threading
+
+from joblib._parallel_backends import ParallelBackendBase
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _run_batch(batch):
+    return batch()
+
+
+class RayTpuBackend(ParallelBackendBase):
+    supports_retrieve_callback = True
+    uses_threads = False
+    supports_sharedmem = False
+
+    def configure(self, n_jobs=1, parallel=None, **kwargs):
+        self.parallel = parallel
+        return self.effective_n_jobs(n_jobs)
+
+    def effective_n_jobs(self, n_jobs):
+        if n_jobs == 1:
+            return 1
+        total = ray_tpu.cluster_resources().get("CPU") if ray_tpu.is_initialized() else None
+        if n_jobs in (None, -1):
+            return int(total) if total else 4
+        return n_jobs
+
+    def submit(self, func, callback=None):
+        ref = _run_batch.remote(func)
+
+        def waiter():
+            try:
+                out = ("ok", ray_tpu.get(ref))
+            except BaseException as e:  # delivered through retrieve_result_callback
+                out = ("err", e)
+            if callback is not None:
+                callback(out)
+
+        threading.Thread(target=waiter, daemon=True, name="joblib-ray-waiter").start()
+        return ref
+
+    def retrieve_result_callback(self, out):
+        kind, val = out
+        if kind == "err":
+            raise val
+        return val
+
+    def terminate(self):
+        pass
+
+    def abort_everything(self, ensure_ready=True):
+        if ensure_ready:
+            self.configure(n_jobs=self.parallel.n_jobs, parallel=self.parallel)

@@ -144,3 +144,92 @@ def test_streaming_larger_than_arena(ray_start_regular):
         seen += 1
         total += float(batch["payload"][0])
     assert seen == 30
+
+
+def test_preprocessors_scalers(ray_start_regular):
+    from ray_tpu.data.preprocessors import MinMaxScaler, StandardScaler
+
+    ds = ray_tpu.data.from_items([{"a": float(i), "b": float(i * 2)} for i in range(100)])
+    sc = StandardScaler(["a"]).fit(ds)
+    assert sc.stats_["a"]["mean"] == pytest.approx(49.5)
+    out = sc.transform(ds).to_pandas()
+    assert abs(out["a"].mean()) < 1e-9
+    assert out["a"].std(ddof=0) == pytest.approx(1.0)
+    assert out["b"].iloc[3] == 6.0  # untouched
+
+    mm = MinMaxScaler(["b"]).fit(ds)
+    out = mm.transform(ds).to_pandas()
+    assert out["b"].min() == 0.0 and out["b"].max() == 1.0
+
+
+def test_preprocessors_encoders_imputer_concat(ray_start_regular):
+    import math
+
+    from ray_tpu.data.preprocessors import (
+        Chain,
+        Concatenator,
+        LabelEncoder,
+        OneHotEncoder,
+        SimpleImputer,
+    )
+
+    rows = [
+        {"color": "red", "size": 1.0, "label": "cat"},
+        {"color": "blue", "size": float("nan"), "label": "dog"},
+        {"color": "red", "size": 3.0, "label": "cat"},
+        {"color": "green", "size": 5.0, "label": "bird"},
+    ]
+    ds = ray_tpu.data.from_items(rows, parallelism=2)
+
+    le = LabelEncoder("label").fit(ds)
+    out = le.transform(ds).take_all()
+    assert [r["label"] for r in out] == [1, 2, 1, 0]  # bird=0, cat=1, dog=2
+
+    oh = OneHotEncoder(["color"]).fit(ds)
+    out = oh.transform(ds).take_all()
+    assert out[0]["color_red"] == 1 and out[0]["color_blue"] == 0
+    assert out[3]["color_green"] == 1
+
+    im = SimpleImputer(["size"], strategy="mean").fit(ds)
+    out = im.transform(ds).take_all()
+    assert out[1]["size"] == pytest.approx(3.0)  # mean of 1,3,5
+    assert not any(math.isnan(r["size"]) for r in out)
+
+    chain = Chain(SimpleImputer(["size"], strategy="mean"), Concatenator(["size"], "features"))
+    chain.fit(ds)
+    out = chain.transform(ds).take_all()
+    assert len(out[0]["features"]) == 1
+
+
+def test_iter_torch_batches(ray_start_regular):
+    import torch
+
+    ds = ray_tpu.data.range(64)
+    got = 0
+    for b in ds.iter_torch_batches(batch_size=16):
+        assert isinstance(b["id"], torch.Tensor)
+        got += len(b["id"])
+    assert got == 64
+
+
+def test_streaming_split_and_limit_zip(ray_start_regular):
+    ds = ray_tpu.data.range(100, parallelism=10)
+    splits = ds.streaming_split(4)
+    total = sum(s.count() for s in splits)
+    assert total == 100
+
+    assert [r["id"] for r in ds.limit(7).take_all()] == list(range(7))
+
+    a = ray_tpu.data.from_items([{"x": i} for i in range(10)])
+    b = ray_tpu.data.from_items([{"y": i * 2} for i in range(10)])
+    z = a.zip(b).take_all()
+    assert z[4] == {"x": 4, "y": 8}
+
+
+def test_streaming_split_equal_rows(ray_start_regular):
+    """equal=True yields exactly total//n rows per split, dropping at most
+    the remainder (never whole blocks)."""
+    ds = ray_tpu.data.range(103, parallelism=10)
+    splits = ds.streaming_split(4, equal=True)
+    counts = [s.count() for s in splits]
+    assert counts == [25, 25, 25, 25], counts

@@ -195,3 +195,63 @@ def test_autoscaling_up_under_load(ray_start_regular):
         time.sleep(0.5)
     assert serve.status()["as_app"]["Slow"]["num_replicas"] == 1
     serve.delete("as_app")
+
+
+def test_multiplexed_models(ray_start_regular):
+    """Model multiplexing: per-replica LRU model cache, model-id routing
+    affinity, and get_multiplexed_model_id inside the request."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"model": model_id, "scale": len(model_id)}
+
+        def __call__(self, x: float):
+            model_id = serve.get_multiplexed_model_id()
+            model = self.get_model(model_id)
+            return {"model": model["model"], "y": x * model["scale"]}
+
+    handle = serve.run(MultiModel.bind(), name="mux")
+    try:
+        # same model id keeps routing to the same replica pair and hits its LRU
+        h_a = handle.options(multiplexed_model_id="aa")
+        h_b = handle.options(multiplexed_model_id="bbb")
+        ra = [h_a.remote(float(i)).result(timeout=30) for i in range(6)]
+        rb = [h_b.remote(float(i)).result(timeout=30) for i in range(6)]
+        assert [r["model"] for r in ra] == ["aa"] * 6
+        assert [r["y"] for r in ra] == [i * 2.0 for i in range(6)]
+        assert [r["model"] for r in rb] == ["bbb"] * 6
+        assert [r["y"] for r in rb] == [i * 3.0 for i in range(6)]
+    finally:
+        serve.delete("mux")
+
+
+def test_multiplexed_lru_eviction():
+    """Beyond max_num_models_per_replica, the least-recently-used model
+    is evicted and reloaded on next use (no cluster needed)."""
+    from ray_tpu.serve.multiplex import multiplexed
+
+    loads = []
+
+    class Holder:
+        @multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            loads.append(model_id)
+            return f"model-{model_id}"
+
+    h = Holder()
+    assert h.get_model("a") == "model-a"
+    assert h.get_model("b") == "model-b"
+    assert h.get_model("a") == "model-a"  # cache hit
+    assert loads == ["a", "b"]
+    h.get_model("c")  # evicts b (LRU)
+    h.get_model("a")  # still cached
+    assert loads == ["a", "b", "c"]
+    h.get_model("b")  # reload
+    assert loads == ["a", "b", "c", "b"]

@@ -181,3 +181,102 @@ def test_pbt_exploits_winner(ray_start_regular, tmp_path):
     # without PBT the poor trial tops out at 12*0.01=0.12; exploiting the
     # winner's checkpoint + mutated lr must lift it far beyond that
     assert scores[0] > 1.0, f"poor trial never exploited: {scores}"
+
+
+def test_concurrency_limiter(ray_start_regular):
+    """At most max_concurrent trials run at once; all samples still run."""
+    import time as _time
+
+    from ray_tpu.tune import ConcurrencyLimiter, TuneConfig, Tuner
+    from ray_tpu.tune.search import BasicVariantGenerator
+    from ray_tpu import tune
+
+    def trainable(config):
+        _time.sleep(0.3)
+        tune.report({"score": config["x"]})
+
+    base = BasicVariantGenerator({"x": tune.uniform(0, 1)}, num_samples=5, seed=0)
+    tuner = Tuner(
+        trainable,
+        tune_config=TuneConfig(
+            metric="score", mode="max", search_alg=ConcurrencyLimiter(base, max_concurrent=2),
+            max_concurrent_trials=4,
+        ),
+    )
+    results = tuner.fit()
+    assert len(results) == 5
+    assert all(r.status == "TERMINATED" for r in results)
+
+
+def test_repeater_averages(ray_start_regular):
+    from ray_tpu.tune import Repeater, TuneConfig, Tuner
+    from ray_tpu.tune.search import BasicVariantGenerator
+    from ray_tpu import tune
+
+    seen = []
+
+    class Spy(BasicVariantGenerator):
+        def on_trial_complete(self, trial_id, result=None):
+            seen.append(result)
+
+    def trainable(config):
+        import random
+
+        tune.report({"score": config["x"] + random.random() * 0.01})
+
+    base = Spy({"x": tune.choice([1.0, 2.0])}, num_samples=2, seed=1)
+    tuner = Tuner(
+        trainable,
+        tune_config=TuneConfig(metric="score", mode="max",
+                               search_alg=Repeater(base, repeat=3, metric="score")),
+    )
+    results = tuner.fit()
+    assert len(results) == 6  # 2 configs x 3 repeats
+    assert len(seen) == 2  # base searcher saw one averaged result per config
+    assert all(r is not None and "score" in r for r in seen)
+
+
+def test_tpe_searcher_improves(ray_start_regular):
+    """TPE concentrates samples near the optimum of a smooth objective:
+    the later half of suggestions should be closer to x*=0.7 on average
+    than the random startup half."""
+    import numpy as np
+
+    from ray_tpu.tune.search import TPESearcher
+    from ray_tpu import tune
+
+    sp = {"x": tune.uniform(0.0, 1.0)}
+    s = TPESearcher(sp, metric="score", mode="max", n_startup=10, seed=0)
+    xs = []
+    for i in range(40):
+        tid = f"t{i}"
+        cfg = s.suggest(tid)
+        xs.append(cfg["x"])
+        s.on_trial_complete(tid, {"score": -(cfg["x"] - 0.7) ** 2})
+    early = np.mean([abs(x - 0.7) for x in xs[:10]])
+    late = np.mean([abs(x - 0.7) for x in xs[-15:]])
+    assert late < early, (early, late)
+
+
+def test_hyperband_brackets(ray_start_regular):
+    """Bracketed halving stops poor trials while the best survives to max_t."""
+    from ray_tpu.tune import TuneConfig, Tuner
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+    from ray_tpu import tune
+
+    def trainable(config):
+        for i in range(1, 10):
+            tune.report({"loss": config["q"] / i})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([1.0, 2.0, 4.0, 8.0])},
+        tune_config=TuneConfig(
+            metric="loss", mode="min",
+            scheduler=HyperBandScheduler(metric="loss", mode="min", max_t=9),
+            max_concurrent_trials=4,
+        ),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert best.config["q"] == 1.0

@@ -66,3 +66,79 @@ def test_dag_bind_execute(ray_start_regular):
 
     dag = mul.bind(inc.bind(1), inc.bind(2))
     assert ray_tpu.get(dag.execute()) == 6
+
+
+def test_check_serialize(capsys):
+    import threading
+
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    ok, failures = inspect_serializability(lambda x: x + 1)
+    assert ok and not failures
+
+    lock = threading.Lock()
+
+    def bad(x):
+        with lock:
+            return x
+
+    ok, failures = inspect_serializability(bad, name="bad")
+    assert not ok
+    assert any("lock" in type(f.obj).__name__.lower() for f in failures)
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(lambda x: x * x)(i) for i in range(10))
+    assert out == [i * i for i in range(10)]
+
+
+def test_dynamic_resources(ray_start_regular):
+    from ray_tpu.experimental.dynamic_resources import set_resource
+
+    set_resource("widget", 2.0)
+    assert ray_tpu.cluster_resources().get("widget") == 2.0
+
+    @ray_tpu.remote(resources={"widget": 1})
+    def uses_widget():
+        return "made"
+
+    assert ray_tpu.get(uses_widget.remote(), timeout=30) == "made"
+    set_resource("widget", 0)
+    assert "widget" not in ray_tpu.cluster_resources()
+
+
+def test_tqdm_ray(ray_start_regular):
+    from ray_tpu.experimental import tqdm_ray
+
+    @ray_tpu.remote
+    def work(n):
+        bar = tqdm_ray.tqdm(desc=f"job{n}", total=10)
+        for _ in range(10):
+            bar.update(1)
+        bar.close()
+        return n
+
+    assert sorted(ray_tpu.get([work.remote(i) for i in range(3)], timeout=60)) == [0, 1, 2]
+
+
+def test_usage_stats(tmp_path, monkeypatch):
+    from ray_tpu._private import usage
+
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+    usage.record_library_usage("data")
+    usage.record_extra_usage_tag("test", "yes")
+    path = usage.write_usage_record(str(tmp_path))
+    import json
+
+    with open(path) as f:
+        rec = json.load(f)
+    assert "data" in rec["libraries"] and rec["tags"]["test"] == "yes"
+
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    assert usage.write_usage_record(str(tmp_path)) == ""
