@@ -43,13 +43,14 @@ CHUNK = 4 * 1024 * 1024
 
 
 def _gc_stale_arenas():
-    """Unlink /dev/shm arenas whose owning raylet pid is gone (defense
-    against SIGKILLed clusters; names embed the creator pid)."""
+    """Unlink /dev/shm arenas AND compiled-DAG channels whose owning pid
+    is gone (defense against SIGKILLed clusters/drivers; names embed the
+    creator pid)."""
     import glob
     import re
 
     for path in glob.glob("/dev/shm/ray_tpu_*"):
-        m = re.match(r".*/ray_tpu_(\d+)_", path)
+        m = re.match(r".*/ray_tpu_(?:chan_)?(\d+)_", path)
         if not m:
             continue
         pid = int(m.group(1))

@@ -45,12 +45,14 @@ def write_usage_record(session_dir: str) -> str:
     unless enabled."""
     if not usage_stats_enabled():
         return ""
+    import ray_tpu
+
     with _lock:
         record = {
             "ts": time.time(),
             "libraries": sorted(_features),
             "tags": dict(_tags),
-            "ray_tpu_version": "0.2.0",
+            "ray_tpu_version": getattr(ray_tpu, "__version__", "unknown"),
         }
     path = os.path.join(session_dir, "usage_stats.json")
     try:

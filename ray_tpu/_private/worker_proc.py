@@ -251,7 +251,19 @@ class Executor:
                 # jobs and nothing may leak across them
                 job_env = ensure_job_env(self.core, self.core.session_dir, spec.get("job_id"))
                 if actor:
-                    fn = getattr(self.actor_instance, spec["method"])
+                    if spec["method"] == "__ray_tpu_channel_loop__":
+                        # compiled-DAG resident loop (experimental/
+                        # compiled_dag.py): a framework method that runs
+                        # ON the actor instance without the class
+                        # declaring it (reference: compiled DAG installing
+                        # do_exec_tasks on participating actors)
+                        import functools
+
+                        from ray_tpu.experimental.compiled_dag import run_channel_loop
+
+                        fn = functools.partial(run_channel_loop, self.actor_instance)
+                    else:
+                        fn = getattr(self.actor_instance, spec["method"])
                 else:
                     fn = self.core.load_function(spec["fn_id"])
                 args, kwargs = self.core.unpack_args(spec["args"])

@@ -53,13 +53,19 @@ def block_to_batch(block: pa.Table, batch_format: str):
         out = {}
         for name, col in zip(block.column_names, block.columns):
             arr = np.asarray(col)
-            if arr.dtype == object and len(arr) and arr[0] is not None:
+            if (
+                arr.dtype == object
+                and len(arr)
+                and isinstance(arr[0], (list, np.ndarray))
+            ):
                 # list<numeric> columns (tensor features): restack into a
-                # contiguous 2-D array instead of a ragged object array
+                # contiguous 2-D array instead of a ragged object array.
+                # (scalars/strings stay object — stacking strings would
+                # pad every row to the longest element)
                 try:
                     arr = np.stack([np.asarray(v) for v in arr])
                 except (ValueError, TypeError):
-                    pass  # genuinely ragged / non-numeric: keep objects
+                    pass  # genuinely ragged: keep objects
             out[name] = arr
         return out
     raise ValueError(f"unknown batch_format {batch_format}")

@@ -78,6 +78,15 @@ def _slice_rows(blk, start, stop):
     return blk.slice(start, stop - start)
 
 
+@ray_tpu.remote
+def _zip_blocks(left, *right_parts):
+    right = B.concat_blocks(list(right_parts))
+    for name in right.column_names:
+        out_name = name if name not in left.column_names else name + "_1"
+        left = left.append_column(out_name, right.column(name).combine_chunks())
+    return left
+
+
 class Dataset:
     """Lazy dataset over block refs + a pending op chain."""
 
@@ -324,17 +333,34 @@ class Dataset:
 
     def zip(self, other: "Dataset") -> "Dataset":
         """Column-wise zip of equal-length datasets (reference:
-        dataset.zip); blocks are realigned by repartitioning both sides
-        to matching row windows."""
-        left = B.concat_blocks(ray_tpu.get(self._execute_refs()))
-        right = B.concat_blocks(ray_tpu.get(other._execute_refs()))
-        if left.num_rows != right.num_rows:
-            raise ValueError(f"zip requires equal row counts ({left.num_rows} vs {right.num_rows})")
-        for name in right.column_names:
-            col = right.column(name)
-            out_name = name if name not in left.column_names else name + "_1"
-            left = left.append_column(out_name, col)
-        return Dataset([ray_tpu.put(left)])
+        dataset.zip). Distributed: the right side is re-sliced to the
+        left side's block row-windows with per-window tasks — the driver
+        only moves refs and row counts, never rows."""
+        lrefs = self._execute_refs()
+        rrefs = other._execute_refs()
+        lcounts = ray_tpu.get([_block_num_rows.remote(r) for r in lrefs])
+        rcounts = ray_tpu.get([_block_num_rows.remote(r) for r in rrefs])
+        if sum(lcounts) != sum(rcounts):
+            raise ValueError(
+                f"zip requires equal row counts ({sum(lcounts)} vs {sum(rcounts)})"
+            )
+        out = []
+        ri, roff = 0, 0  # cursor into the right side
+        for lref, lc in zip(lrefs, lcounts):
+            parts, need = [], lc
+            while need > 0:
+                take = min(need, rcounts[ri] - roff)
+                parts.append(
+                    rrefs[ri]
+                    if take == rcounts[ri] and roff == 0
+                    else _slice_rows.remote(rrefs[ri], roff, roff + take)
+                )
+                roff += take
+                need -= take
+                if roff == rcounts[ri]:
+                    ri, roff = ri + 1, 0
+            out.append(_zip_blocks.remote(lref, *parts))
+        return Dataset(out)
 
     def iter_rows(self) -> Iterator[Dict]:
         for ref in self._execute_refs():

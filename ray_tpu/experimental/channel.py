@@ -1,0 +1,107 @@
+"""Mutable shared-memory channels — the compiled-DAG transport.
+
+Equivalent of the reference's experimental channels
+(reference: python/ray/experimental/channel.py _create_channel_ref — a
+reusable mutable plasma buffer that compiled DAGs write/read per
+execution instead of allocating a new object per call). Here a channel
+is its own tiny mmap file in /dev/shm with a seq-versioned header:
+writer stores payload then bumps seq; readers poll seq past their
+cursor and copy out. Single writer; readers are lockstep consumers (the
+compiled DAG executes one round at a time, so a payload is never
+overwritten while still unread).
+"""
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import time
+from typing import Optional
+
+_HDR = struct.Struct("<QQQ")  # magic, seq, payload_len
+_MAGIC = 0x52545043484E4C31  # "RTPCHNL1"
+
+
+class ChannelTimeoutError(TimeoutError):
+    pass
+
+
+class Channel:
+    """SPSC/SPMC byte channel over a /dev/shm mmap."""
+
+    def __init__(self, path: str, mm: mmap.mmap, capacity: int):
+        self.path = path
+        self._mm = mm
+        self.capacity = capacity
+        self._cursor = 0  # reader-side: last seq consumed
+
+    # -- lifecycle -------------------------------------------------------
+    @classmethod
+    def create(cls, name: str, capacity: int = 1 << 20) -> "Channel":
+        path = f"/dev/shm/ray_tpu_chan_{os.getpid()}_{name}"
+        fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_EXCL, 0o600)
+        try:
+            os.ftruncate(fd, _HDR.size + capacity)
+            mm = mmap.mmap(fd, _HDR.size + capacity)
+        finally:
+            os.close(fd)
+        _HDR.pack_into(mm, 0, _MAGIC, 0, 0)
+        return cls(path, mm, capacity)
+
+    @classmethod
+    def open(cls, path: str) -> "Channel":
+        fd = os.open(path, os.O_RDWR)
+        try:
+            size = os.fstat(fd).st_size
+            mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        magic, _, _ = _HDR.unpack_from(mm, 0)
+        if magic != _MAGIC:
+            mm.close()
+            raise ValueError(f"{path} is not a channel")
+        return cls(path, mm, size - _HDR.size)
+
+    def close(self):
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass
+
+    def unlink(self):
+        self.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    # -- data plane ------------------------------------------------------
+    @property
+    def seq(self) -> int:
+        _, seq, _ = _HDR.unpack_from(self._mm, 0)
+        return seq
+
+    def write(self, payload: bytes) -> int:
+        if len(payload) > self.capacity:
+            raise ValueError(f"payload {len(payload)} exceeds channel capacity {self.capacity}")
+        self._mm[_HDR.size : _HDR.size + len(payload)] = payload
+        # header (seq) is stored LAST: a reader that sees the new seq is
+        # guaranteed to see the payload bytes (x86 store ordering; the
+        # GIL orders the python-side stores)
+        _, seq, _ = _HDR.unpack_from(self._mm, 0)
+        _HDR.pack_into(self._mm, 0, _MAGIC, seq + 1, len(payload))
+        return seq + 1
+
+    def read(self, timeout: Optional[float] = 10.0) -> bytes:
+        """Block until a seq newer than this reader's cursor appears."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 20e-6
+        while True:
+            magic, seq, ln = _HDR.unpack_from(self._mm, 0)
+            if seq > self._cursor:
+                self._cursor = seq
+                return bytes(self._mm[_HDR.size : _HDR.size + ln])
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeoutError(f"channel {self.path} idle for {timeout}s")
+            time.sleep(delay)
+            delay = min(delay * 2, 2e-3)

@@ -1,0 +1,150 @@
+"""Compiled DAGs: repeated execution over channels, no per-call RPC.
+
+Equivalent of the reference's CompiledDAG
+(reference: python/ray/dag/compiled_dag_node.py:141
+experimental_compile — actors run a resident execution loop reading
+input channels and writing output channels, so a steady-state
+`dag.execute(x)` costs shared-memory writes instead of task
+submissions). This is the substrate the reference earmarks for
+pipeline parallelism; on TPU pods the channels carry host-side arrays
+between stage actors while the per-stage compute stays jitted.
+
+Supported topology: DAGs of ActorMethodNodes over a single InputNode
+(fan-out and fan-in allowed; one in-flight execution at a time — the
+lockstep contract that makes seq-versioned channels safe).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List
+
+from ray_tpu.dag import ActorMethodNode, DAGNode, InputNode
+from ray_tpu.experimental.channel import Channel
+
+STOP = b"__ray_tpu_dag_stop__"
+
+
+def _topo(node: DAGNode, order: List[DAGNode], seen: set):
+    if id(node) in seen:
+        return
+    seen.add(id(node))
+    args = getattr(node, "_args", ()) or ()
+    kwargs = getattr(node, "_kwargs", {}) or {}
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, DAGNode):
+            _topo(a, order, seen)
+    order.append(node)
+
+
+class CompiledDAG:
+    def __init__(self, dag: ActorMethodNode):
+        order: List[DAGNode] = []
+        _topo(dag, order, set())
+        self._input_nodes = [n for n in order if isinstance(n, InputNode)]
+        if len(self._input_nodes) != 1:
+            raise ValueError("compiled DAGs need exactly one InputNode")
+        for n in order:
+            if not isinstance(n, (ActorMethodNode, InputNode)):
+                raise TypeError(
+                    f"compiled DAGs support actor-method nodes only, got {type(n).__name__}"
+                )
+            if isinstance(n, ActorMethodNode) and n._kwargs:
+                raise ValueError("compiled DAGs support positional args only")
+
+        # one output channel per node; the input node's channel is the
+        # driver's write side
+        self._channels: Dict[int, Channel] = {}
+        for i, n in enumerate(order):
+            self._channels[id(n)] = Channel.create(f"dag{id(self) & 0xFFFF}_{i}")
+        self._out_chan = self._channels[id(dag)]
+        self._in_chan = self._channels[id(self._input_nodes[0])]
+
+        # start each actor's resident loop (the special worker-side method
+        # __ray_tpu_channel_loop__ — worker_proc.py intercepts it)
+        self._loop_refs = []
+        self._actors = []
+        for n in order:
+            if not isinstance(n, ActorMethodNode):
+                continue
+            in_paths = []
+            const_args = []
+            for a in n._args:
+                if isinstance(a, DAGNode):
+                    in_paths.append(self._channels[id(a)].path)
+                    const_args.append(None)
+                else:
+                    in_paths.append(None)
+                    const_args.append(a)
+            ref = n._handle._invoke(
+                "__ray_tpu_channel_loop__",
+                (n._method, in_paths, const_args, self._channels[id(n)].path),
+                {},
+                1,
+            )
+            self._loop_refs.append(ref)
+            self._actors.append(n._handle)
+
+    def execute(self, value: Any) -> Any:
+        self._in_chan.write(pickle.dumps(value))
+        out = self._out_chan.read(timeout=60.0)
+        if out.startswith(STOP):
+            raise RuntimeError("compiled DAG was torn down")
+        result = pickle.loads(out)
+        if isinstance(result, _WrappedError):
+            raise result.error
+        return result
+
+    def teardown(self):
+        import ray_tpu
+
+        try:
+            self._in_chan.write(STOP)
+            ray_tpu.get(self._loop_refs, timeout=10)
+        except Exception:
+            pass
+        for ch in self._channels.values():
+            ch.unlink()
+
+
+class _WrappedError:
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+def run_channel_loop(instance, method: str, in_paths, const_args, out_path):
+    """Worker-side resident loop (invoked via the intercepted
+    __ray_tpu_channel_loop__ method): read inputs → call → write output.
+    A STOP sentinel on any input propagates downstream and exits."""
+    chans = [Channel.open(p) if p else None for p in in_paths]
+    out = Channel.open(out_path)
+    fn = getattr(instance, method)
+    try:
+        while True:
+            args = list(const_args)
+            stop = False
+            for i, ch in enumerate(chans):
+                if ch is None:
+                    continue
+                data = ch.read(timeout=None)
+                if data.startswith(STOP):
+                    stop = True
+                    break
+                args[i] = pickle.loads(data)
+            if stop:
+                out.write(STOP)
+                return "stopped"
+            try:
+                result = fn(*args)
+                payload = pickle.dumps(result)
+            except Exception as e:
+                payload = pickle.dumps(_WrappedError(e))
+            out.write(payload)
+    finally:
+        for ch in chans:
+            if ch is not None:
+                ch.close()
+        out.close()
+
+
+def experimental_compile(dag: ActorMethodNode) -> CompiledDAG:
+    return CompiledDAG(dag)

@@ -256,9 +256,16 @@ class Tuner:
                     t.history, t.metrics = [], {}
                     relaunch.append(t)
             # fast-forward the (seeded) searcher so continued sampling
-            # doesn't repeat the configs already emitted
+            # doesn't repeat the configs already emitted; trials that
+            # already finished must also COMPLETE in the searcher, or a
+            # fresh ConcurrencyLimiter's slots / Repeater's groups fill
+            # with ghosts and the restored run stalls on PENDING
             for i in range(counter):
-                searcher.suggest(f"trial_{i:05d}")
+                tid = f"trial_{i:05d}"
+                searcher.suggest(tid)
+                t = trials.get(tid)
+                if t is not None and t.status in ("TERMINATED", "STOPPED", "ERROR"):
+                    searcher.on_trial_complete(tid, t.metrics)
 
         generations: Dict[str, int] = {}
 

@@ -1,0 +1,17 @@
+"""ray_tpu.workflow — durable DAG execution.
+
+Equivalent of the reference's workflow library
+(reference: python/ray/workflow/api.py run/resume/get_output,
+workflow_storage.py — every task output is checkpointed to storage, so
+a crashed driver resumes from the last completed task instead of
+re-running the whole graph).
+"""
+from ray_tpu.workflow.api import (  # noqa: F401
+    delete,
+    get_metadata,
+    get_output,
+    get_status,
+    list_all,
+    resume,
+    run,
+)

@@ -1,0 +1,150 @@
+"""Compiled DAGs over channels + durable workflows.
+
+Models the reference's coverage for ray.dag experimental compilation
+(reference: python/ray/dag/tests/experimental/test_accelerated_dag.py)
+and workflow basics (reference: python/ray/workflow/tests/test_basic_workflows.py).
+"""
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+def test_channel_roundtrip():
+    from ray_tpu.experimental.channel import Channel, ChannelTimeoutError
+
+    ch = Channel.create("t0", capacity=1024)
+    try:
+        reader = Channel.open(ch.path)
+        ch.write(b"hello")
+        assert reader.read(timeout=1) == b"hello"
+        ch.write(b"world")
+        assert reader.read(timeout=1) == b"world"
+        with pytest.raises(ChannelTimeoutError):
+            reader.read(timeout=0.05)
+        # second reader has its own cursor and sees the latest payload
+        reader2 = Channel.open(ch.path)
+        assert reader2.read(timeout=1) == b"world"
+        reader.close()
+        reader2.close()
+    finally:
+        ch.unlink()
+
+
+def test_compiled_dag_diamond(ray_start_regular):
+    from ray_tpu.experimental.compiled_dag import experimental_compile
+
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, k):
+            self.k = k
+
+        def mul(self, x):
+            return x * self.k
+
+        def add(self, a, b):
+            return a + b
+
+    s1, s2, s3 = Stage.remote(2), Stage.remote(3), Stage.remote(1)
+    inp = InputNode()
+    dag = s3.add.bind(s1.mul.bind(inp), s2.mul.bind(inp))
+    c = experimental_compile(dag)
+    try:
+        assert c.execute(5) == 25  # 2*5 + 3*5
+        assert c.execute(7) == 35
+        for i in range(50):
+            assert c.execute(i) == 5 * i
+    finally:
+        c.teardown()
+    # actors serve normal calls again after teardown
+    assert ray_tpu.get(s1.mul.remote(4), timeout=30) == 8
+
+
+def test_compiled_dag_error_propagates(ray_start_regular):
+    from ray_tpu.experimental.compiled_dag import experimental_compile
+
+    @ray_tpu.remote
+    class Div:
+        def div(self, x):
+            return 10 / x
+
+    d = Div.remote()
+    inp = InputNode()
+    c = experimental_compile(d.div.bind(inp))
+    try:
+        assert c.execute(2) == 5.0
+        with pytest.raises(ZeroDivisionError):
+            c.execute(0)
+        assert c.execute(5) == 2.0  # loop survives the error
+    finally:
+        c.teardown()
+
+
+def test_workflow_run_resume(ray_start_regular, tmp_path):
+    from ray_tpu import workflow
+
+    calls = str(tmp_path / "calls")
+
+    @ray_tpu.remote
+    def step(tag):
+        with open(calls, "a") as f:
+            f.write(tag + "\n")
+        return tag
+
+    @ray_tpu.remote
+    def combine(a, b):
+        with open(calls, "a") as f:
+            f.write("combine\n")
+        return f"{a}+{b}"
+
+    store = str(tmp_path / "wf")
+    dag = combine.bind(step.bind("a"), step.bind("b"))
+    assert workflow.run(dag, workflow_id="w1", storage=store) == "a+b"
+    assert workflow.get_status("w1", storage=store) == "SUCCESSFUL"
+    n = sum(1 for _ in open(calls))
+
+    # full resume: pure checkpoint reads, no task re-runs
+    assert workflow.resume("w1", storage=store) == "a+b"
+    assert sum(1 for _ in open(calls)) == n
+
+    # partial resume: drop the terminal checkpoint; only it re-runs
+    os.unlink(os.path.join(store, "w1", "output.pkl"))
+    victim = [f for f in os.listdir(os.path.join(store, "w1", "tasks")) if f.startswith("combine")][0]
+    os.unlink(os.path.join(store, "w1", "tasks", victim))
+    assert workflow.resume("w1", storage=store) == "a+b"
+    lines = [l.strip() for l in open(calls)]
+    assert lines.count("combine") == 2 and lines.count("a") == 1
+
+    assert ("w1", "SUCCESSFUL") in workflow.list_all(storage=store)
+    meta = workflow.get_metadata("w1", storage=store)
+    assert meta["tasks_checkpointed"] == 3
+    workflow.delete("w1", storage=store)
+    assert workflow.get_status("w1", storage=store) == "NOT_FOUND"
+
+
+def test_workflow_failure_then_resume(ray_start_regular, tmp_path):
+    from ray_tpu import workflow
+
+    marker = str(tmp_path / "fail_once")
+
+    @ray_tpu.remote
+    def base():
+        return 10
+
+    @ray_tpu.remote
+    def flaky(x):
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            raise RuntimeError("transient")
+        return x * 2
+
+    store = str(tmp_path / "wf")
+    dag = flaky.bind(base.bind())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w2", storage=store)
+    assert workflow.get_status("w2", storage=store) == "FAILED"
+    # resume skips `base` (checkpointed) and re-runs only `flaky`
+    assert workflow.resume("w2", storage=store) == 20
+    assert workflow.get_status("w2", storage=store) == "SUCCESSFUL"

@@ -233,3 +233,13 @@ def test_streaming_split_equal_rows(ray_start_regular):
     splits = ds.streaming_split(4, equal=True)
     counts = [s.count() for s in splits]
     assert counts == [25, 25, 25, 25], counts
+
+
+def test_zip_misaligned_blocks(ray_start_regular):
+    """zip realigns differing block boundaries without a driver merge."""
+    a = ray_tpu.data.from_items([{"x": i} for i in range(30)], parallelism=3)
+    b = ray_tpu.data.from_items([{"y": i * 2} for i in range(30)], parallelism=7)
+    z = a.zip(b)
+    assert z.num_blocks() == 3  # left side's block structure preserved
+    rows = z.take_all()
+    assert all(r["y"] == 2 * r["x"] for r in rows) and len(rows) == 30
