@@ -447,6 +447,18 @@ class GcsServer:
         self._sched_wakeup.set()
         return True
 
+    async def _rpc_node_sync(self, d, conn):
+        """Push-based resource/load view from a raylet the moment its
+        state changes (reference: ray_syncer gossip replacing polling —
+        src/ray/common/ray_syncer/ray_syncer.h). Heartbeats stay as the
+        liveness channel; this keeps `load` fresh for the autoscaler and
+        state API between them."""
+        node = self.nodes.get(d["node_id"])
+        if node:
+            node["load"] = d.get("load", {})
+            node["load_ts"] = time.time()
+        return True
+
     async def _rpc_heartbeat(self, d, conn):
         node = self.nodes.get(d["node_id"])
         if node:
@@ -1134,6 +1146,34 @@ class GcsServer:
                         break
                 if ok:
                     assignment = [node_id] * len(bundles)
+                    break
+            if not assignment:
+                return False
+        elif strategy == "SLICE_PACK":
+            # ICI-topology-aware gang placement: every bundle lands on a
+            # host of ONE TPU slice, bundle index == slice worker id, so
+            # ranks map onto ICI neighbors and the jax mesh initializes
+            # over the slice fabric, never DCN (generalizes the
+            # reference's TPU-<pod>-head resource trick,
+            # _private/accelerators/tpu.py:335-398, into a first-class
+            # strategy; reference bundle policies:
+            # raylet/scheduling/policy/bundle_scheduling_policy.cc).
+            by_slice: Dict[str, list] = {}
+            for n in alive:
+                sname = (n.get("labels") or {}).get("tpu_slice")
+                if sname:
+                    by_slice.setdefault(sname, []).append(n)
+            for sname in sorted(by_slice):
+                hosts = sorted(
+                    by_slice[sname],
+                    key=lambda n: int(n["labels"].get("tpu_worker_id", 0)),
+                )
+                if len(hosts) < len(bundles):
+                    continue
+                if all(fits(hosts[i]["node_id"], b) for i, b in enumerate(bundles)):
+                    assignment = [hosts[i]["node_id"] for i in range(len(bundles))]
+                    for nid, b in zip(assignment, bundles):
+                        take(nid, b)
                     break
             if not assignment:
                 return False

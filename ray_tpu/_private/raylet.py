@@ -149,6 +149,8 @@ class Raylet:
         asyncio.get_running_loop().create_task(self._spill_loop())
         if RayConfig.memory_monitor_refresh_ms > 0:
             asyncio.get_running_loop().create_task(self._memory_monitor_loop())
+        self._sync_event = asyncio.Event()
+        asyncio.get_running_loop().create_task(self._resource_sync_loop())
         for _ in range(min(RayConfig.worker_pool_prestart, self.max_workers)):
             self._start_worker()
         logger.info("raylet %s node=%s up, %d prestarted", self.name, self.node_id, RayConfig.worker_pool_prestart)
@@ -223,6 +225,37 @@ class Raylet:
         )
         return True
 
+    def _mark_sync(self):
+        ev = getattr(self, "_sync_event", None)
+        if ev is not None:
+            ev.set()
+
+    async def _resource_sync_loop(self):
+        """Push-based load sync: the moment local state changes (worker
+        started/died, queue moved), the new view is pushed to the GCS —
+        heartbeats remain only as liveness (reference: ray_syncer bidi
+        resource gossip, src/ray/common/ray_syncer/ray_syncer.h,
+        replacing polling). Debounced 50ms so a worker-start storm is one
+        message."""
+        last = None
+        while True:
+            await self._sync_event.wait()
+            self._sync_event.clear()
+            await asyncio.sleep(0.05)  # coalesce a burst into one push
+            snap = {
+                "num_workers": len(self.workers),
+                "idle": len(self.idle),
+                "queued": len(self.queued),
+                "store": self.store.usage(),
+            }
+            if snap == last:
+                continue
+            last = snap
+            try:
+                await self._gcs.push("node.sync", {"node_id": self.node_id, "load": snap})
+            except Exception:
+                pass  # heartbeat reconnect logic owns GCS failures
+
     async def _memory_monitor_loop(self):
         """Kill a policy-chosen worker when node memory crosses the
         threshold (reference: MemoryMonitor → worker_killing_policy in the
@@ -282,10 +315,9 @@ class Raylet:
         while True:
             await asyncio.sleep(RayConfig.health_check_period_s / 2)
             try:
-                await self._gcs.request(
-                    "heartbeat",
-                    {"node_id": self.node_id, "load": {"num_workers": len(self.workers), "queued": len(self.queued)}},
-                )
+                # liveness only — the load view travels on node.sync
+                # pushes, which heartbeat payloads must not clobber
+                await self._gcs.request("heartbeat", {"node_id": self.node_id})
             except protocol.ConnectionLost:
                 # a restarted GCS listens on the same session socket: keep
                 # trying to rejoin instead of dying (reference:
@@ -347,6 +379,7 @@ class Raylet:
         h = WorkerHandle(worker_id, proc)
         self.workers[worker_id] = h
         self.starting += 1
+        self._mark_sync()
 
     async def _reap_loop(self):
         """Supervise worker processes (reference: worker_pool.cc exit
@@ -358,6 +391,7 @@ class Raylet:
                 if code is None:
                     continue
                 self.workers.pop(worker_id, None)
+                self._mark_sync()
                 if not h.registered.is_set():
                     # died before registering — undo the startup slot
                     self.starting = max(0, self.starting - 1)
@@ -451,12 +485,14 @@ class Raylet:
             h.idle_since = time.time()
             self.idle.append(h.worker_id)
         self._pump()
+        self._mark_sync()  # queue drained / worker freed: refresh the view
 
     # ----------------------------------------------------------- GCS handlers
     async def _handle_gcs(self, method: str, data, conn):
         if method == "raylet.dispatch":
             self.queued.append(data["spec"])
             self._pump()
+            self._mark_sync()
             return True
         if method == "raylet.kill_worker":
             h = self.workers.get(data["worker_id"])

@@ -347,6 +347,9 @@ class Dataset:
         out = []
         ri, roff = 0, 0  # cursor into the right side
         for lref, lc in zip(lrefs, lcounts):
+            if lc == 0:
+                out.append(lref)  # empty block: nothing to align
+                continue
             parts, need = [], lc
             while need > 0:
                 take = min(need, rcounts[ri] - roff)

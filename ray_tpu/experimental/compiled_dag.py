@@ -22,6 +22,7 @@ from ray_tpu.dag import ActorMethodNode, DAGNode, InputNode
 from ray_tpu.experimental.channel import Channel
 
 STOP = b"__ray_tpu_dag_stop__"
+_dag_counter = 0
 
 
 def _topo(node: DAGNode, order: List[DAGNode], seen: set):
@@ -52,10 +53,14 @@ class CompiledDAG:
                 raise ValueError("compiled DAGs support positional args only")
 
         # one output channel per node; the input node's channel is the
-        # driver's write side
+        # driver's write side. Names use a process-monotonic counter —
+        # id(self) would collide when CPython reuses a torn-down DAG's
+        # address
+        global _dag_counter
+        _dag_counter += 1
         self._channels: Dict[int, Channel] = {}
         for i, n in enumerate(order):
-            self._channels[id(n)] = Channel.create(f"dag{id(self) & 0xFFFF}_{i}")
+            self._channels[id(n)] = Channel.create(f"dag{_dag_counter}_{i}")
         self._out_chan = self._channels[id(dag)]
         self._in_chan = self._channels[id(self._input_nodes[0])]
 
@@ -122,6 +127,7 @@ def run_channel_loop(instance, method: str, in_paths, const_args, out_path):
         while True:
             args = list(const_args)
             stop = False
+            upstream_err = None
             for i, ch in enumerate(chans):
                 if ch is None:
                     continue
@@ -129,10 +135,18 @@ def run_channel_loop(instance, method: str, in_paths, const_args, out_path):
                 if data.startswith(STOP):
                     stop = True
                     break
-                args[i] = pickle.loads(data)
+                value = pickle.loads(data)
+                if isinstance(value, _WrappedError):
+                    # forward the ORIGINAL upstream error instead of
+                    # computing on the wrapper and masking it
+                    upstream_err = upstream_err or value
+                args[i] = value
             if stop:
                 out.write(STOP)
                 return "stopped"
+            if upstream_err is not None:
+                out.write(pickle.dumps(upstream_err))
+                continue
             try:
                 result = fn(*args)
                 payload = pickle.dumps(result)

@@ -68,7 +68,7 @@ def placement_group(
 ) -> PlacementGroup:
     from ray_tpu._private.worker import get_global_core
 
-    if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+    if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD", "SLICE_PACK"):
         raise ValueError(f"bad strategy {strategy}")
     for b in bundles:
         if not b or any(v < 0 for v in b.values()):
@@ -108,3 +108,19 @@ def tpu_slice_bundles(topology: str, chips_per_host: int = 4) -> List[Dict[str, 
     hosts = max(1, chips // chips_per_host)
     per_host = chips // hosts
     return [{"TPU": float(per_host), "CPU": 1.0} for _ in range(hosts)]
+
+
+def tpu_slice_placement_group(topology: str, chips_per_host: int = 4,
+                              name: str = "", lifetime=None) -> "PlacementGroup":
+    """Gang-reserve one whole TPU slice with ICI-aware placement: one
+    bundle per slice host via the SLICE_PACK strategy — bundle i lands
+    on the host whose `tpu_worker_id` label is i, so SPMD rank order
+    follows the slice's ICI fabric (the first-class version of the
+    reference's pod-slice head-resource gang trick,
+    _private/accelerators/tpu.py:335-398)."""
+    return placement_group(
+        tpu_slice_bundles(topology, chips_per_host),
+        strategy="SLICE_PACK",
+        name=name,
+        lifetime=lifetime,
+    )

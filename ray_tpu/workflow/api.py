@@ -67,43 +67,71 @@ def _assign_ids(node: DAGNode, ids: Dict[int, str], counter: List[int]):
     counter[0] += 1
 
 
-def _execute_memo(node: DAGNode, ids: Dict[int, str], wf_dir: str, memo: Dict[int, Any]):
-    """Resolve one node: checkpoint hit → stored value; else run the task,
-    wait for its value, checkpoint, return it."""
+def _ckpt_path(wf_dir: str, task_id: str) -> str:
+    return os.path.join(wf_dir, "tasks", task_id.replace("/", "_") + ".pkl")
+
+
+def _submit_memo(node: DAGNode, ids: Dict[int, str], wf_dir: str,
+                 memo: Dict[int, Any], collect: List[DAGNode]):
+    """Phase 1 — submit bottom-up WITHOUT waiting: independent branches
+    run in parallel (function tasks take upstream ObjectRefs as args and
+    the worker resolves them). Returns ("val", v) for checkpoint hits /
+    inputs, ("ref", ref) for submitted tasks."""
     if id(node) in memo:
         return memo[id(node)]
     if isinstance(node, InputNode):
-        memo[id(node)] = node._value
-        return node._value
-    task_id = ids[id(node)]
-    ckpt = os.path.join(wf_dir, "tasks", task_id.replace("/", "_") + ".pkl")
+        memo[id(node)] = ("val", node._value)
+        return memo[id(node)]
+    ckpt = _ckpt_path(wf_dir, ids[id(node)])
     if os.path.exists(ckpt):
         with open(ckpt, "rb") as f:
-            value = cloudpickle.load(f)
-        memo[id(node)] = value
-        return value
+            memo[id(node)] = ("val", cloudpickle.load(f))
+        return memo[id(node)]
 
-    args = [
-        _execute_memo(a, ids, wf_dir, memo) if isinstance(a, DAGNode) else a
+    deps_args = [
+        _submit_memo(a, ids, wf_dir, memo, collect) if isinstance(a, DAGNode) else ("val", a)
         for a in node._args
     ]
-    kwargs = {
-        k: (_execute_memo(v, ids, wf_dir, memo) if isinstance(v, DAGNode) else v)
+    deps_kwargs = {
+        k: (_submit_memo(v, ids, wf_dir, memo, collect) if isinstance(v, DAGNode) else ("val", v))
         for k, v in node._kwargs.items()
     }
     if isinstance(node, FunctionNode):
+        # refs pass through: the executing worker resolves them
+        args = [v for _, v in deps_args]
+        kwargs = {k: v for k, (_, v) in deps_kwargs.items()}
         ref = node._remote_fn.remote(*args, **kwargs)
-        value = ray_tpu.get(ref)
     elif isinstance(node, ActorMethodNode):
-        value = ray_tpu.get(node._handle._invoke(node._method, args, kwargs, 1))
+        # actor calls get concrete values (preserves per-actor ordering
+        # semantics and sidesteps ref-forwarding through actor channels)
+        args = [ray_tpu.get(v) if kind == "ref" else v for kind, v in deps_args]
+        kwargs = {k: (ray_tpu.get(v) if kind == "ref" else v) for k, (kind, v) in deps_kwargs.items()}
+        ref = node._handle._invoke(node._method, args, kwargs, 1)
     else:
         raise TypeError(f"cannot execute workflow node {type(node).__name__}")
-    tmp = ckpt + ".tmp"
-    with open(tmp, "wb") as f:
-        cloudpickle.dump(value, f)
-    os.replace(tmp, ckpt)
-    memo[id(node)] = value
-    return value
+    memo[id(node)] = ("ref", ref)
+    collect.append(node)  # post-order: deps checkpoint before dependents
+    return memo[id(node)]
+
+
+def _execute_memo(node: DAGNode, ids: Dict[int, str], wf_dir: str, memo: Dict[int, Any]):
+    """Submit the whole graph, then collect + checkpoint in dependency
+    order; a mid-graph failure leaves every already-finished dependency
+    checkpointed for resume."""
+    collect: List[DAGNode] = []
+    _submit_memo(node, ids, wf_dir, memo, collect)
+    for n in collect:
+        kind, v = memo[id(n)]
+        if kind != "ref":
+            continue
+        value = ray_tpu.get(v)
+        ckpt = _ckpt_path(wf_dir, ids[id(n)])
+        tmp = ckpt + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)
+        os.replace(tmp, ckpt)
+        memo[id(n)] = ("val", value)
+    return memo[id(node)][1]
 
 
 def run(dag: DAGNode, *, workflow_id: Optional[str] = None,

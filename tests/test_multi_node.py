@@ -163,3 +163,65 @@ def test_node_death_task_retry(cluster):
     assert os.path.exists(marker), "task never started"
     cluster.remove_node(victim)
     assert ray_tpu.get(ref, timeout=120) == "retried"
+
+
+def test_slice_pack_topology_placement():
+    """SLICE_PACK places one bundle per host of ONE slice, ordered by
+    tpu_worker_id — rank i lands on slice worker i (ICI adjacency).
+    Runs in a subprocess: it boots its own cluster, which must not
+    clash with the module fixture's driver connection."""
+    import subprocess
+    import sys as _sys
+
+    code = """
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.placement_group import (
+    placement_group_table, remove_placement_group, tpu_slice_placement_group,
+)
+c2 = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+n_a0 = c2.add_node(num_cpus=1, resources={"TPU": 4.0},
+                   labels={"tpu_slice": "slice-a", "tpu_worker_id": "0"})
+n_b1 = c2.add_node(num_cpus=1, resources={"TPU": 4.0},
+                   labels={"tpu_slice": "slice-b", "tpu_worker_id": "1"})
+n_b0 = c2.add_node(num_cpus=1, resources={"TPU": 4.0},
+                   labels={"tpu_slice": "slice-b", "tpu_worker_id": "0"})
+c2.connect()
+c2.wait_for_nodes()
+pg = tpu_slice_placement_group("2x2x2", chips_per_host=4)  # 8 chips, 2 hosts
+assert pg.wait(30)
+table = {t["pg_id"]: t for t in placement_group_table()}
+nodes = table[pg.id]["bundle_nodes"]
+assert nodes == [n_b0.node_id, n_b1.node_id], nodes
+remove_placement_group(pg)
+c2.shutdown()
+print("SLICE_PACK OK")
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [_sys.executable, "-c", code], capture_output=True, text=True, timeout=240,
+        env={**os.environ, "RAY_TPU_WORKER_POOL_PRESTART": "1",
+             "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")},
+    )
+    assert "SLICE_PACK OK" in r.stdout, r.stdout + "\n" + r.stderr
+
+
+def test_push_based_load_sync(cluster):
+    """Raylet state changes push load views to the GCS within ~100ms —
+    no waiting for the next heartbeat (reference: ray_syncer gossip)."""
+
+    @ray_tpu.remote
+    def burn():
+        time.sleep(0.1)
+        return 1
+
+    ray_tpu.get([burn.remote() for _ in range(4)])
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        synced = [n for n in ray_tpu.nodes() if n.get("load", {}).get("store")]
+        if synced:
+            break
+        time.sleep(0.2)
+    assert synced, "no node ever pushed a load view"
+    load = synced[0]["load"]
+    assert "num_workers" in load and "store" in load
