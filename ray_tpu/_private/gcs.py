@@ -1163,11 +1163,16 @@ class GcsServer:
                 sname = (n.get("labels") or {}).get("tpu_slice")
                 if sname:
                     by_slice.setdefault(sname, []).append(n)
+            def worker_rank(n):
+                # malformed labels sort last instead of raising: a bad
+                # label on one node must never kill the scheduler loop
+                try:
+                    return int(n["labels"].get("tpu_worker_id", 0))
+                except (TypeError, ValueError):
+                    return 1 << 30
+
             for sname in sorted(by_slice):
-                hosts = sorted(
-                    by_slice[sname],
-                    key=lambda n: int(n["labels"].get("tpu_worker_id", 0)),
-                )
+                hosts = sorted(by_slice[sname], key=worker_rank)
                 if len(hosts) < len(bundles):
                     continue
                 if all(fits(hosts[i]["node_id"], b) for i, b in enumerate(bundles)):

@@ -237,7 +237,7 @@ class Raylet:
         resource gossip, src/ray/common/ray_syncer/ray_syncer.h,
         replacing polling). Debounced 50ms so a worker-start storm is one
         message."""
-        last = None
+        self._sync_last = None
         while True:
             await self._sync_event.wait()
             self._sync_event.clear()
@@ -248,9 +248,9 @@ class Raylet:
                 "queued": len(self.queued),
                 "store": self.store.usage(),
             }
-            if snap == last:
+            if snap == self._sync_last:
                 continue
-            last = snap
+            self._sync_last = snap
             try:
                 await self._gcs.push("node.sync", {"node_id": self.node_id, "load": snap})
             except Exception:
@@ -329,6 +329,10 @@ class Raylet:
                     try:
                         await self._connect_and_register()
                         logger.info("rejoined GCS as node %s", self.node_id)
+                        # the restarted GCS has a fresh node record: force
+                        # a load push even if our snapshot is unchanged
+                        self._sync_last = None
+                        self._mark_sync()
                         break
                     except (protocol.ConnectionLost, OSError, ConnectionError):
                         await asyncio.sleep(1.0)

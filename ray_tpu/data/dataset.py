@@ -348,7 +348,13 @@ class Dataset:
         ri, roff = 0, 0  # cursor into the right side
         for lref, lc in zip(lrefs, lcounts):
             if lc == 0:
-                out.append(lref)  # empty block: nothing to align
+                # keep the UNIFIED schema even at zero rows (schema()
+                # reads block 0): zip with an empty right slice
+                if rrefs:
+                    src = rrefs[min(ri, len(rrefs) - 1)]
+                    out.append(_zip_blocks.remote(lref, _slice_rows.remote(src, 0, 0)))
+                else:
+                    out.append(lref)
                 continue
             parts, need = [], lc
             while need > 0:

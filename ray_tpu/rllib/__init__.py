@@ -13,6 +13,7 @@ from ray_tpu.rllib.algorithms.bc import BC, BCConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup  # noqa: F401
 from ray_tpu.rllib.core.rl_module import RLModule, DiscreteMLPModule  # noqa: F401
 from ray_tpu.rllib.env import EnvRunner, SingleAgentEnvRunner  # noqa: F401

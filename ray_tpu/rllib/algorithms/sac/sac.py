@@ -1,0 +1,193 @@
+"""SAC — soft actor-critic for continuous control.
+
+Equivalent of the reference's SAC
+(reference: rllib/algorithms/sac/sac.py — twin soft Q critics with
+polyak-averaged targets, a tanh-squashed Gaussian actor, and learned
+entropy temperature alpha). Jax-native: actor, both critics, alpha and
+the polyak update compile into ONE jitted TD step; the target nets are
+a second pytree argument.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
+from ray_tpu.rllib.core.learner.learner import Learner
+from ray_tpu.rllib.core.rl_module import ContinuousMLPModule
+from ray_tpu.rllib.env.off_policy_env_runner import OffPolicyEnvRunner
+
+
+class ContinuousOffPolicyEnvRunner(OffPolicyEnvRunner):
+    """Transition collector for Box action spaces: actions come from the
+    squashed-Gaussian policy itself (SAC needs no epsilon schedule —
+    exploration is the entropy term). Shares the autoreset-masking
+    sample loop with the discrete runner; only action selection differs.
+    Stored actions are the pre-scaling [-1, 1] squashed values the
+    learner's critics expect; the env sees them rescaled to its bounds."""
+
+    def __init__(self, config, worker_index: int = 0):
+        super().__init__(config, worker_index)
+        self._sample_fn = self._jax.jit(self.module.sample_action)
+
+    def _on_fragment_start(self) -> None:
+        self._warmup = self._global_step < self.config.num_steps_sampled_before_learning_starts
+
+    def _select_actions(self, obs):
+        self._rng, key = self._jax.random.split(self._rng)
+        if self._warmup:  # uniform random until learning starts
+            action = np.asarray(
+                self._jax.random.uniform(
+                    key, (self.num_envs, self.module.act_dim), minval=-1.0, maxval=1.0
+                ),
+                np.float32,
+            )
+        else:
+            action, _ = self._sample_fn(self.params, obs.astype(np.float32), key)
+            action = np.asarray(action, np.float32)
+        low, high = self.module.action_low, self.module.action_high
+        return action, low + (action + 1.0) * 0.5 * (high - low)
+
+    def _extra_metrics(self) -> Dict[str, Any]:
+        return {}
+
+
+class SACLearner(Learner):
+    """Twin-critic soft TD + reparameterized actor + temperature, in one
+    jitted step (reference: sac_torch_learner.py split across three
+    optimizers; one optax chain per component here)."""
+
+    def __init__(self, config, obs_space=None, action_space=None, mesh=None):
+        super().__init__(config, obs_space, action_space, mesh)
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.target_params = jax.tree.map(jnp.asarray, self.params)
+        self.log_alpha = jnp.asarray(float(np.log(config.initial_alpha)))
+        self._alpha_opt = optax.adam(config.lr)
+        self._alpha_opt_state = self._alpha_opt.init(self.log_alpha)
+        self._updates = 0
+        self.td_errors = None
+        module, cfg = self.module, config
+        target_entropy = -float(module.act_dim)
+
+        def _step(params, target_params, opt_state, log_alpha, alpha_opt_state, batch, rng):
+            alpha = jnp.exp(log_alpha)
+            k1, k2 = jax.random.split(rng)
+
+            # critic loss: soft Bellman target from the target critics
+            next_a, next_logp = module.sample_action(params, batch["next_obs"], k1)
+            tq1, tq2 = module.q_values(target_params, batch["next_obs"], next_a)
+            soft_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+            target = batch["rewards"] + cfg.gamma * (1.0 - batch["terminateds"].astype(jnp.float32)) * soft_v
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(p):
+                q1, q2 = module.q_values(p, batch["obs"], batch["actions"])
+                return 0.5 * jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2), (q1 - target)
+
+            def actor_loss(p):
+                a, logp = module.sample_action(p, batch["obs"], k2)
+                q1, q2 = module.q_values(jax.lax.stop_gradient(p), batch["obs"], a)
+                return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+            (closs, td), cgrads = jax.value_and_grad(critic_loss, has_aux=True)(params)
+            (aloss, logp), agrads = jax.value_and_grad(actor_loss, has_aux=True)(params)
+            # critics learn from the critic loss, the actor from the actor
+            # loss: mask each gradient tree to its component
+            grads = {
+                "pi": agrads["pi"],
+                "q1": cgrads["q1"],
+                "q2": cgrads["q2"],
+            }
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            def alpha_loss(la):
+                return -jnp.mean(jnp.exp(la) * jax.lax.stop_gradient(logp + target_entropy))
+
+            aguard, agrad = jax.value_and_grad(alpha_loss)(log_alpha)
+            aupd, alpha_opt_state = self._alpha_opt.update(agrad, alpha_opt_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, aupd)
+
+            # polyak target update rides in the same compiled step
+            target_params = jax.tree.map(
+                lambda t, p: (1.0 - cfg.tau) * t + cfg.tau * p, target_params, params
+            )
+            stats = {
+                "critic_loss": closs,
+                "actor_loss": aloss,
+                "alpha": alpha,
+                "mean_q_target": jnp.mean(target),
+                "entropy": -jnp.mean(logp),
+            }
+            return params, target_params, opt_state, log_alpha, alpha_opt_state, stats, td
+
+        self._sac_step = jax.jit(_step)
+        self._rng = jax.random.PRNGKey(config.seed + 31)
+
+    def update_once(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+
+        self._rng, key = jax.random.split(self._rng)
+        (
+            self.params,
+            self.target_params,
+            self.opt_state,
+            self.log_alpha,
+            self._alpha_opt_state,
+            stats,
+            td,
+        ) = self._sac_step(
+            self.params, self.target_params, self.opt_state,
+            self.log_alpha, self._alpha_opt_state, batch, key,
+        )
+        self.td_errors = np.asarray(td)
+        self._updates += 1
+        return {k: float(np.asarray(v)) for k, v in stats.items()}
+
+    def get_state(self):
+        state = super().get_state()
+        state["target_params"] = self._jax.tree.map(np.asarray, self.target_params)
+        state["log_alpha"] = float(np.asarray(self.log_alpha))
+        return state
+
+    def set_state(self, state) -> None:
+        import jax.numpy as jnp
+
+        super().set_state(state)
+        self.target_params = self._jax.tree.map(np.asarray, state["target_params"])
+        self.log_alpha = jnp.asarray(state["log_alpha"])
+
+
+class SACConfig(DQNConfig):
+    learner_class = SACLearner
+
+    def __init__(self):
+        super().__init__()
+        self.env_runner_cls = ContinuousOffPolicyEnvRunner
+        self.module_class = ContinuousMLPModule
+        self.model_config = {"hidden": (256, 256)}
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.initial_alpha = 1.0
+        self.train_batch_size = 256
+        self.training_intensity = 1.0
+        self.num_steps_sampled_before_learning_starts = 1500
+        self.rollout_fragment_length = 8
+        self.num_envs_per_env_runner = 4
+        self.prioritized_replay = False
+        self.grad_clip = None
+
+
+class SAC(DQN):
+    """training_step is DQN's (sample → replay → update_once at
+    intensity); only the learner and runner differ."""
+
+    config_class = SACConfig
+
+
+SACConfig.algo_class = SAC

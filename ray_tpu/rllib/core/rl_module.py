@@ -25,6 +25,89 @@ class RLModule:
         raise NotImplementedError
 
 
+class ContinuousMLPModule(RLModule):
+    """MLP torso with a tanh-squashed Gaussian policy and twin Q heads —
+    the SAC-family module for Box action spaces (reference analogue:
+    rllib/algorithms/sac/sac_catalog default continuous nets).
+
+    forward() returns {"mean", "log_std", "vf"}; q_value(params, obs, a)
+    evaluates both critics. Actions are in [-1, 1] pre-scaling; the
+    runner rescales to the env's bounds.
+    """
+
+    def __init__(self, obs_space, action_space, model_config=None):
+        import numpy as np
+
+        if not hasattr(action_space, "high"):
+            raise ValueError(f"ContinuousMLPModule requires a Box action space, got {action_space}")
+        model_config = model_config or {}
+        self.obs_dim = int(np.prod(obs_space.shape))
+        self.act_dim = int(np.prod(action_space.shape))
+        self.hidden = tuple(model_config.get("hidden", (256, 256)))
+        self.action_low = np.asarray(action_space.low, np.float32)
+        self.action_high = np.asarray(action_space.high, np.float32)
+
+    def _mlp_init(self, key, sizes, out_dim, out_scale=0.01):
+        keys = jax.random.split(key, len(sizes))
+        layers = []
+        for i in range(len(sizes) - 1):
+            layers.append({
+                "w": jax.random.normal(keys[i], (sizes[i], sizes[i + 1])) * (2.0 / sizes[i]) ** 0.5,
+                "b": jnp.zeros((sizes[i + 1],)),
+            })
+        layers.append({
+            "w": jax.random.normal(keys[-1], (sizes[-1], out_dim)) * out_scale,
+            "b": jnp.zeros((out_dim,)),
+        })
+        return layers
+
+    @staticmethod
+    def _mlp_apply(layers, x):
+        for layer in layers[:-1]:
+            x = jnp.maximum(x @ layer["w"] + layer["b"], 0.0)
+        return x @ layers[-1]["w"] + layers[-1]["b"]
+
+    def init_params(self, rng):
+        sizes = (self.obs_dim,) + self.hidden
+        k_pi, k_q1, k_q2 = jax.random.split(rng, 3)
+        q_sizes = (self.obs_dim + self.act_dim,) + self.hidden
+        return {
+            "pi": self._mlp_init(k_pi, sizes, 2 * self.act_dim),
+            "q1": self._mlp_init(k_q1, q_sizes, 1, out_scale=1.0),
+            "q2": self._mlp_init(k_q2, q_sizes, 1, out_scale=1.0),
+        }
+
+    def forward(self, params, obs):
+        out = self._mlp_apply(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, -10.0, 2.0)
+        return {"mean": mean, "log_std": log_std, "vf": jnp.zeros(obs.shape[:-1])}
+
+    def q_values(self, params, obs, action):
+        x = jnp.concatenate([obs, action], axis=-1)
+        return (
+            self._mlp_apply(params["q1"], x)[..., 0],
+            self._mlp_apply(params["q2"], x)[..., 0],
+        )
+
+    def sample_action(self, params, obs, rng):
+        """(squashed action in [-1,1], its log-prob) — the SAC
+        reparameterized sample."""
+        out = self.forward(params, obs)
+        mean, log_std = out["mean"], out["log_std"]
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(rng, mean.shape)
+        pre = mean + std * eps
+        action = jnp.tanh(pre)
+        # gaussian logp minus tanh jacobian (numerically-stable form)
+        logp = jnp.sum(
+            -0.5 * (eps**2 + 2 * log_std + jnp.log(2 * jnp.pi))
+            - 2.0 * (jnp.log(2.0) - pre - jax.nn.softplus(-2.0 * pre)),
+            axis=-1,
+        )
+        return action, logp
+
+
 class DiscreteMLPModule(RLModule):
     """MLP torso with categorical policy + value heads (the default
     CartPole-class module; reference analogue: catalog default MLP).

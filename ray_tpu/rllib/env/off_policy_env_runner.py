@@ -62,25 +62,38 @@ class OffPolicyEnvRunner(EnvRunner):
         frac = min(1.0, self._global_step / max(1, c.epsilon_timesteps))
         return float(c.epsilon_initial + frac * (c.epsilon_final - c.epsilon_initial))
 
+    # -- hooks for action-selection variants (SAC's continuous runner
+    # subclasses these; the sample loop with its autoreset masking is
+    # shared and lives ONLY here) --------------------------------------
+    def _on_fragment_start(self) -> None:
+        self._eps_now = self._epsilon()
+
+    def _select_actions(self, obs):
+        """Returns (stored_action, env_action) for one vector step."""
+        q = np.asarray(self._q_fn(self.params, obs.astype(np.float32)))
+        action = q.argmax(axis=-1)
+        explore = self._np_rng.random(self.num_envs) < self._eps_now
+        action = np.where(
+            explore, self._np_rng.integers(0, q.shape[-1], size=self.num_envs), action
+        ).astype(np.int64)
+        return action, action
+
+    def _extra_metrics(self) -> Dict[str, Any]:
+        return {"epsilon": self._eps_now}
+
     # -- sampling ------------------------------------------------------------
     def sample(self) -> Dict[str, Any]:
         T = self.config.rollout_fragment_length
-        E = self.num_envs
-        eps = self._epsilon()
         obs_shape = self.env.single_observation_space.shape
+        self._on_fragment_start()
 
         obs_l, act_l, rew_l, next_l, term_l = [], [], [], [], []
         obs = self._obs
         prev_done = self._prev_done
         for _ in range(T):
-            q = np.asarray(self._q_fn(self.params, obs.astype(np.float32)))
-            action = q.argmax(axis=-1)
-            explore = self._np_rng.random(E) < eps
-            action = np.where(
-                explore, self._np_rng.integers(0, q.shape[-1], size=E), action
-            ).astype(np.int64)
+            action, env_action = self._select_actions(obs)
 
-            next_obs, reward, terminated, truncated, _ = self.env.step(action)
+            next_obs, reward, terminated, truncated, _ = self.env.step(env_action)
             done = terminated | truncated
             live = self._account_step(np.asarray(reward), done, prev_done)
             # keep only real frames (autoreset frames carry a stale action)
@@ -105,7 +118,7 @@ class OffPolicyEnvRunner(EnvRunner):
         n = len(batch["actions"])
         self._global_step += n  # local estimate between syncs
         metrics = self._drain_episode_metrics(n, self._weights_seq)
-        metrics["epsilon"] = eps
+        metrics.update(self._extra_metrics())
         return {"batch": batch, "metrics": metrics}
 
     def stop(self) -> None:

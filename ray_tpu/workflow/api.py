@@ -71,6 +71,14 @@ def _ckpt_path(wf_dir: str, task_id: str) -> str:
     return os.path.join(wf_dir, "tasks", task_id.replace("/", "_") + ".pkl")
 
 
+def _checkpoint(wf_dir: str, task_id: str, value: Any) -> None:
+    ckpt = _ckpt_path(wf_dir, task_id)
+    tmp = ckpt + ".tmp"
+    with open(tmp, "wb") as f:
+        cloudpickle.dump(value, f)
+    os.replace(tmp, ckpt)
+
+
 def _submit_memo(node: DAGNode, ids: Dict[int, str], wf_dir: str,
                  memo: Dict[int, Any], collect: List[DAGNode]):
     """Phase 1 — submit bottom-up WITHOUT waiting: independent branches
@@ -88,14 +96,24 @@ def _submit_memo(node: DAGNode, ids: Dict[int, str], wf_dir: str,
             memo[id(node)] = ("val", cloudpickle.load(f))
         return memo[id(node)]
 
-    deps_args = [
-        _submit_memo(a, ids, wf_dir, memo, collect) if isinstance(a, DAGNode) else ("val", a)
-        for a in node._args
-    ]
-    deps_kwargs = {
-        k: (_submit_memo(v, ids, wf_dir, memo, collect) if isinstance(v, DAGNode) else ("val", v))
-        for k, v in node._kwargs.items()
-    }
+    def _dep(a):
+        return _submit_memo(a, ids, wf_dir, memo, collect) if isinstance(a, DAGNode) else ("val", a)
+
+    def _force(a, kv):
+        """Concrete value for an actor-call dependency; checkpoints it
+        immediately so a failure in a SIBLING dependency can't lose this
+        finished work before the collect loop runs."""
+        kind, v = kv
+        if kind != "ref":
+            return v
+        value = ray_tpu.get(v)
+        if isinstance(a, DAGNode) and not isinstance(a, InputNode):
+            _checkpoint(wf_dir, ids[id(a)], value)
+            memo[id(a)] = ("val", value)
+        return value
+
+    deps_args = [_dep(a) for a in node._args]
+    deps_kwargs = {k: _dep(v) for k, v in node._kwargs.items()}
     if isinstance(node, FunctionNode):
         # refs pass through: the executing worker resolves them
         args = [v for _, v in deps_args]
@@ -104,8 +122,8 @@ def _submit_memo(node: DAGNode, ids: Dict[int, str], wf_dir: str,
     elif isinstance(node, ActorMethodNode):
         # actor calls get concrete values (preserves per-actor ordering
         # semantics and sidesteps ref-forwarding through actor channels)
-        args = [ray_tpu.get(v) if kind == "ref" else v for kind, v in deps_args]
-        kwargs = {k: (ray_tpu.get(v) if kind == "ref" else v) for k, (kind, v) in deps_kwargs.items()}
+        args = [_force(a, kv) for a, kv in zip(node._args, deps_args)]
+        kwargs = {k: _force(node._kwargs[k], kv) for k, kv in deps_kwargs.items()}
         ref = node._handle._invoke(node._method, args, kwargs, 1)
     else:
         raise TypeError(f"cannot execute workflow node {type(node).__name__}")
@@ -119,18 +137,29 @@ def _execute_memo(node: DAGNode, ids: Dict[int, str], wf_dir: str, memo: Dict[in
     order; a mid-graph failure leaves every already-finished dependency
     checkpointed for resume."""
     collect: List[DAGNode] = []
-    _submit_memo(node, ids, wf_dir, memo, collect)
+    # a submit-phase failure (an actor dependency resolving to an error)
+    # must still fall through to the checkpoint loop below, which saves
+    # every sibling branch that did finish
+    first_error: Optional[BaseException] = None
+    try:
+        _submit_memo(node, ids, wf_dir, memo, collect)
+    except BaseException as e:
+        first_error = e
+    # checkpoint EVERYTHING that finished even when something failed —
+    # a partial run's surviving work is exactly what resume() skips
     for n in collect:
         kind, v = memo[id(n)]
         if kind != "ref":
             continue
-        value = ray_tpu.get(v)
-        ckpt = _ckpt_path(wf_dir, ids[id(n)])
-        tmp = ckpt + ".tmp"
-        with open(tmp, "wb") as f:
-            cloudpickle.dump(value, f)
-        os.replace(tmp, ckpt)
+        try:
+            value = ray_tpu.get(v)
+        except BaseException as e:
+            first_error = first_error or e
+            continue
+        _checkpoint(wf_dir, ids[id(n)], value)
         memo[id(n)] = ("val", value)
+    if first_error is not None:
+        raise first_error
     return memo[id(node)][1]
 
 

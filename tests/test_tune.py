@@ -154,7 +154,7 @@ def test_pbt_exploits_winner(ray_start_regular, tmp_path):
             with open(os.path.join(ckpt.path, "state.txt")) as f:
                 start = float(f.read())
         value = start
-        for i in range(12):
+        for i in range(16):
             import tempfile
             import time as _t
 
@@ -163,10 +163,12 @@ def test_pbt_exploits_winner(ray_start_regular, tmp_path):
             with open(os.path.join(d, "state.txt"), "w") as f:
                 f.write(str(value))
             tune.report({"score": value}, checkpoint=Checkpoint(d))
-            _t.sleep(0.4)  # keep the population alive across PBT decisions
+            # long enough that PBT's exploit decision lands while the
+            # trial is still alive even on a heavily-loaded 1-core CI box
+            _t.sleep(0.6)
 
     pbt = PopulationBasedTraining(
-        metric="score", mode="max", perturbation_interval=3,
+        metric="score", mode="max", perturbation_interval=2,
         hyperparam_mutations={"lr": [0.5, 1.0, 2.0]}, seed=0,
     )
     tuner = Tuner(
