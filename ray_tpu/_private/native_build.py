@@ -1,0 +1,49 @@
+"""Compile-and-cache for the native (C++) runtime components.
+
+One build path for every src/*.cc library (shm arena, futex channels):
+the output name embeds a content hash of the source, so a source change
+rebuilds automatically regardless of file timestamps, and a stale or
+foreign binary is never loaded (git does not preserve mtimes — see the
+round-1 advisory on the committed .so).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Sequence
+
+_lock = threading.Lock()
+
+
+def build_native_library(src_path: str, prefix: str,
+                         extra_flags: Sequence[str] = (), force: bool = False) -> str:
+    """Build `src_path` into lib<prefix>.<hash>.so next to the source
+    (cached by content hash); returns the library path."""
+    with open(src_path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    lib = os.path.join(os.path.dirname(src_path), f"lib{prefix}.{digest}.so")
+    with _lock:
+        if force or not os.path.exists(lib):
+            tmp = lib + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src_path,
+                 *extra_flags],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, lib)
+            # drop builds of older source revisions
+            d = os.path.dirname(lib)
+            for name in os.listdir(d):
+                if (
+                    name.startswith(f"lib{prefix}.")
+                    and name.endswith(".so")
+                    and os.path.join(d, name) != lib
+                ):
+                    try:
+                        os.unlink(os.path.join(d, name))
+                    except OSError:
+                        pass
+    return lib

@@ -44,25 +44,9 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 def build_library(force: bool = False) -> str:
-    lib = _lib_path()
-    with _build_lock:
-        if force or not os.path.exists(lib):
-            tmp = lib + f".tmp.{os.getpid()}"
-            subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC, "-lpthread"],
-                check=True,
-                capture_output=True,
-            )
-            os.replace(tmp, lib)
-            # drop builds of older source revisions
-            d = os.path.dirname(lib)
-            for name in os.listdir(d):
-                if name.startswith("libshm_store.") and name.endswith(".so") and os.path.join(d, name) != lib:
-                    try:
-                        os.unlink(os.path.join(d, name))
-                    except OSError:
-                        pass
-    return lib
+    from ray_tpu._private.native_build import build_native_library
+
+    return build_native_library(_SRC, "shm_store", extra_flags=("-lpthread",), force=force)
 
 
 def _load() -> ctypes.CDLL:

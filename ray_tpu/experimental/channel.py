@@ -3,23 +3,73 @@
 Equivalent of the reference's experimental channels
 (reference: python/ray/experimental/channel.py _create_channel_ref — a
 reusable mutable plasma buffer that compiled DAGs write/read per
-execution instead of allocating a new object per call). Here a channel
-is its own tiny mmap file in /dev/shm with a seq-versioned header:
-writer stores payload then bumps seq; readers poll seq past their
-cursor and copy out. Single writer; readers are lockstep consumers (the
-compiled DAG executes one round at a time, so a payload is never
-overwritten while still unread).
+execution instead of allocating a new object per call). A channel is a
+tiny /dev/shm mmap:
+
+    [ magic u64 | seq u64 | len u64 | notify u32 | pad u32 | payload ]
+
+Writer stores payload then bumps seq (then notify); readers wait for a
+seq past their cursor. The hot path is the native library
+(src/channel.cc): FUTEX_WAIT on the notify word instead of sleep
+polling — microsecond wakeups with zero busy CPU. A pure-python
+polling implementation backs it up when the native build is
+unavailable, and the two interoperate on the same wire format (the
+native reader's futex wait is time-sliced so python writers, which
+cannot futex-wake, still unblock it).
 """
 from __future__ import annotations
 
+import ctypes
 import mmap
 import os
 import struct
+import threading
 import time
 from typing import Optional
 
-_HDR = struct.Struct("<QQQ")  # magic, seq, payload_len
+_HDR = struct.Struct("<QQQII")  # magic, seq, payload_len, notify, pad
 _MAGIC = 0x52545043484E4C31  # "RTPCHNL1"
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "src", "channel.cc"
+)
+_build_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+
+def _native_lib() -> Optional[ctypes.CDLL]:
+    """Build (hash-keyed, shared helper) + load the futex channel lib;
+    None when unavailable — callers fall back to polling."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    with _build_lock:
+        if _lib_tried:
+            return _lib
+        try:
+            from ray_tpu._private.native_build import build_native_library
+
+            lib = ctypes.CDLL(build_native_library(_SRC, "channel"))
+            lib.chan_open.restype = ctypes.c_void_p
+            lib.chan_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+            lib.chan_capacity.restype = ctypes.c_uint64
+            lib.chan_capacity.argtypes = [ctypes.c_void_p]
+            lib.chan_seq.restype = ctypes.c_uint64
+            lib.chan_seq.argtypes = [ctypes.c_void_p]
+            lib.chan_write.restype = ctypes.c_uint64
+            lib.chan_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+            lib.chan_read.restype = ctypes.c_int64
+            lib.chan_read.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.chan_close.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception:
+            _lib = None
+        _lib_tried = True
+        return _lib
 
 
 class ChannelTimeoutError(TimeoutError):
@@ -27,46 +77,67 @@ class ChannelTimeoutError(TimeoutError):
 
 
 class Channel:
-    """SPSC/SPMC byte channel over a /dev/shm mmap."""
+    """SPSC/SPMC byte channel over a /dev/shm mmap (see module doc)."""
 
-    def __init__(self, path: str, mm: mmap.mmap, capacity: int):
+    def __init__(self, path: str, capacity: int, handle=None, mm: Optional[mmap.mmap] = None):
         self.path = path
-        self._mm = mm
         self.capacity = capacity
+        self._handle = handle  # native
+        self._mm = mm  # python fallback
         self._cursor = 0  # reader-side: last seq consumed
+        self._closed = False
 
     # -- lifecycle -------------------------------------------------------
     @classmethod
     def create(cls, name: str, capacity: int = 1 << 20) -> "Channel":
         path = f"/dev/shm/ray_tpu_chan_{os.getpid()}_{name}"
+        lib = _native_lib()
+        if lib is not None:
+            h = lib.chan_open(path.encode(), capacity, 1)
+            if not h:
+                raise FileExistsError(path)
+            return cls(path, capacity, handle=h)
         fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_EXCL, 0o600)
         try:
             os.ftruncate(fd, _HDR.size + capacity)
             mm = mmap.mmap(fd, _HDR.size + capacity)
         finally:
             os.close(fd)
-        _HDR.pack_into(mm, 0, _MAGIC, 0, 0)
-        return cls(path, mm, capacity)
+        _HDR.pack_into(mm, 0, _MAGIC, 0, 0, 0, 0)
+        return cls(path, capacity, mm=mm)
 
     @classmethod
     def open(cls, path: str) -> "Channel":
+        lib = _native_lib()
+        if lib is not None:
+            h = lib.chan_open(path.encode(), 0, 0)
+            if not h:
+                raise ValueError(f"{path} is not a channel")
+            return cls(path, lib.chan_capacity(h), handle=h)
         fd = os.open(path, os.O_RDWR)
         try:
             size = os.fstat(fd).st_size
             mm = mmap.mmap(fd, size)
         finally:
             os.close(fd)
-        magic, _, _ = _HDR.unpack_from(mm, 0)
+        magic, _, _, _, _ = _HDR.unpack_from(mm, 0)
         if magic != _MAGIC:
             mm.close()
             raise ValueError(f"{path} is not a channel")
-        return cls(path, mm, size - _HDR.size)
+        return cls(path, size - _HDR.size, mm=mm)
 
     def close(self):
-        try:
-            self._mm.close()
-        except (BufferError, ValueError):
-            pass
+        if self._closed:
+            return
+        self._closed = True
+        if self._handle is not None:
+            _native_lib().chan_close(self._handle)
+            self._handle = None
+        if self._mm is not None:
+            try:
+                self._mm.close()
+            except (BufferError, ValueError):
+                pass
 
     def unlink(self):
         self.close()
@@ -78,26 +149,48 @@ class Channel:
     # -- data plane ------------------------------------------------------
     @property
     def seq(self) -> int:
-        _, seq, _ = _HDR.unpack_from(self._mm, 0)
+        if self._handle is not None:
+            return _native_lib().chan_seq(self._handle)
+        _, seq, _, _, _ = _HDR.unpack_from(self._mm, 0)
         return seq
 
     def write(self, payload: bytes) -> int:
         if len(payload) > self.capacity:
             raise ValueError(f"payload {len(payload)} exceeds channel capacity {self.capacity}")
-        self._mm[_HDR.size : _HDR.size + len(payload)] = payload
-        # header (seq) is stored LAST: a reader that sees the new seq is
-        # guaranteed to see the payload bytes (x86 store ordering; the
-        # GIL orders the python-side stores)
-        _, seq, _ = _HDR.unpack_from(self._mm, 0)
-        _HDR.pack_into(self._mm, 0, _MAGIC, seq + 1, len(payload))
+        if self._handle is not None:
+            return _native_lib().chan_write(self._handle, payload, len(payload))
+        mm = self._mm
+        mm[_HDR.size : _HDR.size + len(payload)] = payload
+        magic, seq, _, notify, _ = _HDR.unpack_from(mm, 0)
+        # seq is stored before notify; a reader that sees the new seq is
+        # guaranteed to see the payload (x86 store ordering + GIL)
+        _HDR.pack_into(mm, 0, _MAGIC, seq + 1, len(payload), (notify + 1) & 0xFFFFFFFF, 0)
         return seq + 1
 
     def read(self, timeout: Optional[float] = 10.0) -> bytes:
         """Block until a seq newer than this reader's cursor appears."""
+        if self._handle is not None:
+            lib = _native_lib()
+            buf = getattr(self, "_read_buf", None)
+            if buf is None:
+                # one reusable buffer per channel: allocating (and
+                # zero-filling) capacity bytes per read would dwarf the
+                # futex win
+                buf = self._read_buf = ctypes.create_string_buffer(self.capacity)
+            seq_out = ctypes.c_uint64(0)
+            tmo = -1 if timeout is None else max(0, int(timeout * 1000))
+            n = lib.chan_read(self._handle, self._cursor, buf, self.capacity, tmo,
+                              ctypes.byref(seq_out))
+            if n == -1:
+                raise ChannelTimeoutError(f"channel {self.path} idle for {timeout}s")
+            if n < 0:
+                raise ValueError(f"channel read error {n} on {self.path}")
+            self._cursor = seq_out.value
+            return ctypes.string_at(buf, n)
         deadline = None if timeout is None else time.monotonic() + timeout
         delay = 20e-6
         while True:
-            magic, seq, ln = _HDR.unpack_from(self._mm, 0)
+            magic, seq, ln, _, _ = _HDR.unpack_from(self._mm, 0)
             if seq > self._cursor:
                 self._cursor = seq
                 return bytes(self._mm[_HDR.size : _HDR.size + ln])

@@ -49,8 +49,16 @@ class CompiledDAG:
                 raise TypeError(
                     f"compiled DAGs support actor-method nodes only, got {type(n).__name__}"
                 )
-            if isinstance(n, ActorMethodNode) and n._kwargs:
-                raise ValueError("compiled DAGs support positional args only")
+            if isinstance(n, ActorMethodNode):
+                if n._kwargs:
+                    raise ValueError("compiled DAGs support positional args only")
+                if not any(isinstance(a, DAGNode) for a in n._args):
+                    # no channel inputs = nothing paces the loop: it would
+                    # spin at 100% CPU out of lockstep and never see STOP
+                    raise ValueError(
+                        f"compiled node {n._method!r} has no upstream inputs; "
+                        "every actor node needs at least one DAGNode argument"
+                    )
 
         # one output channel per node; the input node's channel is the
         # driver's write side. Names use a process-monotonic counter —
@@ -89,9 +97,20 @@ class CompiledDAG:
             self._loop_refs.append(ref)
             self._actors.append(n._handle)
 
-    def execute(self, value: Any) -> Any:
+    def execute(self, value: Any, timeout: float = 60.0) -> Any:
+        if getattr(self, "_broken", False):
+            raise RuntimeError(
+                "compiled DAG is out of lockstep after a timed-out execute(); "
+                "teardown() and recompile"
+            )
         self._in_chan.write(pickle.dumps(value))
-        out = self._out_chan.read(timeout=60.0)
+        try:
+            out = self._out_chan.read(timeout=timeout)
+        except Exception:
+            # the result may still arrive later; a subsequent execute()
+            # would silently read THIS round's output as its own — refuse
+            self._broken = True
+            raise
         if out.startswith(STOP):
             raise RuntimeError("compiled DAG was torn down")
         result = pickle.loads(out)

@@ -152,6 +152,8 @@ class SACLearner(Learner):
         state = super().get_state()
         state["target_params"] = self._jax.tree.map(np.asarray, self.target_params)
         state["log_alpha"] = float(np.asarray(self.log_alpha))
+        state["alpha_opt_state"] = self._jax.tree.map(np.asarray, self._alpha_opt_state)
+        state["updates"] = self._updates
         return state
 
     def set_state(self, state) -> None:
@@ -160,6 +162,8 @@ class SACLearner(Learner):
         super().set_state(state)
         self.target_params = self._jax.tree.map(np.asarray, state["target_params"])
         self.log_alpha = jnp.asarray(state["log_alpha"])
+        self._alpha_opt_state = self._jax.tree.map(jnp.asarray, state["alpha_opt_state"])
+        self._updates = state.get("updates", 0)
 
 
 class SACConfig(DQNConfig):

@@ -1,0 +1,177 @@
+// Native channel transport: futex-waited SPSC/SPMC seq channels.
+//
+// The compiled-DAG data plane (reference: python/ray/experimental/
+// channel.py reusable mutable plasma buffers; the reference's C++ side
+// is plasma + gRPC). A channel is a tiny /dev/shm file:
+//
+//   [ magic u64 | seq u64 | len u64 | notify u32 | pad u32 | payload.. ]
+//
+// Writer: memcpy payload, release-store seq+1, bump notify, FUTEX_WAKE.
+// Reader: acquire-load seq; if stale, FUTEX_WAIT on notify (with a
+// short timeout so a pure-python poller on the other end still
+// interoperates). Single writer; readers are lockstep consumers.
+//
+// Exposed as a C ABI for the ctypes binding in
+// ray_tpu/experimental/channel.py, which keeps a pure-python polling
+// fallback when the library cannot build.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <linux/futex.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545043484E4C31ULL;  // "RTPCHNL1" (little-endian)
+constexpr size_t kHeader = 32;
+
+struct Header {
+  uint64_t magic;
+  std::atomic<uint64_t> seq;
+  uint64_t len;
+  std::atomic<uint32_t> notify;
+  uint32_t pad;
+};
+
+static_assert(sizeof(Header) == kHeader, "header layout is the wire format");
+
+struct Chan {
+  void* base;
+  size_t map_size;
+  uint64_t capacity;
+};
+
+int futex(std::atomic<uint32_t>* addr, int op, uint32_t val, const timespec* ts) {
+  return syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), op, val, ts, nullptr, 0);
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns NULL on failure. create=1: O_EXCL create + init header.
+void* chan_open(const char* path, uint64_t capacity, int create) {
+  int fd;
+  if (create) {
+    fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+    if (fd < 0) return nullptr;
+    if (ftruncate(fd, (off_t)(kHeader + capacity)) != 0) {
+      close(fd);
+      unlink(path);
+      return nullptr;
+    }
+  } else {
+    fd = open(path, O_RDWR);
+    if (fd < 0) return nullptr;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (size_t)st.st_size < kHeader) {
+      close(fd);
+      return nullptr;
+    }
+    capacity = (uint64_t)st.st_size - kHeader;
+  }
+  void* base =
+      mmap(nullptr, kHeader + capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  Header* h = reinterpret_cast<Header*>(base);
+  if (create) {
+    h->seq.store(0, std::memory_order_relaxed);
+    h->len = 0;
+    h->notify.store(0, std::memory_order_relaxed);
+    h->pad = 0;
+    std::atomic_thread_fence(std::memory_order_release);
+    h->magic = kMagic;
+  } else if (h->magic != kMagic) {
+    munmap(base, kHeader + capacity);
+    return nullptr;
+  }
+  Chan* c = new Chan{base, kHeader + capacity, capacity};
+  return c;
+}
+
+uint64_t chan_capacity(void* handle) {
+  return reinterpret_cast<Chan*>(handle)->capacity;
+}
+
+uint64_t chan_seq(void* handle) {
+  Chan* c = reinterpret_cast<Chan*>(handle);
+  return reinterpret_cast<Header*>(c->base)->seq.load(std::memory_order_acquire);
+}
+
+// returns new seq, or 0 on payload-too-large
+uint64_t chan_write(void* handle, const uint8_t* data, uint64_t len) {
+  Chan* c = reinterpret_cast<Chan*>(handle);
+  if (len > c->capacity) return 0;
+  Header* h = reinterpret_cast<Header*>(c->base);
+  memcpy(reinterpret_cast<uint8_t*>(c->base) + kHeader, data, len);
+  h->len = len;
+  uint64_t next = h->seq.load(std::memory_order_relaxed) + 1;
+  h->seq.store(next, std::memory_order_release);
+  h->notify.fetch_add(1, std::memory_order_release);
+  futex(&h->notify, FUTEX_WAKE, INT32_MAX, nullptr);
+  return next;
+}
+
+// Wait for seq > last_seq; copy payload into out (cap out_cap).
+// Returns payload length, or -1 on timeout, -2 if payload > out_cap.
+// timeout_ms < 0 waits forever.
+int64_t chan_read(void* handle, uint64_t last_seq, uint8_t* out, uint64_t out_cap,
+                  int64_t timeout_ms, uint64_t* seq_out) {
+  Chan* c = reinterpret_cast<Chan*>(handle);
+  Header* h = reinterpret_cast<Header*>(c->base);
+  timespec deadline;
+  if (timeout_ms >= 0) {
+    clock_gettime(CLOCK_MONOTONIC, &deadline);
+    deadline.tv_sec += timeout_ms / 1000;
+    deadline.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (deadline.tv_nsec >= 1000000000L) {
+      deadline.tv_sec += 1;
+      deadline.tv_nsec -= 1000000000L;
+    }
+  }
+  for (;;) {
+    uint32_t n = h->notify.load(std::memory_order_acquire);
+    uint64_t seq = h->seq.load(std::memory_order_acquire);
+    if (seq > last_seq) {
+      uint64_t len = h->len;
+      if (len > out_cap) return -2;
+      memcpy(out, reinterpret_cast<uint8_t*>(c->base) + kHeader, len);
+      // re-check seq: a concurrent overwrite during the copy means the
+      // lockstep contract was violated; surface the newest seq anyway
+      *seq_out = h->seq.load(std::memory_order_acquire);
+      return (int64_t)len;
+    }
+    // wait: bounded slice so python-side writers (no futex wake) still
+    // unblock us via the next iteration's seq check
+    timespec slice{0, 2 * 1000 * 1000};  // 2ms
+    if (timeout_ms >= 0) {
+      timespec now;
+      clock_gettime(CLOCK_MONOTONIC, &now);
+      int64_t left_ns = (deadline.tv_sec - now.tv_sec) * 1000000000L +
+                        (deadline.tv_nsec - now.tv_nsec);
+      if (left_ns <= 0) return -1;
+      if (left_ns < 2 * 1000 * 1000) {
+        slice.tv_sec = 0;
+        slice.tv_nsec = left_ns;
+      }
+    }
+    futex(&h->notify, FUTEX_WAIT, n, &slice);
+  }
+}
+
+void chan_close(void* handle) {
+  Chan* c = reinterpret_cast<Chan*>(handle);
+  munmap(c->base, c->map_size);
+  delete c;
+}
+
+}  // extern "C"

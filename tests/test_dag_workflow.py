@@ -148,3 +148,34 @@ def test_workflow_failure_then_resume(ray_start_regular, tmp_path):
     # resume skips `base` (checkpointed) and re-runs only `flaky`
     assert workflow.resume("w2", storage=store) == 20
     assert workflow.get_status("w2", storage=store) == "SUCCESSFUL"
+
+
+def test_channel_python_fallback_interop(monkeypatch):
+    """The pure-python polling implementation and the native futex one
+    share a wire format: python-written channels are native-readable and
+    vice versa."""
+    from ray_tpu.experimental import channel as ch
+
+    native = ch._native_lib()
+    # force the python implementation for the writer side
+    monkeypatch.setattr(ch, "_lib", None)
+    monkeypatch.setattr(ch, "_lib_tried", True)
+    py_chan = ch.Channel.create("fallback0", capacity=4096)
+    try:
+        assert py_chan._mm is not None  # really the python path
+        py_chan.write(b"from-python")
+        py_reader = ch.Channel.open(py_chan.path)
+        assert py_reader.read(timeout=1) == b"from-python"
+        py_reader.close()
+
+        if native is not None:
+            # native reader on a python-written channel
+            monkeypatch.setattr(ch, "_lib", native)
+            nat_reader = ch.Channel.open(py_chan.path)
+            assert nat_reader._handle is not None
+            assert nat_reader.read(timeout=1) == b"from-python"
+            py_chan.write(b"again")  # python writer wakes the futex reader via time-slice
+            assert nat_reader.read(timeout=2) == b"again"
+            nat_reader.close()
+    finally:
+        py_chan.unlink()
