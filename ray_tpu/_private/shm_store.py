@@ -16,21 +16,11 @@ for wheels/CI.
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import subprocess
-import threading
 from typing import Optional
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "src", "shm_store.cc")
 
-
-def _lib_path() -> str:
-    """Library path embedding a hash of the source: rebuilds are automatic
-    whenever shm_store.cc changes, regardless of file timestamps."""
-    with open(_SRC, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    return os.path.join(os.path.dirname(_SRC), f"libshm_store.{digest}.so")
 
 ST_OK = 0
 ST_EXISTS = -1
@@ -39,7 +29,6 @@ ST_NOT_FOUND = -3
 ST_TIMEOUT = -4
 ST_ERR = -5
 
-_build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
 

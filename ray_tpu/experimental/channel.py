@@ -162,9 +162,13 @@ class Channel:
         mm = self._mm
         mm[_HDR.size : _HDR.size + len(payload)] = payload
         magic, seq, _, notify, _ = _HDR.unpack_from(mm, 0)
-        # seq is stored before notify; a reader that sees the new seq is
-        # guaranteed to see the payload (x86 store ordering + GIL)
-        _HDR.pack_into(mm, 0, _MAGIC, seq + 1, len(payload), (notify + 1) & 0xFFFFFFFF, 0)
+        # publication order matters cross-process: payload, then len,
+        # then seq, then notify — a reader that sees the new seq is
+        # guaranteed a matching len+payload (x86 store ordering; the
+        # native writer orders its stores the same way)
+        struct.pack_into("<Q", mm, 16, len(payload))
+        struct.pack_into("<Q", mm, 8, seq + 1)
+        struct.pack_into("<I", mm, 24, (notify + 1) & 0xFFFFFFFF)
         return seq + 1
 
     def read(self, timeout: Optional[float] = 10.0) -> bytes:

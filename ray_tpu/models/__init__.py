@@ -1,0 +1,9 @@
+"""ray_tpu.models — jax-native model families.
+
+llama: decoder-only LM (GQA/SwiGLU/RoPE, flash/blockwise attention) —
+the flagship training target. resnet: NHWC/bf16 vision family
+(reference benchmark analogue: mlperf-train resnet50). Import the
+submodules directly (`from ray_tpu.models import llama`): no eager
+imports here so worker processes don't pay the jax import for code
+that never touches a model.
+"""

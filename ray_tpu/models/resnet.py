@@ -1,0 +1,236 @@
+"""ResNet — the vision model family, TPU-native.
+
+Counterpart of the reference's ResNet-50 training benchmark workload
+(reference: release/air_tests/air_benchmarks/mlperf-train/
+resnet50_ray_air.py — torchvision's model inside Train workers; here
+the model itself is jax). TPU-first layout choices: NHWC activations
+(the TPU-native convolution layout), bf16-friendly compute with fp32
+batch-norm statistics, and a functional param pytree so the same
+forward serves pjit training and serve replicas.
+
+Families: resnet18/34 (basic blocks), resnet50/101 (bottleneck).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    depths: Tuple[int, ...] = (2, 2, 2, 2)
+    bottleneck: bool = False
+    widths: Tuple[int, ...] = (64, 128, 256, 512)
+    num_classes: int = 1000
+    stem_width: int = 64
+    # ImageNet stem: stride-2 7x7 conv + stride-2 3x3 maxpool (16x fewer
+    # stage-1 pixels — without it stage 1 runs at input resolution and
+    # the FLOPs are nothing like the benchmark model). The tiny/CIFAR
+    # config uses a plain 3x3 stem instead.
+    imagenet_stem: bool = True
+    dtype: Any = jnp.bfloat16  # activations/weights; BN stats stay fp32
+
+    @classmethod
+    def resnet18(cls, **kw):
+        return cls(depths=(2, 2, 2, 2), bottleneck=False, **kw)
+
+    @classmethod
+    def resnet34(cls, **kw):
+        return cls(depths=(3, 4, 6, 3), bottleneck=False, **kw)
+
+    @classmethod
+    def resnet50(cls, **kw):
+        return cls(depths=(3, 4, 6, 3), bottleneck=True, **kw)
+
+    @classmethod
+    def resnet101(cls, **kw):
+        return cls(depths=(3, 4, 23, 3), bottleneck=True, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """CIFAR-scale config for tests: 8px-friendly stem, 2 stages."""
+        kw.setdefault("num_classes", 10)
+        kw.setdefault("stem_width", 16)
+        kw.setdefault("imagenet_stem", False)
+        return cls(depths=(1, 1), widths=(16, 32), bottleneck=False, **kw)
+
+
+def _conv_init(key, kh, kw_, cin, cout):
+    fan_in = kh * kw_ * cin
+    return jax.random.normal(key, (kh, kw_, cin, cout)) * (2.0 / fan_in) ** 0.5
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),  # TPU-native layouts
+    )
+
+
+def _bn_init(c):
+    return {
+        "scale": jnp.ones((c,)),
+        "bias": jnp.zeros((c,)),
+        "mean": jnp.zeros((c,), jnp.float32),
+        "var": jnp.ones((c,), jnp.float32),
+    }
+
+
+def _bn(x, p, train: bool, momentum=0.9):
+    """Returns (y, updated_stats). Statistics compute in fp32 even for
+    bf16 activations (precision of the variance matters)."""
+    if train:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.var(xf, axis=(0, 1, 2))
+        new_stats = {
+            "mean": momentum * p["mean"] + (1 - momentum) * mean,
+            "var": momentum * p["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = p["mean"], p["var"]
+        new_stats = None
+    inv = jax.lax.rsqrt(var + 1e-5)
+    y = (x.astype(jnp.float32) - mean) * inv * p["scale"] + p["bias"]
+    return y.astype(x.dtype), new_stats
+
+
+def _block_init(key, cin, cout, bottleneck, stride):
+    ks = jax.random.split(key, 4)
+    p: Dict[str, Any] = {}
+    if bottleneck:
+        mid = cout // 4
+        p["conv1"] = _conv_init(ks[0], 1, 1, cin, mid)
+        p["bn1"] = _bn_init(mid)
+        p["conv2"] = _conv_init(ks[1], 3, 3, mid, mid)
+        p["bn2"] = _bn_init(mid)
+        p["conv3"] = _conv_init(ks[2], 1, 1, mid, cout)
+        p["bn3"] = _bn_init(cout)
+    else:
+        p["conv1"] = _conv_init(ks[0], 3, 3, cin, cout)
+        p["bn1"] = _bn_init(cout)
+        p["conv2"] = _conv_init(ks[1], 3, 3, cout, cout)
+        p["bn2"] = _bn_init(cout)
+    if stride != 1 or cin != cout:
+        p["proj"] = _conv_init(ks[3], 1, 1, cin, cout)
+        p["bn_proj"] = _bn_init(cout)
+    return p
+
+
+def _block_apply(x, p, bottleneck, stride, train):
+    updates = {}
+    shortcut = x
+    if "proj" in p:
+        shortcut = _conv(x, p["proj"], stride)
+        shortcut, u = _bn(shortcut, p["bn_proj"], train)
+        updates["bn_proj"] = u
+    if bottleneck:
+        y = _conv(x, p["conv1"])
+        y, updates["bn1"] = _bn(y, p["bn1"], train)
+        y = jax.nn.relu(y)
+        y = _conv(y, p["conv2"], stride)
+        y, updates["bn2"] = _bn(y, p["bn2"], train)
+        y = jax.nn.relu(y)
+        y = _conv(y, p["conv3"])
+        y, updates["bn3"] = _bn(y, p["bn3"], train)
+    else:
+        y = _conv(x, p["conv1"], stride)
+        y, updates["bn1"] = _bn(y, p["bn1"], train)
+        y = jax.nn.relu(y)
+        y = _conv(y, p["conv2"])
+        y, updates["bn2"] = _bn(y, p["bn2"], train)
+    return jax.nn.relu(y + shortcut), updates
+
+
+def init_params(key, cfg: ResNetConfig):
+    expansion = 4 if cfg.bottleneck else 1
+    keys = jax.random.split(key, 2 + sum(cfg.depths))
+    stem_k = (7, 7) if cfg.imagenet_stem else (3, 3)
+    params: Dict[str, Any] = {
+        "stem": _conv_init(keys[0], stem_k[0], stem_k[1], 3, cfg.stem_width),
+        "bn_stem": _bn_init(cfg.stem_width),
+        "stages": [],
+    }
+    cin = cfg.stem_width
+    k = 1
+    for si, (depth, width) in enumerate(zip(cfg.depths, cfg.widths)):
+        cout = width * expansion
+        blocks = []
+        for bi in range(depth):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            blocks.append(_block_init(keys[k], cin, cout, cfg.bottleneck, stride))
+            cin = cout
+            k += 1
+        params["stages"].append(blocks)
+    params["head"] = {
+        "w": jax.random.normal(keys[-1], (cin, cfg.num_classes)) * 0.01,
+        "b": jnp.zeros((cfg.num_classes,)),
+    }
+    if cfg.dtype is not None:
+        params = jax.tree.map(
+            lambda a: a.astype(cfg.dtype) if a.dtype == jnp.float32 and a.ndim >= 2 else a,
+            params,
+        )
+    return params
+
+
+def forward(params, images, cfg: ResNetConfig, train: bool = False):
+    """images: (N, H, W, 3) float. Returns (logits fp32, bn_updates)."""
+    x = images.astype(cfg.dtype or images.dtype)
+    updates: Dict[str, Any] = {}
+    x = _conv(x, params["stem"], stride=2 if cfg.imagenet_stem else 1)
+    x, updates["bn_stem"] = _bn(x, params["bn_stem"], train)
+    x = jax.nn.relu(x)
+    if cfg.imagenet_stem:
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, 3, 3, 1), window_strides=(1, 2, 2, 1),
+            padding="SAME",
+        )
+    stage_updates: List[Any] = []
+    for si, blocks in enumerate(params["stages"]):
+        block_updates = []
+        for bi, bp in enumerate(blocks):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            x, u = _block_apply(x, bp, cfg.bottleneck, stride, train)
+            block_updates.append(u)
+        stage_updates.append(block_updates)
+    updates["stages"] = stage_updates
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    logits = x.astype(jnp.float32) @ params["head"]["w"].astype(jnp.float32) + params["head"]["b"]
+    return logits, (updates if train else None)
+
+
+def apply_bn_updates(params, updates):
+    """Fold the batch-norm running-stat updates back into the param tree
+    — purely (new tree; BN stats stay out of the gradient path)."""
+
+    def fold_block(bp, bu):
+        out = dict(bp)
+        for k, v in (bu or {}).items():
+            if k.startswith("bn") and v is not None:
+                out[k] = {**bp[k], "mean": v["mean"], "var": v["var"]}
+        return out
+
+    out = dict(params)
+    if updates.get("bn_stem") is not None:
+        out["bn_stem"] = {**params["bn_stem"], "mean": updates["bn_stem"]["mean"],
+                          "var": updates["bn_stem"]["var"]}
+    out["stages"] = [
+        [fold_block(bp, bu) for bp, bu in zip(sp, su)]
+        for sp, su in zip(params["stages"], updates["stages"])
+    ]
+    return out
+
+
+def loss_fn(params, images, labels, cfg: ResNetConfig):
+    logits, updates = forward(params, images, cfg, train=True)
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+    acc = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+    return loss, {"accuracy": acc, "bn_updates": updates}

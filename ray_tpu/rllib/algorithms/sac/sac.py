@@ -162,7 +162,10 @@ class SACLearner(Learner):
         super().set_state(state)
         self.target_params = self._jax.tree.map(np.asarray, state["target_params"])
         self.log_alpha = jnp.asarray(state["log_alpha"])
-        self._alpha_opt_state = self._jax.tree.map(jnp.asarray, state["alpha_opt_state"])
+        if "alpha_opt_state" in state:
+            self._alpha_opt_state = self._jax.tree.map(jnp.asarray, state["alpha_opt_state"])
+        else:  # checkpoint predates alpha-state persistence
+            self._alpha_opt_state = self._alpha_opt.init(self.log_alpha)
         self._updates = state.get("updates", 0)
 
 
