@@ -12,6 +12,7 @@ from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup  # noqa: F401

@@ -157,6 +157,23 @@ def shutdown():
 
 
 # --------------------------------------------------------------- batching
+_batch_states: Dict[Any, Dict[str, Any]] = {}  # (module, qualname) -> state
+
+
+def _get_batch_state(state_key) -> Dict[str, Any]:
+    """Lazy per-process state. A module-level NAMED function on purpose:
+    cloudpickle ships it by reference, so the decorated wrapper's pickle
+    never drags in `_batch_states` (whose Conditions are unpicklable the
+    moment any batch function has run in this process)."""
+    st = _batch_states.get(state_key)
+    if st is None:
+        st = _batch_states[state_key] = {
+            "cond": threading.Condition(),
+            "pending": [],
+        }
+    return st
+
+
 def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
     """@serve.batch — coalesce concurrent calls into one batched call
     (reference: python/ray/serve/batching.py). The leader waits on a
@@ -164,11 +181,21 @@ def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.
     than burning a thread in a sleep/poll loop."""
 
     def deco(fn):
-        cond = threading.Condition()
-        pending: List = []  # (args_item, event, out)
+        # per-process lazy state; the key carries a per-DECORATION token
+        # (factory-made wrappers share a qualname but must not share a
+        # queue) and travels inside the wrapper's pickled closure, so a
+        # shipped replica resolves the same state its driver-side twin
+        # would (see _get_batch_state for why the lookup is a named
+        # module function)
+        import uuid
+
+        state_key = (fn.__module__, fn.__qualname__, uuid.uuid4().hex)
 
         @functools.wraps(fn)
         def wrapper(self_or_item, *rest):
+            st = _get_batch_state(state_key)
+            cond: threading.Condition = st["cond"]
+            pending: List = st["pending"]  # (args_item, event, out)
             # method form: (self, item); function form: (item,)
             if rest:
                 owner, item = self_or_item, rest[0]

@@ -25,6 +25,16 @@ _current_model_id: contextvars.ContextVar = contextvars.ContextVar(
 _mux_states: dict = {}  # (module, qualname) -> {"lock", "cache"}, per process
 
 
+def _get_mux_state(state_key) -> dict:
+    st = _mux_states.get(state_key)
+    if st is None:
+        st = _mux_states[state_key] = {
+            "lock": threading.Lock(),
+            "cache": collections.OrderedDict(),
+        }
+    return st
+
+
 def get_multiplexed_model_id() -> str:
     """The model id of the request currently being handled
     (reference: serve/api.py get_multiplexed_model_id)."""
@@ -68,19 +78,14 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
 
     def deco(fn: Callable):
         # LRU state lives OUTSIDE the function/class (created lazily per
-        # process, keyed by the wrapped function): a closure-captured
-        # threading.Lock would make the deployment class unpicklable for
-        # serve.run's cloudpickle ship to the controller
-        state_key = (fn.__module__, fn.__qualname__)
+        # process, keyed per decoration — factory-made wrappers share a
+        # qualname but must not share a cache) and is looked up via a
+        # NAMED module function, which cloudpickle ships by reference:
+        # a closure over the state (or the registry dict) would drag its
+        # locks into the deployment class's pickle
+        import uuid
 
-        def _state():
-            st = _mux_states.get(state_key)
-            if st is None:
-                st = _mux_states[state_key] = {
-                    "lock": threading.Lock(),
-                    "cache": collections.OrderedDict(),
-                }
-            return st
+        state_key = (fn.__module__, fn.__qualname__, uuid.uuid4().hex)
 
         @functools.wraps(fn)
         def wrapper(self_or_id, *rest):
@@ -88,7 +93,7 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
                 owner, model_id = self_or_id, rest[0]
             else:
                 owner, model_id = None, self_or_id
-            st = _state()
+            st = _get_mux_state(state_key)
             lock, cache = st["lock"], st["cache"]
             with lock:
                 if model_id in cache:
@@ -110,7 +115,7 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
                             pass
             return model
 
-        wrapper._multiplexed_state = _state  # introspection / tests
+        wrapper._multiplexed_state_key = state_key  # introspection / tests
         return wrapper
 
     if _fn is not None:

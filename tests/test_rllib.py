@@ -354,3 +354,57 @@ def test_sac_pendulum_improves():
             break
     algo.stop()
     assert best > -600.0, f"SAC failed to improve on Pendulum (best {best})"
+
+
+def test_marwil_learns_from_mixed_data():
+    """MARWIL's advantage weighting filters a mixed-quality dataset: the
+    exp(beta*adv) weights are demonstrably non-uniform, and the learned
+    policy evaluates far above the dataset's random half."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib import MARWILConfig
+
+    env = gym.make("CartPole-v1")
+    obs_l, act_l, rew_l, done_l = [], [], [], []
+    rng = np.random.default_rng(0)
+    for ep in range(60):
+        obs, _ = env.reset(seed=int(rng.integers(1 << 30)))
+        done = False
+        good = ep % 2 == 0
+        while not done:
+            if good:
+                action = int(obs[2] + 0.5 * obs[3] > 0)  # decent heuristic
+            else:
+                action = int(rng.integers(0, 2))  # garbage
+            obs_l.append(obs)
+            act_l.append(action)
+            obs, r, term, trunc, _ = env.step(action)
+            rew_l.append(r)
+            done = term or trunc
+            done_l.append(done)
+    env.close()
+    data = {
+        "obs": np.asarray(obs_l, np.float32),
+        "actions": np.asarray(act_l),
+        "rewards": np.asarray(rew_l, np.float32),
+        "dones": np.asarray(done_l),
+    }
+
+    config = (
+        MARWILConfig()
+        .environment("CartPole-v1")
+        .offline(dict(data))
+        .training(lr=1e-3, minibatch_size=512, num_epochs=10)
+        .debugging(seed=0)
+    )
+    config.beta = 2.0
+    algo = config.build()
+    for _ in range(6):
+        r = algo.train()
+    # the weighting must actually be active: exp of a centered, non-zero
+    # advantage distribution has mean > 1 (Jensen); uniform weights = bug
+    assert r["learner"]["mean_weight"] > 1.05, r["learner"]
+    ev = algo.evaluate(num_episodes=5)
+    algo.stop()
+    weighted = ev["episode_return_mean"]
+    assert weighted > 60.0, f"MARWIL failed to learn from mixed data ({weighted})"
