@@ -162,6 +162,28 @@ def bench_runtime(extra):
     extra["tasks_async"] = round(r, 1)
     log(f"[bench] async tasks: {r:.0f}/s")
 
+    # compiled DAG over native futex channels vs the task path (no
+    # reference baseline — the reference's compiled DAGs are experimental)
+    try:
+        from ray_tpu.dag import InputNode
+        from ray_tpu.experimental.compiled_dag import experimental_compile
+
+        s = Echo.remote()
+        ray_tpu.get(s.ping.remote())
+        inp = InputNode()
+        cdag = experimental_compile(s.ping.bind(inp))
+        cdag.execute(1)
+        t0 = time.perf_counter()
+        n = 2000
+        for i in range(n):
+            cdag.execute(i)
+        dt = (time.perf_counter() - t0) / n
+        cdag.teardown()
+        extra["compiled_dag_us_per_call"] = round(dt * 1e6, 1)
+        log(f"[bench] compiled DAG round: {dt * 1e6:.0f} us/call ({1 / dt:,.0f}/s)")
+    except Exception as e:
+        log(f"[bench] compiled DAG bench failed: {e}")
+
     ray_tpu.shutdown()
 
 
@@ -213,6 +235,38 @@ def bench_tpu_train(extra):
             f"[bench] llama-nano train (flash path): {dt * 1e3:.1f} ms/step, "
             f"{B * T / dt:,.0f} tok/s/chip, {mfu * 100:.1f}% MFU (v5e peak)"
         )
+
+        # long-context: same model at 8k tokens — the flash kernel's
+        # O(T) memory + causal block skipping keep MFU up as attention
+        # grows toward the FLOPs share (long-context is first-class)
+        try:
+            Tl = 8192
+            assert kernel_supported(Tl, Tl, cfg.head_dim)
+            tokens_l = jax.random.randint(jax.random.PRNGKey(2), (1, Tl + 1), 0, cfg.vocab_size)
+            batch_l = shard_batch({"tokens": tokens_l})
+            for _ in range(3):
+                state, m = step_fn(state, batch_l)
+            float(m["loss"])
+
+            def run_l(n):
+                nonlocal state
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    state, m = step_fn(state, batch_l)
+                _ = float(m["loss"])
+                return time.perf_counter() - t0
+
+            dt_l = (run_l(12) - run_l(3)) / 9
+            fl_l = flops_per_token(cfg, Tl) * Tl
+            mfu_l = fl_l / dt_l / 197e12
+            extra["train_8k_tok_per_s_chip"] = round(Tl / dt_l, 0)
+            extra["train_8k_mfu_pct"] = round(mfu_l * 100, 1)
+            log(
+                f"[bench] llama-nano 8k-context train: {dt_l * 1e3:.1f} ms/step, "
+                f"{Tl / dt_l:,.0f} tok/s/chip, {mfu_l * 100:.1f}% MFU"
+            )
+        except Exception as e:
+            log(f"[bench] long-context bench skipped: {e}")
         return mfu
     except Exception as e:
         import traceback

@@ -18,4 +18,5 @@ from ray_tpu.data.read_api import (  # noqa: F401
     read_text,
     read_numpy,
     read_binary_files,
+    read_images,
 )

@@ -52,6 +52,9 @@ def block_to_batch(block: pa.Table, batch_format: str):
 
         out = {}
         for name, col in zip(block.column_names, block.columns):
+            if isinstance(col.type, pa.FixedShapeTensorType):
+                out[name] = col.combine_chunks().to_numpy_ndarray()
+                continue
             arr = np.asarray(col)
             if (
                 arr.dtype == object
@@ -80,7 +83,23 @@ def batch_to_block(batch) -> pa.Table:
     if isinstance(batch, pd.DataFrame):
         return pa.Table.from_pandas(batch, preserve_index=False)
     if isinstance(batch, dict):
-        return pa.table({k: (v if not isinstance(v, np.ndarray) else pa.array(list(v) if v.ndim > 1 else v)) for k, v in batch.items()})
+        def col(v):
+            if not isinstance(v, np.ndarray):
+                return v
+            if v.ndim == 1:
+                return pa.array(v)
+            if v.ndim == 2:
+                return pa.array(list(v))
+            # >=3-D tensor columns (images etc.): arrow's fixed-shape
+            # tensor type keeps the data one contiguous buffer. A size-1
+            # leading axis can carry stride 0 (arr[None] views), which
+            # numpy calls contiguous but arrow rejects — copy normalizes.
+            v = np.ascontiguousarray(v)
+            if 0 in v.strides:
+                v = v.copy()
+            return pa.FixedShapeTensorArray.from_numpy_ndarray(v)
+
+        return pa.table({k: col(v) for k, v in batch.items()})
     if isinstance(batch, list):
         return to_block(batch)
     raise TypeError(f"cannot convert batch of type {type(batch)} to a block")

@@ -267,11 +267,19 @@ class Dataset:
                            dtypes=None, drop_last: bool = False) -> Iterator[Any]:
         """Batches as dicts of torch tensors (reference:
         data/iterator.py iter_torch_batches). CPU torch by default."""
+        import numpy as np
         import torch
 
         for batch in self.iter_batches(batch_size=batch_size, batch_format="numpy", drop_last=drop_last):
             out = {}
             for k, v in batch.items():
+                if getattr(v, "dtype", None) is not None and v.dtype.kind in "OUS":
+                    out[k] = v  # strings/objects pass through untensored
+                    continue
+                if isinstance(v, np.ndarray) and not v.flags.writeable:
+                    # zero-copy arrow views are read-only; torch wants
+                    # ownership for in-place ops (normalize, augment)
+                    v = v.copy()
                 t = torch.as_tensor(v)
                 if dtypes and k in dtypes:
                     t = t.to(dtypes[k])

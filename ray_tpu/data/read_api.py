@@ -123,3 +123,37 @@ def read_numpy(paths, **kw) -> Dataset:
 
 def read_binary_files(paths, **kw) -> Dataset:
     return Dataset([_read_binary.remote(p) for p in _expand(paths)])
+
+
+_IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp", ".tiff")
+
+
+@ray_tpu.remote
+def _read_image(path, size):
+    """One image file -> a single-row block with an HWC uint8 image
+    column (reference: data/datasource/image_datasource.py). With a
+    fixed `size` the column is a contiguous fixed-shape tensor; without
+    one it is nested lists, since per-file shapes differ and fixed-shape
+    tensor blocks of different shapes cannot concatenate."""
+    import numpy as np
+    import pyarrow as pa
+    from PIL import Image
+
+    img = Image.open(path).convert("RGB")
+    if size is not None:
+        img = img.resize((size[1], size[0]))  # PIL takes (W, H)
+        arr = np.asarray(img, dtype=np.uint8)
+        return B.batch_to_block({"image": arr[None], "path": np.asarray([path])})
+    arr = np.asarray(img, dtype=np.uint8)
+    return pa.table({"image": pa.array([arr.tolist()]), "path": pa.array([path])})
+
+
+def read_images(paths, *, size=None, **kw) -> Dataset:
+    """Image dataset: one task per file, rows carry {"image", "path"}.
+    `size=(H, W)` resizes at read time so downstream batches stack into
+    contiguous NHWC uint8 tensors for device_put; without it, rows keep
+    their natural (ragged) shapes as nested lists. Non-image files in
+    the directory are skipped by extension (reference image datasource
+    filters the same way)."""
+    files = [p for p in _expand(paths) if p.lower().endswith(_IMAGE_EXTENSIONS)]
+    return Dataset([_read_image.remote(p, size) for p in files])
