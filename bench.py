@@ -267,6 +267,40 @@ def bench_tpu_train(extra):
             )
         except Exception as e:
             log(f"[bench] long-context bench skipped: {e}")
+
+        # inference: KV-cache decode throughput on the same model
+        try:
+            import functools
+
+            from ray_tpu.models import llama_decode
+
+            params = state["params"]
+            Bd, prompt_len, steps = 16, 128, 32
+            cache = llama_decode.init_cache(cfg, Bd, 1024)
+            prompt = jax.random.randint(jax.random.PRNGKey(5), (Bd, prompt_len), 0, cfg.vocab_size)
+            pre = jax.jit(functools.partial(llama_decode.prefill, cfg=cfg))
+            stepf = jax.jit(functools.partial(llama_decode.decode_step, cfg=cfg), donate_argnums=(1,))
+            logits, cache = pre(params, prompt, cache)
+            first = logits.argmax(axis=-1).astype("int32")
+            # device-side decode loop: ONE dispatch for all steps (the
+            # python step loop pays a relay dispatch per token here)
+            loop = jax.jit(
+                functools.partial(llama_decode.decode_loop, cfg=cfg, n_steps=steps),
+                donate_argnums=(1,),
+            )
+            tokens, cache = loop(params, cache, first)  # compile
+            jax.block_until_ready(tokens)
+            t0 = time.perf_counter()
+            tokens, cache = loop(params, cache, first)
+            jax.block_until_ready(tokens)
+            dt_d = (time.perf_counter() - t0) / steps
+            extra["decode_tok_per_s"] = round(Bd / dt_d, 0)
+            log(
+                f"[bench] KV-cache decode (B={Bd}, device-side loop): "
+                f"{dt_d * 1e3:.2f} ms/token, {Bd / dt_d:,.0f} tok/s"
+            )
+        except Exception as e:
+            log(f"[bench] decode bench skipped: {e}")
         return mfu
     except Exception as e:
         import traceback

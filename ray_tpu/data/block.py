@@ -26,7 +26,20 @@ def to_block(rows: List[Any]) -> pa.Table:
 
 
 def block_rows(block: pa.Table) -> List[Dict[str, Any]]:
-    return block.to_pylist()
+    tensor_cols = {
+        name: block.column(name).combine_chunks().to_numpy_ndarray()
+        for name, col in zip(block.column_names, block.columns)
+        if isinstance(col.type, pa.FixedShapeTensorType)
+    }
+    if not tensor_cols:
+        return block.to_pylist()
+    # to_pylist flattens fixed-shape tensor columns to their 1-D storage;
+    # substitute the properly-shaped per-row ndarrays
+    rows = block.drop_columns(list(tensor_cols)).to_pylist()
+    for name, arr in tensor_cols.items():
+        for i, row in enumerate(rows):
+            row[name] = arr[i]
+    return rows
 
 
 def block_size(block: pa.Table) -> int:

@@ -145,7 +145,9 @@ def _read_image(path, size):
         arr = np.asarray(img, dtype=np.uint8)
         return B.batch_to_block({"image": arr[None], "path": np.asarray([path])})
     arr = np.asarray(img, dtype=np.uint8)
-    return pa.table({"image": pa.array([arr.tolist()]), "path": pa.array([path])})
+    # explicit uint8 nesting: inference would widen the pixels to int64
+    u8_3d = pa.list_(pa.list_(pa.list_(pa.uint8())))
+    return pa.table({"image": pa.array([arr.tolist()], type=u8_3d), "path": pa.array([path])})
 
 
 def read_images(paths, *, size=None, **kw) -> Dataset:

@@ -1,0 +1,173 @@
+"""Llama autoregressive inference: KV-cache prefill + decode.
+
+The serving-side counterpart of models/llama.py (reference analogue:
+the reference serves LLMs through integrated engines inside Serve
+replicas — vLLM in examples — rather than in-tree; on TPU the engine
+IS the jitted jax program). TPU-first decode design:
+
+- Static shapes: the cache is (L, B, max_len, kv_heads, head_dim),
+  written with dynamic_update_slice at the current position; attention
+  masks positions beyond `pos` — one compiled decode step serves every
+  position, no recompiles.
+- One lax.scan over the stacked layer params per step (same O(1)
+  compile-depth trick as training), GQA via kv-head broadcast, bf16
+  compute with fp32 softmax/logits.
+- `prefill` runs the full training forward over the prompt while
+  capturing per-layer K/V as scan outputs — the prompt pass costs one
+  matmul-bound forward, not T decode steps.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.ops.normalization import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict[str, Any]:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _gqa_attend(q, k_cache, v_cache, pos, cfg: LlamaConfig):
+    """q: (B, 1, h, hd); caches: (B, S, kvh, hd); mask > pos."""
+    B, _, h, hd = q.shape
+    S = k_cache.shape[1]
+    groups = h // cfg.n_kv_heads
+    qf = q.astype(jnp.float32).reshape(B, h, hd)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+    # scores: (B, h, S) — broadcast q heads onto their kv group
+    qg = qf.reshape(B, cfg.n_kv_heads, groups, hd)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, kf) * (hd**-0.5)
+    mask = jnp.arange(S)[None, None, None, :] <= pos
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, vf)
+    return out.reshape(B, 1, h * hd).astype(cfg.dtype)
+
+
+def decode_step(params, cache, tokens, cfg: LlamaConfig):
+    """One token per sequence: tokens (B,) int32 → (logits (B, vocab),
+    updated cache). Jit with donate_argnums on the cache."""
+    B = tokens.shape[0]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    pos = cache["pos"]
+    x = params["embed"][tokens][:, None, :].astype(cfg.dtype)  # (B, 1, d)
+    cos, sin = rope_frequencies(hd, cache["k"].shape[2], cfg.rope_theta)
+    positions = jnp.full((B, 1), pos, jnp.int32)
+
+    def body(x, layer_and_cache):
+        layer, k_cache, v_cache = layer_and_cache
+        a = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = (a @ layer["wq"]).reshape(B, 1, h, hd)
+        k = (a @ layer["wk"]).reshape(B, 1, kvh, hd)
+        v = (a @ layer["wv"]).reshape(B, 1, kvh, hd)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        o = _gqa_attend(q, k_cache, v_cache, pos, cfg) @ layer["wo"]
+        x = x + o
+        m = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu((m @ layer["w_gate"]).astype(jnp.float32)).astype(cfg.dtype)
+        x = x + (gate * (m @ layer["w_up"])) @ layer["w_down"]
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x[:, 0, :], params["final_norm"], cfg.rms_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "pos": pos + 1}
+
+
+def prefill(params, tokens, cache, cfg: LlamaConfig):
+    """Prompt pass: tokens (B, T) → (last-position logits, cache filled
+    for positions [0, T))."""
+    B, T = tokens.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embed"][tokens].astype(cfg.dtype)
+    cos, sin = rope_frequencies(hd, cache["k"].shape[2], cfg.rope_theta)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+
+    from ray_tpu.ops.blockwise_attention import blockwise_attention
+
+    def body(x, layer):
+        a = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = (a @ layer["wq"]).reshape(B, T, h, hd)
+        k = (a @ layer["wk"]).reshape(B, T, kvh, hd)
+        v = (a @ layer["wv"]).reshape(B, T, kvh, hd)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        o = blockwise_attention(q, k, v, True, min(512, T)).reshape(B, T, h * hd)
+        x = x + o @ layer["wo"]
+        m = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu((m @ layer["w_gate"]).astype(jnp.float32)).astype(cfg.dtype)
+        x = x + (gate * (m @ layer["w_up"])) @ layer["w_down"]
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    # write prompt K/V into the cache at [0, T)
+    new_k = jax.lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0))
+    new_v = jax.lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0))
+    x = rms_norm(x[:, -1, :], params["final_norm"], cfg.rms_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "pos": jnp.asarray(T, jnp.int32)}
+
+
+def decode_loop(params, cache, first_token, n_steps: int, cfg: LlamaConfig):
+    """Greedy decode of `n_steps` tokens entirely on device: one jitted
+    lax.scan, zero host round-trips inside the loop — the TPU-native
+    serving inner loop (a python-level step loop pays a dispatch per
+    token, which over a relay dwarfs the compute). Returns
+    (tokens (B, n_steps), cache)."""
+
+    def body(carry, _):
+        cache, token = carry
+        logits, cache = decode_step(params, cache, token, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, nxt), nxt
+
+    (cache, _), tokens = jax.lax.scan(body, (cache, first_token), None, length=n_steps)
+    return jnp.moveaxis(tokens, 0, 1), cache
+
+
+def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int,
+             temperature: float = 0.0, rng=None, max_len: int = 0):
+    """Greedy (or sampled) generation. prompt: (B, T) int32 → (B,
+    max_new_tokens) int32. The decode step is jitted once and reused."""
+    import numpy as np
+
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, T = prompt.shape
+    S = max_len or min(cfg.max_seq_len, T + max_new_tokens)
+    cache = init_cache(cfg, B, S)
+    logits, cache = jax.jit(functools.partial(prefill, cfg=cfg))(params, prompt, cache)
+
+    if temperature <= 0:
+        # greedy: the whole decode runs as ONE device-side scan
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        loop = jax.jit(
+            functools.partial(decode_loop, cfg=cfg, n_steps=max_new_tokens - 1),
+            donate_argnums=(1,),
+        )
+        rest, _ = loop(params, cache, first)
+        return np.concatenate([np.asarray(first)[:, None], np.asarray(rest)], axis=1)
+
+    step = jax.jit(functools.partial(decode_step, cfg=cfg), donate_argnums=(1,))
+    out = []
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    for _ in range(max_new_tokens):
+        rng, k = jax.random.split(rng)
+        token = jax.random.categorical(k, logits / temperature, axis=-1)
+        out.append(np.asarray(token))
+        logits, cache = step(params, cache, token.astype(jnp.int32))
+    return np.stack(out, axis=1)

@@ -56,3 +56,20 @@ def test_orbax_checkpoint_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(back["params"]["w"]), np.arange(12.0).reshape(3, 4))
     np.testing.assert_array_equal(np.asarray(back["params"]["b"]), np.ones((4,)))
     assert int(np.asarray(back["step"])) == 7
+
+
+def test_tensor_rows_keep_shape(ray_start_regular, tmp_path):
+    """Row-based consumers (take/iter_rows) get properly-shaped HWC
+    arrays from tensor columns, not flattened storage lists."""
+    from PIL import Image
+
+    Image.fromarray(np.full((9, 7, 3), 5, np.uint8)).save(tmp_path / "a.png")
+    ds = ray_tpu.data.read_images(str(tmp_path), size=(4, 6))
+    row = ds.take_all()[0]
+    assert np.asarray(row["image"]).shape == (4, 6, 3)
+
+    # ragged path keeps uint8 pixels
+    ragged = ray_tpu.data.read_images(str(tmp_path))
+    r = ragged.take_all()[0]
+    arr = np.asarray(r["image"])
+    assert arr.shape == (9, 7, 3) and arr.dtype != np.int64 or arr.max() <= 255

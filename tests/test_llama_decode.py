@@ -1,0 +1,104 @@
+"""KV-cache inference matches the training forward, token for token."""
+import numpy as np
+import pytest
+
+
+def test_prefill_matches_forward():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama, llama_decode
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, attn_impl="blockwise", remat=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size)
+
+    full = llama.forward(params, tokens, cfg)  # (B, T, V)
+    cache = llama_decode.init_cache(cfg, 2, 32)
+    last, cache = llama_decode.prefill(params, tokens, cache, cfg)
+    np.testing.assert_allclose(np.asarray(last), np.asarray(full[:, -1, :]), rtol=2e-4, atol=2e-4)
+    assert int(cache["pos"]) == 12
+
+
+def test_decode_matches_forward_stepwise():
+    """Each decode_step's logits equal forward() on the growing prefix —
+    the KV cache is exact, not approximate."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama, llama_decode
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, attn_impl="blockwise", remat=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    B, T0, steps = 2, 5, 4
+    rng = jax.random.PRNGKey(2)
+    prompt = jax.random.randint(rng, (B, T0), 0, cfg.vocab_size)
+
+    cache = llama_decode.init_cache(cfg, B, 32)
+    logits, cache = llama_decode.prefill(params, prompt, cache, cfg)
+    seq = np.asarray(prompt)
+    for _ in range(steps):
+        token = np.argmax(np.asarray(logits), axis=-1)
+        seq = np.concatenate([seq, token[:, None]], axis=1)
+        ref = llama.forward(params, jnp.asarray(seq), cfg)
+        logits, cache = llama_decode.decode_step(params, cache, jnp.asarray(token), cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref[:, -1, :]), rtol=2e-3, atol=2e-3
+        )
+
+
+def test_generate_greedy_deterministic():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama, llama_decode
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, attn_impl="blockwise", remat=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 6), 0, cfg.vocab_size)
+
+    a = llama_decode.generate(params, prompt, cfg, max_new_tokens=6)
+    b = llama_decode.generate(params, prompt, cfg, max_new_tokens=6)
+    assert a.shape == (2, 6)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_llm_serving_deployment(ray_start_regular):
+    """An LLM generation endpoint: the replica owns jitted prefill+decode
+    and serves token generation (the TPU-serving shape for LMs)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class LlamaEndpoint:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.models import llama
+
+            self.cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, attn_impl="blockwise", remat=False)
+            self.params = llama.init_params(jax.random.PRNGKey(0), self.cfg)
+
+        def __call__(self, prompt_tokens):
+            import numpy as np
+
+            from ray_tpu.models import llama_decode
+
+            out = llama_decode.generate(
+                self.params, np.asarray([prompt_tokens]), self.cfg, max_new_tokens=8
+            )
+            return out[0].tolist()
+
+        def __del__(self):
+            pass
+
+    handle = serve.run(LlamaEndpoint.bind(), name="llm")
+    try:
+        tokens = handle.remote([1, 5, 9, 12]).result(timeout=120)
+        assert len(tokens) == 8 and all(0 <= t < 512 for t in tokens)
+        # deterministic greedy decode end to end
+        tokens2 = handle.remote([1, 5, 9, 12]).result(timeout=60)
+        assert tokens == tokens2
+    finally:
+        serve.delete("llm")
