@@ -140,29 +140,46 @@ def decode_loop(params, cache, first_token, n_steps: int, cfg: LlamaConfig):
     return jnp.moveaxis(tokens, 0, 1), cache
 
 
+@functools.lru_cache(maxsize=64)
+def _jitted_prefill(cfg: LlamaConfig):
+    return jax.jit(functools.partial(prefill, cfg=cfg))
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_decode_loop(cfg: LlamaConfig, n_steps: int):
+    return jax.jit(
+        functools.partial(decode_loop, cfg=cfg, n_steps=n_steps), donate_argnums=(1,)
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_decode_step(cfg: LlamaConfig):
+    return jax.jit(functools.partial(decode_step, cfg=cfg), donate_argnums=(1,))
+
+
 def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int,
              temperature: float = 0.0, rng=None, max_len: int = 0):
     """Greedy (or sampled) generation. prompt: (B, T) int32 → (B,
-    max_new_tokens) int32. The decode step is jitted once and reused."""
+    max_new_tokens) int32. Jitted callables are memoized per (cfg,
+    n_steps) — repeat calls with the same shapes hit XLA's compile
+    cache instead of rebuilding jit wrappers (a serving hot path)."""
     import numpy as np
 
     prompt = jnp.asarray(prompt, jnp.int32)
     B, T = prompt.shape
+    if T == 0:
+        raise ValueError("generate() requires a non-empty prompt")
     S = max_len or min(cfg.max_seq_len, T + max_new_tokens)
     cache = init_cache(cfg, B, S)
-    logits, cache = jax.jit(functools.partial(prefill, cfg=cfg))(params, prompt, cache)
+    logits, cache = _jitted_prefill(cfg)(params, prompt, cache)
 
     if temperature <= 0:
         # greedy: the whole decode runs as ONE device-side scan
         first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        loop = jax.jit(
-            functools.partial(decode_loop, cfg=cfg, n_steps=max_new_tokens - 1),
-            donate_argnums=(1,),
-        )
-        rest, _ = loop(params, cache, first)
+        rest, _ = _jitted_decode_loop(cfg, max_new_tokens - 1)(params, cache, first)
         return np.concatenate([np.asarray(first)[:, None], np.asarray(rest)], axis=1)
 
-    step = jax.jit(functools.partial(decode_step, cfg=cfg), donate_argnums=(1,))
+    step = _jitted_decode_step(cfg)
     out = []
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     for _ in range(max_new_tokens):

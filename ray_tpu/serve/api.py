@@ -174,7 +174,8 @@ def _get_batch_state(state_key) -> Dict[str, Any]:
     return st
 
 
-def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
+def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01,
+          wait_timeout_s: float = 300.0):
     """@serve.batch — coalesce concurrent calls into one batched call
     (reference: python/ray/serve/batching.py). The leader waits on a
     condition variable — woken early the instant the batch fills — rather
