@@ -399,11 +399,21 @@ class CoreWorker:
         set_ref_hooks(None)
 
         async def _aclose():
-            for c in self._peer_conns.values():
+            for c in list(self._peer_conns.values()):
                 await c.close()
             if self._gcs:
                 await self._gcs.close()
             self._listen_server.close()
+            # drain every task still on this loop (lease waiters, the
+            # ref-gc loop, server-side read loops) so loop.stop() doesn't
+            # strand pending tasks — the source of "Task was destroyed but
+            # it is pending!" showers at interpreter exit
+            cur = asyncio.current_task()
+            rest = [t for t in asyncio.all_tasks() if t is not cur]
+            for t in rest:
+                t.cancel()
+            if rest:
+                await asyncio.gather(*rest, return_exceptions=True)
 
         try:
             self._call(_aclose(), timeout=5)

@@ -176,7 +176,10 @@ class Connection:
         self._closed = True
         for fut in self._pending.values():
             if not fut.done():
-                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+                try:
+                    fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+                except RuntimeError:
+                    pass  # event loop already closed (late GC finalization)
         self._pending.clear()
         try:
             self.writer.close()
@@ -195,6 +198,11 @@ class Connection:
     async def close(self):
         if self._loop_task is not None:
             self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except BaseException:
+                pass  # CancelledError (or the loop's own error) — both fine
+            self._loop_task = None
         await self._teardown()
 
 

@@ -25,20 +25,52 @@ def to_block(rows: List[Any]) -> pa.Table:
     return pa.table({"item": list(rows)})
 
 
+def _list_leaf_dtype(t: pa.DataType):
+    """numpy dtype of a nested (depth>=2) list column's numeric leaf, else
+    None. Depth-1 list columns keep python-list row semantics; only
+    multi-dim ragged tensors (e.g. HWC images without a fixed size) are
+    rebuilt as ndarrays so the storage dtype survives to_pylist()."""
+    depth = 0
+    while pa.types.is_list(t) or pa.types.is_large_list(t):
+        t = t.value_type
+        depth += 1
+    if depth >= 2 and (pa.types.is_integer(t) or pa.types.is_floating(t)):
+        return t.to_pandas_dtype()
+    return None
+
+
 def block_rows(block: pa.Table) -> List[Dict[str, Any]]:
     tensor_cols = {
         name: block.column(name).combine_chunks().to_numpy_ndarray()
         for name, col in zip(block.column_names, block.columns)
         if isinstance(col.type, pa.FixedShapeTensorType)
     }
-    if not tensor_cols:
-        return block.to_pylist()
+    rows = (
+        block.drop_columns(list(tensor_cols)).to_pylist()
+        if tensor_cols
+        else block.to_pylist()
+    )
     # to_pylist flattens fixed-shape tensor columns to their 1-D storage;
     # substitute the properly-shaped per-row ndarrays
-    rows = block.drop_columns(list(tensor_cols)).to_pylist()
     for name, arr in tensor_cols.items():
         for i, row in enumerate(rows):
             row[name] = arr[i]
+    # nested-list numeric columns (ragged tensors): to_pylist() turned the
+    # values into python ints/floats, which np.asarray would widen to
+    # int64/float64 — rebuild per-row arrays with the arrow leaf dtype
+    for name, col in zip(block.column_names, block.columns):
+        if name in tensor_cols:
+            continue
+        dt = _list_leaf_dtype(col.type)
+        if dt is not None:
+            import numpy as np
+
+            for row in rows:
+                if row[name] is not None:
+                    try:
+                        row[name] = np.asarray(row[name], dtype=dt)
+                    except (ValueError, TypeError):
+                        pass  # ragged inner dims or nulls: keep nested lists
     return rows
 
 

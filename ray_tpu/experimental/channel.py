@@ -162,10 +162,14 @@ class Channel:
         mm = self._mm
         mm[_HDR.size : _HDR.size + len(payload)] = payload
         magic, seq, _, notify, _ = _HDR.unpack_from(mm, 0)
-        # publication order matters cross-process: payload, then len,
-        # then seq, then notify — a reader that sees the new seq is
-        # guaranteed a matching len+payload (x86 store ordering; the
-        # native writer orders its stores the same way)
+        # publication order matters cross-process: payload, then len, then
+        # seq, then notify — a reader that sees the new seq is guaranteed
+        # a matching len+payload under x86 total store order. On weaker
+        # architectures (aarch64) this pure-python fallback is UNSAFE for
+        # concurrent writers (no store barriers) — use the native library
+        # there, which orders stores with real barriers; the reader-side
+        # stable-seq re-check (read() below) narrows but cannot close the
+        # window.
         struct.pack_into("<Q", mm, 16, len(payload))
         struct.pack_into("<Q", mm, 8, seq + 1)
         struct.pack_into("<I", mm, 24, (notify + 1) & 0xFFFFFFFF)
@@ -196,8 +200,16 @@ class Channel:
         while True:
             magic, seq, ln, _, _ = _HDR.unpack_from(self._mm, 0)
             if seq > self._cursor:
+                payload = bytes(self._mm[_HDR.size : _HDR.size + ln])
+                # stable-seq re-check: if a concurrent write advanced seq
+                # (or the header stores reached us before the payload on a
+                # weakly-ordered machine), the snapshot may be torn — spin
+                # until two reads bracket an unchanged seq
+                _, seq2, ln2, _, _ = _HDR.unpack_from(self._mm, 0)
+                if seq2 != seq or ln2 != ln:
+                    continue
                 self._cursor = seq
-                return bytes(self._mm[_HDR.size : _HDR.size + ln])
+                return payload
             if deadline is not None and time.monotonic() > deadline:
                 raise ChannelTimeoutError(f"channel {self.path} idle for {timeout}s")
             time.sleep(delay)

@@ -59,6 +59,20 @@ class CompiledDAG:
                         f"compiled node {n._method!r} has no upstream inputs; "
                         "every actor node needs at least one DAGNode argument"
                     )
+        # one resident channel loop per actor: a second node on the same
+        # actor would queue behind the first loop forever (the loop owns
+        # the actor's executor), so execute() would hang until timeout
+        seen_actors: Dict[str, str] = {}
+        for n in order:
+            if isinstance(n, ActorMethodNode):
+                aid = n._handle._actor_id
+                if aid in seen_actors:
+                    raise ValueError(
+                        f"actor {n._handle} is used by two compiled nodes "
+                        f"({seen_actors[aid]!r} and {n._method!r}); each actor "
+                        "may appear in at most one node of a compiled DAG"
+                    )
+                seen_actors[aid] = n._method
 
         # one output channel per node; the input node's channel is the
         # driver's write side. Names use a process-monotonic counter —

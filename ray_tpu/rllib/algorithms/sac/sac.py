@@ -196,5 +196,18 @@ class SAC(DQN):
 
     config_class = SACConfig
 
+    def __init__(self, config):
+        if config.num_learners > 0:
+            # validate BEFORE super().__init__ spawns runner/learner actors:
+            # the lockstep path calls the base Learner.compute_grads (which
+            # has no SAC loss) and would skip SACLearner's target-net polyak
+            # and alpha updates even if it did not raise
+            raise ValueError(
+                "SAC requires the local learner (num_learners=0): target-net "
+                "polyak and alpha updates happen only inside SACLearner; a "
+                "distributed SAC step is not implemented yet"
+            )
+        super().__init__(config)
+
 
 SACConfig.algo_class = SAC

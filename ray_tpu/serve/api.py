@@ -234,7 +234,7 @@ def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.
                     with cond:
                         if not pending:
                             break
-            if not ev.wait(timeout=30):
+            if not ev.wait(timeout=wait_timeout_s):
                 raise TimeoutError("batched call timed out")
             if "error" in slot:
                 raise slot["error"]

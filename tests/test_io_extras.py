@@ -72,4 +72,6 @@ def test_tensor_rows_keep_shape(ray_start_regular, tmp_path):
     ragged = ray_tpu.data.read_images(str(tmp_path))
     r = ragged.take_all()[0]
     arr = np.asarray(r["image"])
-    assert arr.shape == (9, 7, 3) and arr.dtype != np.int64 or arr.max() <= 255
+    assert arr.shape == (9, 7, 3)
+    assert arr.dtype == np.uint8
+    assert arr.max() <= 255
