@@ -28,8 +28,11 @@ _DEFS: Dict[str, Any] = {
     # adds context-switch overhead (measured 15k vs 5.5k noop tasks/s on
     # a 1-core box with 2 vs 16 leases); logical num_cpus is admission
     # control and can legitimately exceed cores
-    "max_leases_per_shape": max(2, os.cpu_count() or 4),
-    "actor_call_batch_max": 16,  # pipelined actor calls coalesced per wire message
+    # (on a 1-core box a SECOND leased worker is pure context-switch
+    # overhead: measured 17.0k vs 10.0k noop tasks/s with 1 vs 2 leases)
+    "max_leases_per_shape": max(1, os.cpu_count() or 4),
+    "actor_call_batch_max": 32,  # pipelined actor calls coalesced per wire message
+    "direct_task_batch_max": 16,  # direct-path tasks coalesced per wire message
     "worker_pool_prestart": 2,
     "worker_pool_max_idle": 8,
     "scheduler_spread_threshold": 0.5,

@@ -44,13 +44,16 @@ MODE_WORKER = "worker"
 
 
 class _Cell:
-    """A pending object slot, waitable from both worlds."""
+    """A pending object slot, waitable from both worlds. The sync-side
+    Event is created LAZILY by the first thread that actually blocks
+    (most pipelined results arrive before anyone waits — an Event per
+    call was measurable on the fan-out hot path)."""
 
     __slots__ = ("env", "event", "waiters")
 
     def __init__(self):
         self.env = None
-        self.event = threading.Event()
+        self.event: Optional[threading.Event] = None
         self.waiters: List[asyncio.Future] = []
 
 
@@ -120,6 +123,11 @@ class CoreWorker:
         self._owned: set = set()  # oids this worker CREATED (owns)
         self._gcs_registered: set = set()  # owned oids the directory knows
         self._owned_flush_scheduled = False
+        # task-event buffer: direct-path task transitions accumulate here
+        # and flush to the GCS on a timer (reference: TaskEventBuffer,
+        # src/ray/core_worker/task_event_buffer.h:206)
+        self._task_events: List[Dict[str, Any]] = []
+        self._event_flush_scheduled = False
         # batched driver-thread → IO-loop posts: call_soon_threadsafe wakes
         # the loop through a self-pipe write (~20µs); one wakeup covers
         # every post made while the loop was busy
@@ -193,6 +201,37 @@ class CoreWorker:
     def _run_loop(self):
         asyncio.set_event_loop(self._loop)
         self._loop_ready.set()
+        prof_dir = os.environ.get("RAY_TPU_PROFILE_DIR")
+        if prof_dir and os.environ.get("RAY_TPU_PROFILE_WHAT", "ioloop") == "ioloop":
+            # dev-only: profile the IO loop thread (the control-plane hot
+            # loop) and dump when the loop stops at shutdown
+            import cProfile
+
+            prof = cProfile.Profile()
+            path = f"{prof_dir}/ioloop-{os.getpid()}-{self.mode}.prof"
+
+            def _periodic_dump():
+                # workers die by SIGKILL at cluster stop: dump on a timer
+                # (disable→dump→re-enable; cProfile can't snapshot live)
+                prof.disable()
+                try:
+                    prof.dump_stats(path)
+                except Exception:
+                    pass
+                prof.enable()
+                self._loop.call_later(3.0, _periodic_dump)
+
+            self._loop.call_later(3.0, _periodic_dump)
+            prof.enable()
+            try:
+                self._loop.run_forever()
+            finally:
+                prof.disable()
+                try:
+                    prof.dump_stats(path)
+                except Exception:
+                    pass
+            return
         self._loop.run_forever()
 
     def start(self):
@@ -399,6 +438,14 @@ class CoreWorker:
         set_ref_hooks(None)
 
         async def _aclose():
+            # last task-event flush so short-lived drivers still surface
+            # their direct-path events to the state API / timeline
+            if self._task_events and self._gcs is not None:
+                spans, self._task_events = self._task_events, []
+                try:
+                    await self._gcs.push("events.report", {"spans": spans})
+                except Exception:
+                    pass
             for c in list(self._peer_conns.values()):
                 await c.close()
             if self._gcs:
@@ -526,6 +573,62 @@ class CoreWorker:
                 self._pending[oid] = cell
             return cell
 
+    def _register_returns(self, returns: List[bytes]):
+        """Submit-path fast helper: mark each return oid pending AND owned
+        under a single lock acquisition (two lock round trips per call was
+        measurable at fan-out rates)."""
+        with self._store_lock:
+            pending = self._pending
+            for oid in returns:
+                if oid not in pending:
+                    pending[oid] = _Cell()
+            self._owned.update(returns)
+
+    def _cell_event(self, oid: bytes, cell: "_Cell") -> Optional[threading.Event]:
+        """Sync-waiter side of the lazy cell event: returns an Event to
+        wait on, or None if the result is already delivered. Created under
+        the store lock so a concurrent _deliver either sees the event (and
+        sets it) or has already published to the store (and we see that)."""
+        ev = cell.event
+        if ev is None:
+            with self._store_lock:
+                if cell.env is not None or oid in self._store:
+                    return None
+                ev = cell.event
+                if ev is None:
+                    ev = cell.event = threading.Event()
+        return ev
+
+    def _deliver_batch(self, oids, envs):
+        """Deliver a whole reply's results (parallel arrays, matching the
+        batched wire format) under ONE store-lock acquisition — the
+        per-oid path costs a lock round trip per result; replies carry up
+        to actor_call_batch_max of them."""
+        wake: List[_Cell] = []
+        special: List[Tuple[bytes, Dict[str, Any]]] = []
+        with self._store_lock:
+            for oid, env in zip(oids, envs):
+                oid = bytes(oid)
+                if oid in self._dropped:
+                    special.append((oid, env))
+                    continue
+                self._store[oid] = env
+                cell = self._pending.pop(oid, None)
+                if cell is not None:
+                    cell.env = env
+                    wake.append(cell)
+        for cell in wake:
+            if cell.event is not None:
+                cell.event.set()
+            for fut in cell.waiters:
+                if not fut.done():
+                    fut.get_loop().call_soon_threadsafe(
+                        lambda f=fut, e=cell.env: f.done() or f.set_result(e)
+                    )
+            cell.waiters.clear()
+        for oid, env in special:
+            self._deliver(oid, env)  # dropped-ref cleanup path (rare)
+
     def _deliver(self, oid: bytes, env: Dict[str, Any]):
         """Called on the IO loop (or any thread for local puts)."""
         with self._store_lock:
@@ -547,7 +650,8 @@ class CoreWorker:
             cell = self._pending.pop(oid, None)
         if cell is not None:
             cell.env = env
-            cell.event.set()
+            if cell.event is not None:
+                cell.event.set()
             for fut in cell.waiters:
                 if not fut.done():
                     fut.get_loop().call_soon_threadsafe(
@@ -826,9 +930,11 @@ class CoreWorker:
                 continue
             cell = self._pending.get(oid)
             if cell is not None:
-                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-                if not cell.event.wait(remaining):
-                    raise exceptions.GetTimeoutError(f"get timed out on {oid.hex()}")
+                ev = self._cell_event(oid, cell)
+                if ev is not None:
+                    remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                    if not ev.wait(remaining):
+                        raise exceptions.GetTimeoutError(f"get timed out on {oid.hex()}")
                 envs[i] = cell.env if cell.env is not None else self._store.get(oid)
             else:
                 slow.append(i)
@@ -871,7 +977,8 @@ class CoreWorker:
         self._submitted[respec["task_id"]] = {"spec": respec, "retries_left": respec.get("max_retries", 0)}
         self._call(self._gcs.request("task.submit", {"spec": respec}))
         cell = next(c for c, roid in zip(cells, respec["returns"]) if roid == oid)
-        if not cell.event.wait(timeout if timeout is not None else 300.0):
+        ev = self._cell_event(oid, cell)
+        if ev is not None and not ev.wait(timeout if timeout is not None else 300.0):
             raise exceptions.GetTimeoutError(f"reconstruction of {oid.hex()} timed out")
         env = cell.env if cell.env is not None else self._store.get(oid)
         if env is None or env.get("k") == "e":
@@ -969,7 +1076,12 @@ class CoreWorker:
         for a in args:
             packed.append(self._pack_one(a))
         packed_kw = {k: self._pack_one(v) for k, v in kwargs.items()}
-        return {"a": packed, "kw": packed_kw}
+        out = {"a": packed, "kw": packed_kw}
+        # "hr" (has refs) lets the hot paths (sender-loop dep scan, worker
+        # batch staging) skip per-call ref scans for the common ref-free call
+        if any("r" in p for p in packed) or any("r" in p for p in packed_kw.values()):
+            out["hr"] = 1
+        return out
 
     def _pack_one(self, value):
         if isinstance(value, ObjectRef):
@@ -995,6 +1107,8 @@ class CoreWorker:
         return {"r": oid}
 
     def unpack_args(self, packed: Dict[str, Any]):
+        if not packed["a"] and not packed["kw"]:
+            return (), {}
         args = [self._unpack_one(p) for p in packed["a"]]
         kwargs = {k: self._unpack_one(p) for k, p in packed["kw"].items()}
         return args, kwargs
@@ -1032,17 +1146,18 @@ class CoreWorker:
             "job_id": self.job_id,
             **(scheduling or {}),
         }
-        for oid in returns:
-            self._make_pending(oid)
-        with self._store_lock:
-            self._owned.update(returns)
+        self._register_returns(returns)
         self._submitted[spec["task_id"]] = {"spec": spec, "retries_left": spec.get("max_retries", 0)}
         if self._direct_eligible(spec):
-            deps = [
-                bytes(p["r"])
-                for p in list(spec["args"]["a"]) + list(spec["args"]["kw"].values())
-                if "r" in p
-            ]
+            deps = (
+                [
+                    bytes(p["r"])
+                    for p in list(spec["args"]["a"]) + list(spec["args"]["kw"].values())
+                    if "r" in p
+                ]
+                if spec["args"].get("hr")
+                else []
+            )
             if deps:
                 # resolve dependencies owner-side BEFORE pushing to a leased
                 # worker (reference: transport/dependency_resolver.cc). A
@@ -1159,6 +1274,25 @@ class CoreWorker:
         oids, self._owned_pending = self._owned_pending, []
         self._loop.create_task(self._gcs.push("obj.register_owned", {"oids": oids}))
 
+    def _schedule_event_flush(self, delay: float = 0.5):
+        """Loop-side: arm a single delayed flush of the task-event buffer
+        (coalesces an arbitrary number of task completions into one GCS
+        push every `delay` seconds; a full buffer flushes immediately so
+        sustained fan-out can't grow it unboundedly)."""
+        if len(self._task_events) >= 4096:
+            self._flush_events()
+            return
+        if not self._event_flush_scheduled:
+            self._event_flush_scheduled = True
+            self._loop.call_later(delay, self._flush_events)
+
+    def _flush_events(self):
+        self._event_flush_scheduled = False
+        if not self._task_events or self._closed:
+            return
+        spans, self._task_events = self._task_events, []
+        self._loop.create_task(self._gcs.push("events.report", {"spans": spans}))
+
     def _direct_submit(self, spec):
         """Loop-side: enqueue on the shape queue and size the lease pool.
         Return oids are NOT registered with the directory here — results
@@ -1263,7 +1397,7 @@ class CoreWorker:
             while True:
                 while st.queue and len(window) < 4:
                     batch = []
-                    while st.queue and len(batch) < 8:
+                    while st.queue and len(batch) < RayConfig.direct_task_batch_max:
                         spec = st.queue.popleft()
                         if spec.get("cancelled"):
                             self._fail_call(spec, exceptions.TaskCancelledError(spec.get("name", "")))
@@ -1274,10 +1408,30 @@ class CoreWorker:
                     if not batch:
                         break
                     try:
+                        # slim wire copy: the executor only needs these keys
+                        # (resources/max_retries/owner_addr are owner-side
+                        # bookkeeping; the full spec stays in _submitted for
+                        # retries and the GCS fallback)
+                        wire = [
+                            {
+                                "task_id": s["task_id"],
+                                "fn_id": s["fn_id"],
+                                "name": s["name"],
+                                "args": s["args"],
+                                "returns": s["returns"],
+                                "job_id": s["job_id"],
+                                **(
+                                    {"runtime_env": s["runtime_env"]}
+                                    if s.get("runtime_env")
+                                    else {}
+                                ),
+                            }
+                            for s in batch
+                        ]
                         if len(batch) == 1:
-                            fut = await conn.request_send("call.task", {"spec": batch[0]})
+                            fut = await conn.request_send("call.task", {"spec": wire[0]})
                         else:
-                            fut = await conn.request_send("call.tasks", {"specs": batch})
+                            fut = await conn.request_send("call.tasks", {"specs": wire})
                     except (protocol.ConnectionLost, OSError):
                         await _worker_died(batch)
                         return  # lease is dead (raylet reap credits the resources)
@@ -1305,23 +1459,20 @@ class CoreWorker:
                 for spec in batch:
                     self._direct_inflight.pop(spec["task_id"], None)
                     self._record_lineage(spec["task_id"])
-                for item in reply["results"]:
-                    self._deliver(bytes(item["oid"]), item["env"])
+                self._deliver_batch(reply["o"], reply["e"])
                 # direct tasks never touch the GCS scheduler — report their
-                # events here so the timeline / state API still sees them
-                # (reference: TaskEventBuffer flushing from every worker,
-                # task_event_buffer.h:206); one batched push per reply,
-                # with the worker-measured execution windows
+                # events so the timeline / state API still sees them. Events
+                # are BUFFERED and flushed on a timer (reference:
+                # TaskEventBuffer periodic flush, task_event_buffer.h:206) —
+                # a per-reply GCS push put event encode/decode work on the
+                # fan-out hot path in both this process and the GCS.
                 now = time.time()
                 timings = reply.get("timings") or {}
-                events = []
+                buf = self._task_events
                 for spec in batch:
                     t0, t1 = timings.get(spec["task_id"], (now, now))
-                    events.append({"task_id": spec["task_id"], "name": spec.get("name", ""),
-                                   "state": "RUNNING", "time": t0, "actor_id": None})
-                    events.append({"task_id": spec["task_id"], "name": spec.get("name", ""),
-                                   "state": "FINISHED", "time": t1, "actor_id": None})
-                self._loop.create_task(self._gcs.push("events.report", {"events": events}))
+                    buf.append((spec["task_id"], spec.get("name", ""), t0, t1))
+                self._schedule_event_flush()
         finally:
             st.leases.discard(lease_id)
             try:
@@ -1410,30 +1561,26 @@ class CoreWorker:
         num_returns: int = 1,
         max_task_retries: int = 0,
     ) -> List[ObjectRef]:
-        task_id = hex_id(new_id())
         returns = [new_id() for _ in range(num_returns)]
+        # slim spec — no task_id (returns[0] is the call's identity: actor
+        # calls are not individually cancellable/retryable-by-id), no
+        # actor_id (the sender loop is per-actor), no caller/job_id (the
+        # actor worker is bound to its job at creation; reference: direct
+        # actor transport needs only method+args+seq)
         spec = {
-            "task_id": task_id,
-            "actor_id": actor_id,
             "method": method_name,
             "args": self.pack_args(args, kwargs),
             "returns": returns,
-            "caller": self.client_id,
-            "job_id": self.job_id,
         }
-        for oid in returns:
-            self._make_pending(oid)
-        with self._store_lock:
-            self._owned.update(returns)
+        self._register_returns(returns)
         # fire-and-forget enqueue: the caller holds refs whose cells are
         # already waitable; the loop does the sending
-        self._post(lambda: self._enqueue_actor_call(spec, max_task_retries))
+        self._post(lambda: self._enqueue_actor_call(actor_id, spec, max_task_retries))
         return [ObjectRef(oid) for oid in returns]
 
-    def _enqueue_actor_call(self, spec, retries_left: int):
+    def _enqueue_actor_call(self, actor_id: str, spec, retries_left: int):
         import collections
 
-        actor_id = spec["actor_id"]
         q = self._actor_queues.setdefault(actor_id, collections.deque())
         q.append((spec, retries_left))
         sender = self._actor_senders.get(actor_id)
@@ -1506,6 +1653,8 @@ class CoreWorker:
             # deadlock. Such calls go out as singletons — their worker-side
             # resolve then overlaps with earlier in-flight replies.
             def _has_pending_dep(s):
+                if not s["args"].get("hr"):
+                    return False  # ref-free call (the common case): no scan
                 with self._store_lock:
                     return any(
                         "r" in p and bytes(p["r"]) in self._pending and bytes(p["r"]) in self._owned
@@ -1547,8 +1696,8 @@ class CoreWorker:
             for spec, retries_left in batch:
                 loop.create_task(self._actor_reply_failed(actor_id, spec, retries_left, exc))
             return
-        for item in fut.result()["results"]:
-            self._deliver(bytes(item["oid"]), item["env"])
+        r = fut.result()
+        self._deliver_batch(r["o"], r["e"])
 
     async def _actor_reply_failed(self, actor_id: str, spec, retries_left: int, exc):
         if isinstance(exc, protocol.RpcError):
@@ -1571,12 +1720,11 @@ class CoreWorker:
                 ),
             )
             return
-        await self._asubmit_actor_requeue(spec, retries_left - 1)
+        await self._asubmit_actor_requeue(actor_id, spec, retries_left - 1)
 
-    async def _asubmit_actor_requeue(self, spec, retries_left: int):
+    async def _asubmit_actor_requeue(self, actor_id: str, spec, retries_left: int):
         import collections
 
-        actor_id = spec["actor_id"]
         q = self._actor_queues.setdefault(actor_id, collections.deque())
         q.append((spec, retries_left))
         sender = self._actor_senders.get(actor_id)

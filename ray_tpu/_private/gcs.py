@@ -1277,7 +1277,18 @@ class GcsServer:
         )
 
     async def _rpc_events_report(self, d, conn):
-        self.task_events.extend(d["events"])
+        self.task_events.extend(d.get("events", ()))
+        # "spans" is the compact direct-path form: one [task_id, name, t0,
+        # t1] entry per finished task, expanded into the two transition
+        # events here — the GCS is idle during fan-out bursts, the owner's
+        # hot loop is not
+        for tid, name, t0, t1 in d.get("spans", ()):
+            self.task_events.append(
+                {"task_id": tid, "name": name, "state": "RUNNING", "time": t0, "actor_id": None}
+            )
+            self.task_events.append(
+                {"task_id": tid, "name": name, "state": "FINISHED", "time": t1, "actor_id": None}
+            )
         return True
 
     async def _rpc_state_tasks(self, d, conn):

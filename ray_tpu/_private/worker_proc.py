@@ -33,6 +33,11 @@ from ray_tpu._private.core_worker import CoreWorker, _env_err, _env_inline
 logger = logging.getLogger("ray_tpu.worker")
 
 
+import contextlib
+
+_NULL_OVERLAY = contextlib.nullcontext()
+
+
 def _cancelled_envs(spec):
     """One TaskCancelledError envelope per return oid of `spec`."""
     name = spec.get("name", "")
@@ -63,6 +68,12 @@ class Executor:
         self._current_task_id: Optional[str] = None
         self._current_thread: Optional[threading.Thread] = None
         self._cancelled: set = set()
+        self._coro_cache: Dict[str, bool] = {}  # method/fn_id -> iscoroutinefunction
+        self._exec_prof = None
+        if os.environ.get("RAY_TPU_PROFILE_DIR") and os.environ.get("RAY_TPU_PROFILE_WHAT") == "exec":
+            import cProfile
+
+            self._exec_prof = cProfile.Profile()
 
     # ------------------------------------------------------------- execution
     async def execute_task(self, spec: Dict[str, Any]) -> Dict[str, Any]:
@@ -109,21 +120,19 @@ class Executor:
         travel back in the reply (no raylet, no GCS on this path)."""
         spec = data["spec"]
         if spec.get("cancelled") or spec["task_id"] in self._cancelled:
-            return {"results": [{"oid": oid, "env": env} for oid, env in zip(spec["returns"], _cancelled_envs(spec))]}
+            return {"o": spec["returns"], "e": _cancelled_envs(spec)}
         envs = await self._run_user_function(spec)
-        return {"results": [{"oid": oid, "env": env} for oid, env in zip(spec["returns"], envs)]}
+        return {"o": spec["returns"], "e": envs}
 
     async def handle_direct_tasks(self, data) -> Dict[str, Any]:
         """Batch of direct tasks from one lease drain: one executor hop
         runs them all sequentially (normal tasks are always sync here)."""
-        results = []
+        oids, out_envs = [], []
         runnable = []
         for spec in data["specs"]:
             if spec.get("cancelled") or spec["task_id"] in self._cancelled:
-                results.extend(
-                    {"oid": oid, "env": env}
-                    for oid, env in zip(spec["returns"], _cancelled_envs(spec))
-                )
+                oids.extend(spec["returns"])
+                out_envs.extend(_cancelled_envs(spec))
             else:
                 runnable.append(spec)
         timings = {}
@@ -134,10 +143,11 @@ class Executor:
             )
             timings = getattr(self, "_batch_timings", {})
             for spec, envs in zip(runnable, env_lists):
-                results.extend({"oid": oid, "env": env} for oid, env in zip(spec["returns"], envs))
+                oids.extend(spec["returns"])
+                out_envs.extend(envs)
         # real execution windows so the owner can report honest timeline
         # events for the direct path
-        return {"results": results, "timings": timings}
+        return {"o": oids, "e": out_envs, "timings": timings}
 
     async def handle_actor_call(self, data, conn) -> Dict[str, Any]:
         """Direct actor invocation. Calls from one caller arrive in
@@ -148,7 +158,7 @@ class Executor:
         spec = data["spec"]
         async with self.actor_semaphore:
             envs = await self._run_user_function(spec, actor=True)
-        return {"results": [{"oid": oid, "env": env} for oid, env in zip(spec["returns"], envs)]}
+        return {"o": spec["returns"], "e": envs}
 
     async def handle_actor_calls(self, data, conn) -> Dict[str, Any]:
         """Batched pipelined calls from one caller. A strictly-serial sync
@@ -165,16 +175,16 @@ class Executor:
                     self.pool, self._exec_sync_batch, specs, True, loop
                 )
             return {
-                "results": [
-                    {"oid": oid, "env": env}
-                    for s, envs in zip(specs, env_lists)
-                    for oid, env in zip(s["returns"], envs)
-                ]
+                "o": [oid for s in specs for oid in s["returns"]],
+                "e": [env for envs in env_lists for env in envs],
             }
         replies = await asyncio.gather(
             *(self.handle_actor_call({"spec": spec}, conn) for spec in specs)
         )
-        return {"results": [item for r in replies for item in r["results"]]}
+        return {
+            "o": [oid for r in replies for oid in r["o"]],
+            "e": [env for r in replies for env in r["e"]],
+        }
 
     def _ensure_user_loop(self) -> asyncio.AbstractEventLoop:
         if self._user_loop is None:
@@ -202,6 +212,8 @@ class Executor:
         out = []
         staged = []
         self._batch_timings = {}
+        if self._exec_prof is not None:
+            self._exec_prof.enable()
         try:
             for spec in specs:
                 appended = False
@@ -210,7 +222,7 @@ class Executor:
                     envs = self._exec_sync_one(spec, actor, loop)
                     out.append(envs)
                     appended = True
-                    self._batch_timings[spec["task_id"]] = (t0, _time.time())
+                    self._batch_timings[spec.get("task_id") or spec["returns"][0]] = (t0, _time.time())
                     for oid, env in zip(spec["returns"], envs):
                         self.core._deliver(bytes(oid), env)
                         staged.append(bytes(oid))
@@ -223,6 +235,13 @@ class Executor:
                         out.append(_cancelled_envs(spec))
             return out
         finally:
+            if self._exec_prof is not None:
+                self._exec_prof.disable()
+                self._exec_batches = getattr(self, "_exec_batches", 0) + 1
+                if self._exec_batches % 50 == 0:  # dumping per batch would swamp the run
+                    self._exec_prof.dump_stats(
+                        os.environ["RAY_TPU_PROFILE_DIR"] + f"/exec-{os.getpid()}.prof"
+                    )
             while staged:
                 try:
                     self.core._store.pop(staged.pop(), None)
@@ -234,13 +253,16 @@ class Executor:
         serialize → error conversion. Runs on a pool thread so pipelined
         batches can share a single loop⇄thread round trip."""
         name = spec.get("name") or spec.get("method", "?")
+        # actor-call specs are slim (no task_id): the first return oid is
+        # the call's identity for cancel bookkeeping and batch timings
+        tid = spec.get("task_id") or spec["returns"][0]
         try:
             # the task that owns the pool thread is the one cancel() can
             # interrupt, so both fields are set HERE, on that thread
             self._current_thread = threading.current_thread()
-            self._current_task_id = spec["task_id"]
+            self._current_task_id = tid
             try:
-                if spec["task_id"] in self._cancelled:
+                if tid in self._cancelled:
                     raise exceptions.TaskCancelledError(spec.get("name", ""))
                 from ray_tpu._private.runtime_env import ensure_job_env, env_overlay
 
@@ -248,8 +270,13 @@ class Executor:
                 # the job's first task — prestarted workers boot before
                 # the publish); env_vars and working_dir overlay around
                 # THIS execution only, since pooled workers serve many
-                # jobs and nothing may leak across them
-                job_env = ensure_job_env(self.core, self.core.session_dir, spec.get("job_id"))
+                # jobs and nothing may leak across them. Actor workers are
+                # bound to their job at CREATION (env applied permanently,
+                # _create_actor) — per-call re-overlay would be redundant.
+                job_env = (
+                    {} if actor
+                    else ensure_job_env(self.core, self.core.session_dir, spec.get("job_id"))
+                )
                 if actor:
                     if spec["method"] == "__ray_tpu_channel_loop__":
                         # compiled-DAG resident loop (experimental/
@@ -270,8 +297,17 @@ class Executor:
                 merged_env = {**job_env.get("env_vars", {}),
                               **((spec.get("runtime_env") or {}).get("env_vars") or {})}
 
-                with env_overlay(merged_env, cwd=job_env.get("cwd")):
-                    if inspect.iscoroutinefunction(fn):
+                overlay = (
+                    env_overlay(merged_env, cwd=job_env.get("cwd"))
+                    if merged_env or job_env.get("cwd")
+                    else _NULL_OVERLAY  # hot path: nothing to apply/restore
+                )
+                fn_key = spec.get("method") if actor else spec["fn_id"]
+                is_coro = self._coro_cache.get(fn_key)
+                if is_coro is None:
+                    is_coro = self._coro_cache[fn_key] = inspect.iscoroutinefunction(fn)
+                with overlay:
+                    if is_coro:
                         import asyncio as _a
 
                         # run on the user loop, not the CoreWorker loop: the
@@ -296,7 +332,7 @@ class Executor:
             # TaskCancelledError.
             tb = traceback.format_exc()
             logger.info("task %s failed: %s", name, tb)
-            if isinstance(e, (KeyboardInterrupt,)) or spec["task_id"] in self._cancelled:
+            if isinstance(e, (KeyboardInterrupt,)) or tid in self._cancelled:
                 return _cancelled_envs(spec)
             return [_env_err(e, name)] * len(spec["returns"])
 
@@ -325,7 +361,8 @@ class Executor:
         except (Exception, KeyboardInterrupt) as e:
             tb = traceback.format_exc()
             logger.info("task %s failed: %s", name, tb)
-            if isinstance(e, (KeyboardInterrupt,)) or spec["task_id"] in self._cancelled:
+            tid = spec.get("task_id") or spec["returns"][0]
+            if isinstance(e, (KeyboardInterrupt,)) or tid in self._cancelled:
                 return _cancelled_envs(spec)
             return [_env_err(e, name)] * len(spec["returns"])
 
@@ -508,6 +545,10 @@ async def _amain():
             executor.cancel(data["task_id"], data.get("force", False))
             return True
         if method == "exec.shutdown":
+            prof = globals().get("_worker_profile")
+            if prof is not None:  # WHAT=main mode; ioloop/exec modes dump on timers
+                prof.disable()
+                prof.dump_stats(os.environ["RAY_TPU_PROFILE_DIR"] + f"/worker-{os.getpid()}.prof")
             os._exit(0)
         raise ValueError(f"unknown method {method}")
 
@@ -519,6 +560,15 @@ async def _amain():
 
 def main():
     logging.basicConfig(level=logging.INFO)
+    if os.environ.get("RAY_TPU_PROFILE_DIR") and os.environ.get("RAY_TPU_PROFILE_WHAT") == "main":
+        # dev-only worker profiling: dump per-pid cProfile stats at
+        # graceful shutdown (driven by bench/profiling scripts). Only one
+        # cProfile may be active per process — RAY_TPU_PROFILE_WHAT picks
+        # the thread (main | ioloop | exec).
+        import cProfile
+
+        globals()["_worker_profile"] = prof = cProfile.Profile()
+        prof.enable()
     asyncio.run(_amain())
 
 

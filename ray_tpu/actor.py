@@ -45,6 +45,7 @@ class ActorHandle:
         self._class_name = class_name
         self._method_meta = method_meta
         self._max_task_retries = max_task_retries
+        self._methods: Dict[str, ActorMethod] = {}  # per-name cache (hot path)
 
     @property
     def _id(self):
@@ -67,7 +68,10 @@ class ActorHandle:
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name, self._method_meta.get(name, 1))
+        m = self._methods.get(name)
+        if m is None:
+            m = self._methods[name] = ActorMethod(self, name, self._method_meta.get(name, 1))
+        return m
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id[:12]})"

@@ -52,6 +52,8 @@ class RemoteFunction:
         self._opts = default_opts
         self._fn_id: Optional[str] = None
         self._exported_by: Optional[int] = None
+        self._resources: Optional[Dict[str, float]] = None
+        self._scheduling: Optional[Dict[str, Any]] = None
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -74,6 +76,11 @@ class RemoteFunction:
         if self._fn_id is None or self._exported_by != id(core):
             self._fn_id = core.export_function(self._fn)
             self._exported_by = id(core)
+        if self._resources is None:
+            # options are immutable per RemoteFunction instance: normalize
+            # once instead of rebuilding dicts per call
+            self._resources = _normalize_resources(self._opts)
+            self._scheduling = _scheduling_fields(self._opts)
         num_returns = self._opts.get("num_returns", 1)
         refs = core.submit_task(
             fn_id=self._fn_id,
@@ -81,9 +88,9 @@ class RemoteFunction:
             kwargs=kwargs,
             name=self._opts.get("name", self._fn.__name__),
             num_returns=num_returns,
-            resources=_normalize_resources(self._opts),
+            resources=self._resources,
             max_retries=self._opts.get("max_retries"),
-            scheduling=_scheduling_fields(self._opts),
+            scheduling=self._scheduling,
         )
         return refs[0] if num_returns == 1 else refs
 
