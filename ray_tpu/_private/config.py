@@ -18,6 +18,11 @@ _DEFS: Dict[str, Any] = {
     "object_store_memory_bytes": 2 * 1024**3,  # default shm arena size
     "object_store_inline_max_bytes": 100 * 1024,  # small objects ride the control plane
     "object_store_fallback_directory": "/tmp/ray_tpu/spill",
+    # pre-commit the arena's tmpfs pages at open: first-touch faults cost
+    # ~2.7x raw memcpy bandwidth on the put path (plasma preallocates the
+    # same way). Disable (RAY_TPU_OBJECT_STORE_PREFAULT=0) to keep lazy
+    # allocation on memory-tight nodes with mostly-idle stores.
+    "object_store_prefault": True,
     "object_spilling_threshold": 0.8,
     "object_chunk_size_bytes": 4 * 1024**2,  # node-to-node transfer chunking
     # --- scheduler ---

@@ -533,7 +533,7 @@ class CoreWorker:
         if method == "call.tasks":
             if self.executor is None:
                 raise RuntimeError("not an executor worker")
-            return await self.executor.handle_direct_tasks(data)
+            return await self.executor.handle_direct_tasks(data, conn)
         if method == "exec.cancel":
             if self.executor is not None:
                 self.executor.cancel(data["task_id"], data.get("force", False))
@@ -1094,9 +1094,7 @@ class CoreWorker:
             self._ensure_registered([r.binary() for r in refs])
         total = serialization.serialized_size(pickled, buffers)
         if total <= RayConfig.object_store_inline_max_bytes or self._shm is None:
-            data = bytearray(total)
-            n = serialization.write_to(memoryview(data), pickled, buffers)
-            return {"v": bytes(data[:n])}
+            return {"v": serialization.to_wire(pickled, buffers)}
         # large arg → promote to an owned shm object, pass by ref
         oid = new_id()
         env = self.put_serialized_to_shm(oid, pickled, buffers)
@@ -1132,8 +1130,11 @@ class CoreWorker:
         max_retries: Optional[int] = None,
         scheduling: Optional[Dict[str, Any]] = None,
     ) -> List[ObjectRef]:
-        task_id = hex_id(new_id())
-        returns = [new_id() for _ in range(num_returns)]
+        # one urandom read yields the task id and (single-return case) the
+        # return oid — syscalls are visible at fan-out submission rates
+        rnd = os.urandom(16 * (1 + num_returns))
+        task_id = hex_id(rnd[:16])
+        returns = [rnd[16 * (i + 1) : 16 * (i + 2)] for i in range(num_returns)]
         spec = {
             "task_id": task_id,
             "fn_id": fn_id,

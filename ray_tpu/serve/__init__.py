@@ -9,6 +9,7 @@ FastAPI/uvicorn there, aiohttp here since that's what the image ships).
 TPU replicas are actors with num_tpus chips running jitted inference.
 """
 from ray_tpu.serve.api import (  # noqa: F401
+    Application,
     batch,
     delete,
     deployment,
@@ -18,5 +19,8 @@ from ray_tpu.serve.api import (  # noqa: F401
     shutdown,
     status,
 )
+from ray_tpu.serve.config import build_app, deploy_config  # noqa: F401
+from ray_tpu.serve.grpc_proxy import start_grpc_proxy  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401
+from ray_tpu.serve.ingress import ingress, route  # noqa: F401
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401

@@ -38,6 +38,11 @@ def _get_controller(create: bool = False):
 
 
 class Application:
+    """A bound deployment, possibly with other bound deployments among its
+    init args — the deployment-graph form (reference: serve deployment
+    graphs / model composition, serve/api.py build + handle passing:
+    children deploy first and the parent receives DeploymentHandles)."""
+
     def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
         self.deployment = deployment
         self.init_args = args
@@ -93,29 +98,59 @@ def deployment(_cls=None, **kwargs):
     return wrap
 
 
-def run(app: Application, *, name: str = "default", route_prefix: Optional[str] = "/") -> DeploymentHandle:
-    """Deploy an application (reference: serve/api.py:439)."""
+def _deploy_tree(controller, app_name: str, app: Application, *, is_root: bool,
+                 root_prefix: Optional[str], seen: Dict[int, str]) -> str:
+    """Post-order deploy of a deployment graph: children first, each
+    Application arg replaced by a handle marker the Replica resolves at
+    init (reference: deployment graphs — serve handles passed into
+    constructors)."""
     import cloudpickle
 
-    controller = _get_controller(create=True)
+    if id(app) in seen:  # diamond: same bound child used twice
+        return seen[id(app)]
     dep = app.deployment
-    prefix = dep.route_prefix if dep.route_prefix is not None else route_prefix
+
+    def _resolve(v):
+        if isinstance(v, Application):
+            child = _deploy_tree(
+                controller, app_name, v, is_root=False, root_prefix=None, seen=seen
+            )
+            return {"__serve_handle__": [app_name, child]}
+        return v
+
+    init_args = tuple(_resolve(a) for a in app.init_args)
+    init_kwargs = {k: _resolve(v) for k, v in app.init_kwargs.items()}
+    prefix = None
+    if is_root:
+        prefix = dep.route_prefix if dep.route_prefix is not None else root_prefix
     ray_tpu.get(
         controller.deploy.remote(
-            name,
+            app_name,
             dep.name,
             cloudpickle.dumps(dep._callable),
-            app.init_args,
-            app.init_kwargs,
+            init_args,
+            init_kwargs,
             dep.num_replicas,
             prefix,
             dep.ray_actor_options,
             dep.autoscaling_config,
+            bool(getattr(dep._callable, "__serve_is_ingress__", False)),
         )
+    )
+    seen[id(app)] = dep.name
+    return dep.name
+
+
+def run(app: Application, *, name: str = "default", route_prefix: Optional[str] = "/") -> DeploymentHandle:
+    """Deploy an application — a single bound deployment or a whole
+    deployment graph (reference: serve/api.py:439)."""
+    controller = _get_controller(create=True)
+    root = _deploy_tree(
+        controller, name, app, is_root=True, root_prefix=route_prefix, seen={}
     )
     # fire-and-forget: the controller's reconcile/autoscale loop (idempotent)
     controller.run_control_loop.remote()
-    handle = DeploymentHandle(dep.name, name)
+    handle = DeploymentHandle(root, name)
     handle._refresh()
     return handle
 

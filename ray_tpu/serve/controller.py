@@ -32,6 +32,19 @@ class Replica:
         import inspect
         import threading
 
+        def _resolve(v):
+            # handle markers from deployment graphs → live handles
+            if isinstance(v, dict) and "__serve_handle__" in v:
+                from ray_tpu.serve.handle import DeploymentHandle
+
+                app_name, dep_name = v["__serve_handle__"]
+                h = DeploymentHandle(dep_name, app_name)
+                h._refresh()
+                return h
+            return v
+
+        init_args = tuple(_resolve(a) for a in init_args)
+        init_kwargs = {k: _resolve(v) for k, v in init_kwargs.items()}
         if inspect.isclass(cls_or_fn):
             self.instance = cls_or_fn(*init_args, **init_kwargs)
         else:
@@ -153,6 +166,7 @@ class ServeControllerActor:
         route_prefix: Optional[str],
         ray_actor_options: Optional[dict] = None,
         autoscaling_config: Optional[dict] = None,
+        is_ingress: bool = False,
     ):
         import cloudpickle
 
@@ -168,6 +182,7 @@ class ServeControllerActor:
             "route_prefix": route_prefix,
             "ray_actor_options": dict(ray_actor_options or {}),
             "autoscaling": autoscaling_config,
+            "is_ingress": is_ingress,
             "deploy_time": time.time(),
         }
         if autoscaling_config:
@@ -192,14 +207,17 @@ class ServeControllerActor:
             raise
         app[deployment_name] = rec
         if old:
+            # versioned in-place upgrade: the new replica set is healthy
+            # and published FIRST (long-poll bump below swaps handles and
+            # proxies over), then old replicas DRAIN their in-flight
+            # requests before dying — a config redeploy must not drop
+            # requests (reference: serve rolling updates +
+            # graceful_shutdown_wait_loop_s)
             for name in old["replicas"]:
                 if name not in rec["replicas"]:
-                    try:
-                        ray_tpu.kill(ray_tpu.get_actor(name))
-                    except Exception:
-                        pass
+                    asyncio.ensure_future(self._drain_and_kill(name))
         if route_prefix:
-            self.routes[route_prefix] = (app_name, deployment_name)
+            self.routes[route_prefix] = (app_name, deployment_name, is_ingress)
             self._bump("routes")
         self._bump(f"replicas::{app_name}::{deployment_name}")
         return True

@@ -1,0 +1,141 @@
+"""gRPC proxy for Serve.
+
+Equivalent of the reference's gRPC proxy (reference:
+serve/_private/proxy.py:542 gRPCProxy — a grpc.aio server sharing the
+HTTP proxy's routing/handle layer). Without protoc-generated stubs in
+the image, the service is a generic bytes-in/bytes-out handler with a
+msgpack envelope — the same routing table (controller long-poll) and the
+same DeploymentHandle data path as the HTTP proxy.
+
+Wire contract (all msgpack):
+    request : {"app": str, "deployment": str?, "method": str?,
+               "args": list?, "kwargs": dict?}
+      or    : {"route": "/prefix", ...} to resolve via the route table
+    response: {"result": ...} | {"error": str}
+
+Client example::
+
+    ch = grpc.insecure_channel("localhost:9000")
+    call = ch.unary_unary("/ray_tpu.serve.Serve/Call")
+    reply = msgpack.unpackb(call(msgpack.packb({"app": "default", "args": [x]})))
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+SERVICE_METHOD = "/ray_tpu.serve.Serve/Call"
+
+
+@ray_tpu.remote(num_cpus=0)
+class GrpcProxyActor:
+    """grpc server on a dedicated thread; requests route through cached
+    DeploymentHandles exactly like the HTTP proxy's."""
+
+    def __init__(self, port: int = 9000):
+        import grpc
+        import msgpack
+
+        self.port = port
+        self.routes: Dict[str, tuple] = {}
+        self._routes_version = 0
+        self._handles: Dict[tuple, Any] = {}
+        self._msgpack = msgpack
+
+        proxy = self
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                if handler_call_details.method != SERVICE_METHOD:
+                    return None
+                return grpc.unary_unary_rpc_method_handler(
+                    proxy._call,
+                    request_deserializer=None,  # raw bytes
+                    response_serializer=None,
+                )
+
+        self._server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=8),
+            handlers=(_Handler(),),
+        )
+        bound = self._server.add_insecure_port(f"0.0.0.0:{port}")
+        if bound == 0:
+            raise RuntimeError(f"grpc proxy failed to bind port {port}")
+        self.port = bound
+        self._server.start()
+        self._poller = threading.Thread(target=self._routes_poll_loop, daemon=True, name="grpc-longpoll")
+        self._poller.start()
+
+    # -- routing (same long-poll freshness as the HTTP proxy) -----------
+    def _routes_poll_loop(self):
+        import time as _t
+
+        from ray_tpu.serve.api import _get_controller
+
+        while True:
+            try:
+                controller = _get_controller()
+                changed = ray_tpu.get(
+                    controller.listen_for_change.remote(
+                        {"routes": self._routes_version}, timeout_s=20.0
+                    ),
+                    timeout=40.0,
+                )
+                if "routes" in changed:
+                    self.routes = dict(changed["routes"]["data"])
+                    self._routes_version = changed["routes"]["version"]
+            except Exception:
+                _t.sleep(1.0)
+
+    def _handle_for(self, app_name: str, dep_name: Optional[str], method: str):
+        from ray_tpu.serve.api import _get_controller
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        if dep_name is None:
+            # latest deployment of the app (reference: app-level ingress)
+            controller = _get_controller()
+            st = ray_tpu.get(controller.status.remote())
+            deps = list(st.get(app_name, {}))
+            if not deps:
+                raise ValueError(f"no app {app_name!r}")
+            dep_name = deps[-1]
+        key = (app_name, dep_name, method)
+        h = self._handles.get(key)
+        if h is None:
+            h = DeploymentHandle(dep_name, app_name)
+            h._method = method
+            h._refresh()
+            self._handles[key] = h
+        return h
+
+    def _call(self, request_bytes: bytes, context) -> bytes:
+        m = self._msgpack
+        try:
+            req = m.unpackb(request_bytes, raw=False)
+            app_name = req.get("app", "default")
+            dep_name = req.get("deployment")
+            if dep_name is None and req.get("route"):
+                route = self.routes.get(req["route"])
+                if route is not None:
+                    app_name, dep_name = route[0], route[1]
+            h = self._handle_for(app_name, dep_name, req.get("method", "__call__"))
+            resp = h.remote(*req.get("args", ()), **req.get("kwargs", {}))
+            return m.packb({"result": resp.result(timeout=60)}, use_bin_type=True)
+        except Exception as e:
+            return m.packb({"error": f"{type(e).__name__}: {e}"}, use_bin_type=True)
+
+    def ready(self):
+        return self.port
+
+
+def start_grpc_proxy(port: int = 9000):
+    """Start (or return) the gRPC proxy actor; returns (actor, port)."""
+    name = "SERVE_GRPC_PROXY"
+    try:
+        actor = ray_tpu.get_actor(name)
+    except ValueError:
+        actor = GrpcProxyActor.options(name=name, lifetime="detached").remote(port)
+    return actor, ray_tpu.get(actor.ready.remote())

@@ -1,0 +1,100 @@
+"""HTTP ingress for Serve deployments: method-level route decorators and
+path routing inside a deployment class.
+
+Equivalent of the reference's FastAPI integration (reference:
+python/ray/serve/api.py @serve.ingress — there a FastAPI app is mounted
+inside the replica and the proxy forwards raw ASGI scope; FastAPI is not
+in this image, so the router here is a small native route table with
+`{param}` path captures, and the proxy forwards (method, path, body,
+query) to the replica's dispatcher).
+
+Usage::
+
+    @serve.deployment
+    @serve.ingress
+    class Api:
+        @serve.route("GET", "/hello/{name}")
+        def hello(self, name):
+            return {"msg": f"hi {name}"}
+
+        @serve.route("POST", "/items")
+        def create(self, body):        # `body` receives the JSON payload
+            return {"ok": True, "item": body}
+
+`serve.run(Api.bind(), route_prefix="/api")` serves GET /api/hello/x and
+POST /api/items through the shared HTTP proxy.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_ROUTE_ATTR = "__serve_route__"
+
+
+def route(http_method: str, pattern: str):
+    """Mark a method as an HTTP route inside an @serve.ingress class.
+    `pattern` is /-separated; `{name}` segments capture into kwargs."""
+
+    def deco(fn):
+        routes = getattr(fn, _ROUTE_ATTR, [])
+        routes.append((http_method.upper(), pattern))
+        setattr(fn, _ROUTE_ATTR, routes)
+        return fn
+
+    return deco
+
+
+def _compile(pattern: str) -> List[str]:
+    return [seg for seg in pattern.strip("/").split("/") if seg != ""]
+
+
+def _match(segs: List[str], path: str) -> Optional[Dict[str, str]]:
+    parts = [p for p in path.strip("/").split("/") if p != ""]
+    if len(parts) != len(segs):
+        return None
+    captures: Dict[str, str] = {}
+    for seg, part in zip(segs, parts):
+        if seg.startswith("{") and seg.endswith("}"):
+            captures[seg[1:-1]] = part
+        elif seg != part:
+            return None
+    return captures
+
+
+def ingress(cls):
+    """Class decorator: collect @serve.route-marked methods into a route
+    table and install the dispatcher the HTTP/gRPC proxies call."""
+    table: List[Tuple[str, List[str], str]] = []  # (http_method, segs, attr)
+    for attr in dir(cls):
+        fn = getattr(cls, attr, None)
+        for http_method, pattern in getattr(fn, _ROUTE_ATTR, ()):
+            table.append((http_method, _compile(pattern), attr))
+
+    def __serve_http_request__(self, http_method: str, path: str,
+                               body: Any = None, query: Optional[Dict[str, str]] = None):
+        import inspect
+
+        for m, segs, attr in table:
+            if m != http_method.upper():
+                continue
+            captures = _match(segs, path)
+            if captures is None:
+                continue
+            fn = getattr(self, attr)
+            kwargs: Dict[str, Any] = dict(captures)
+            sig = inspect.signature(fn)
+            if "body" in sig.parameters:
+                kwargs["body"] = body
+            if "query" in sig.parameters:
+                kwargs["query"] = query or {}
+            return fn(**kwargs)
+        raise _NoRouteError(f"no route for {http_method} {path}")
+
+    cls.__serve_http_request__ = __serve_http_request__
+    cls.__serve_is_ingress__ = True
+    return cls
+
+
+class _NoRouteError(Exception):
+    """Raised by the dispatcher for unmatched paths; the proxy maps it to
+    a 404 instead of a 500."""

@@ -73,31 +73,34 @@ class ProxyActor:
 
         self.routes = await asyncio.get_running_loop().run_in_executor(None, _fetch)
 
+    def _match_route(self, path: str):
+        for prefix in sorted(self.routes, key=len, reverse=True):
+            if path.startswith(prefix):
+                return prefix, self.routes[prefix]
+        return None, None
+
     async def _handle(self, request):
         from aiohttp import web
 
         path = "/" + request.match_info["tail"]
-        route = None
-        for prefix in sorted(self.routes, key=len, reverse=True):
-            if path.startswith(prefix):
-                route = self.routes[prefix]
-                break
+        prefix, route = self._match_route(path)
         if route is None:
             await self._refresh_routes()
-            for prefix in sorted(self.routes, key=len, reverse=True):
-                if path.startswith(prefix):
-                    route = self.routes[prefix]
-                    break
+            prefix, route = self._match_route(path)
         if route is None:
             return web.json_response({"error": f"no route for {path}"}, status=404)
-        app_name, dep_name = route
-        key = (app_name, dep_name)
+        app_name, dep_name, is_ingress = (route if len(route) == 3 else (*route, False))
+        # key includes the ingress flag: a redeploy that flips it must not
+        # reuse a handle with the wrong dispatch method baked in
+        key = (app_name, dep_name, is_ingress)
         handle = self._handles.get(key)
         if handle is None:
             from ray_tpu.serve.handle import DeploymentHandle
 
             def _build():
                 h = DeploymentHandle(dep_name, app_name)
+                if is_ingress:  # route-dispatch method baked in ONCE
+                    h._method = "__serve_http_request__"
                 h._refresh()  # blocking controller round trips — off-loop
                 return h
 
@@ -107,14 +110,27 @@ class ProxyActor:
             body = await request.json() if request.can_read_body else {}
         except json.JSONDecodeError:
             body = {"raw": await request.text()}
+        loop = asyncio.get_running_loop()
         try:
-            resp = handle.remote(body)
-            loop = asyncio.get_running_loop()
+            if is_ingress:
+                # path routing inside the deployment: forward (method,
+                # subpath, body, query) to the replica's route dispatcher
+                # (reference: proxy → mounted FastAPI app in the replica)
+                sub = path[len(prefix):] or "/"
+                resp = handle.remote(request.method, sub, body, dict(request.query))
+            else:
+                resp = handle.remote(body)
             result = await loop.run_in_executor(None, resp.result, 60)
             if isinstance(result, (dict, list, str, int, float, bool, type(None))):
                 return web.json_response({"result": result})
             return web.json_response({"result": str(result)})
         except Exception as e:
+            if type(e).__name__ == "_NoRouteError" or "_NoRouteError" in str(type(e)):
+                return web.json_response({"error": str(e)}, status=404)
+            from ray_tpu.exceptions import TaskError
+
+            if isinstance(e, TaskError) and "_NoRouteError" in getattr(e, "traceback_str", str(e)):
+                return web.json_response({"error": "no matching route"}, status=404)
             return web.json_response({"error": str(e)}, status=500)
 
     def ready(self):
