@@ -122,7 +122,14 @@ class CoreWorker:
         self._owned_pending: List[bytes] = []
         self._owned: set = set()  # oids this worker CREATED (owns)
         self._gcs_registered: set = set()  # owned oids the directory knows
+        # registered ONLY so spill notices route here (never actually
+        # shared): ref death may still free these fully + GC the record
+        self._pin_registered: set = set()
+        self._dir_free_pending: List[bytes] = []
         self._owned_flush_scheduled = False
+        # producer-side handoff pins: (deadline, buf) released by the gc
+        # loop once the owner has surely pinned (see put_serialized_to_shm)
+        self._handoff_pins: List[Tuple[float, Any]] = []
         # task-event buffer: direct-path task transitions accumulate here
         # and flush to the GCS on a timer (reference: TaskEventBuffer,
         # src/ray/core_worker/task_event_buffer.h:206)
@@ -175,6 +182,8 @@ class CoreWorker:
         # very thread holds _store_lock (or any other lock), so the hooks
         # must not lock or schedule — a periodic loop task drains them.
         self._ref_events: collections.deque = collections.deque()
+        # submission-time arg references: task_id/returns[0] -> arg oids
+        self._task_arg_pins: Dict[Any, List[bytes]] = {}
 
         # function table cache
         self._fn_cache: Dict[str, Any] = {}
@@ -374,18 +383,21 @@ class CoreWorker:
 
     async def _ref_gc_loop(self):
         while not self._closed:
-            await asyncio.sleep(0.2)
+            await asyncio.sleep(0.1)
+            self._sweep_handoff_pins()
             self._drain_ref_events()
-            if self._release_retry:
-                # pins whose numpy views were still alive at free time:
-                # re-try here so arena space is reclaimed promptly once
-                # the views die, not only at the next unrelated free
-                self._release_retry = [b for b in self._release_retry if not b.try_release()]
+            # pins whose numpy views were still alive at free time:
+            # re-try here so arena space is reclaimed promptly once
+            # the views die, not only at the next unrelated free
+            self._sweep_release_retry()
 
     def _drain_ref_events(self):
         """Loop-side: fold queued create/delete events into counts; free
-        owned, never-shared objects whose count hit zero."""
+        owned, never-shared objects whose count hit zero; RELEASE pins on
+        borrowed objects whose count hit zero."""
         dead: List[bytes] = []
+        borrowed_done: List[bytes] = []
+        pin_done: List[bytes] = []
         with self._store_lock:
             while self._ref_events:
                 created, oid = self._ref_events.popleft()
@@ -397,12 +409,100 @@ class CoreWorker:
                     self._local_refs[oid] = n
                     continue
                 self._local_refs.pop(oid, None)
-                if oid in self._owned and oid not in self._gcs_registered:
-                    # borrowed or escaped objects need an explicit free()
-                    # (or the full distributed protocol) — skip those
-                    dead.append(oid)
+                if oid in self._owned:
+                    if oid not in self._gcs_registered:
+                        dead.append(oid)
+                    elif oid in self._pin_registered:
+                        # registered ONLY for spill routing, never shared:
+                        # free fully AND retire the directory record
+                        self._pin_registered.discard(oid)
+                        self._gcs_registered.discard(oid)
+                        self._dir_free_pending.append(oid)
+                        dead.append(oid)
+                    else:
+                        # escaped (shared) owned object: full deletion
+                        # still needs explicit free() (borrowers may hold
+                        # it), but OUR primary-copy pin must drop — the
+                        # entry becomes evictable/spillable once borrowers
+                        # release theirs too. The cached env STAYS (the
+                        # owner keeps serving owner.resolve for it).
+                        pin_done.append(oid)
+                else:
+                    # BORROWED ref: this process only holds a read pin on
+                    # the owner's object. Dropping the pin when our last
+                    # local ref dies is what keeps consumed blocks
+                    # evictable — without it every worker that ever read a
+                    # block holds its arena slot forever (reference:
+                    # reference_count.cc borrower release → owner)
+                    borrowed_done.append(oid)
         for oid in dead:
             self._local_free(oid)
+        for oid in borrowed_done:
+            self._release_borrowed(oid)
+        if self._dir_free_pending:
+            # batched directory-record GC for pin-registered oids that died
+            oids, self._dir_free_pending = self._dir_free_pending, []
+            self._loop.call_soon_threadsafe(
+                lambda o=oids: self._loop.create_task(
+                    self._gcs.push("obj.free", {"oids": o})
+                )
+            )
+        for oid in pin_done:
+            buf = self._pinned.pop(oid, None)
+            if buf is not None and not buf.try_release():
+                with self._store_lock:
+                    self._release_retry.append(buf)
+
+    def _pin_owned(self, oid: bytes, env: Dict[str, Any]):
+        """OWNER-PINNED primary copies (reference: plasma pinning of
+        objects with live references — eviction must not take an object
+        the owner still holds refs to; pressure is handled by SPILLING,
+        which writes the bytes out and tells the owner to release). Only
+        local-node shm objects can be pinned (the arena refcount is
+        per-node); remote locations are protected by their own raylet."""
+        if self._shm is None or env.get("n") != self.node_id:
+            return
+        if oid in self._pinned:
+            return
+        buf = self._shm.get(oid, timeout_ms=0)
+        if buf is None:
+            return
+        if self._pinned.setdefault(oid, buf) is not buf:
+            buf.release()  # raced with another pinner
+            return
+        # the spill-release notice is routed to the directory's recorded
+        # OWNER — for a task result that record was created by the
+        # executing worker's add_location. Claim ownership (micro-batched
+        # push; runs loop-side) so spill notices reach the process that
+        # actually holds this pin.
+        with self._store_lock:
+            if oid in self._gcs_registered:
+                return
+            self._gcs_registered.add(oid)
+            self._pin_registered.add(oid)
+        self._register_owned([oid])
+
+    def _on_spill_release(self, data):
+        """GCS push: one of our pinned objects was spilled to disk — drop
+        the pin so its arena slot can actually be reclaimed (the bytes
+        are safe on disk; decode restores on demand)."""
+        oid = bytes(data["oid"])
+        buf = self._pinned.pop(oid, None)
+        if buf is not None and not buf.try_release():
+            with self._store_lock:
+                self._release_retry.append(buf)
+
+    def _release_borrowed(self, oid: bytes):
+        """Drop this process's cached env + arena pin for a borrowed
+        object (the object itself belongs to its owner)."""
+        with self._store_lock:
+            if self._local_refs.get(oid):  # resurrected meanwhile
+                return
+            self._store.pop(oid, None)
+        buf = self._pinned.pop(oid, None)
+        if buf is not None and not buf.try_release():
+            with self._store_lock:
+                self._release_retry.append(buf)  # numpy views still alive
 
     def _local_free(self, oid: bytes):
         """Loop-side: reclaim an owned, never-shared object whose last
@@ -419,15 +519,15 @@ class CoreWorker:
             self._lineage.pop(oid, None)
         buf = self._pinned.pop(oid, None)
         if buf is not None and not buf.try_release():
-            self._release_retry.append(buf)  # numpy views still live
+            with self._store_lock:
+                self._release_retry.append(buf)  # numpy views still live
         if not pending and self._shm is not None:
             try:
                 self._shm.delete(oid)
             except Exception:
                 pass
         # opportunistic sweep of parked pins whose views have since died
-        if self._release_retry:
-            self._release_retry = [b for b in self._release_retry if not b.try_release()]
+        self._sweep_release_retry()
 
     def shutdown(self):
         if self._closed:
@@ -468,6 +568,13 @@ class CoreWorker:
             pass
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._loop_thread.join(timeout=5)
+        with self._store_lock:
+            pins, self._handoff_pins = self._handoff_pins, []
+        for _, buf in pins:
+            try:
+                buf.release()
+            except Exception:
+                pass
         if self._shm:
             self._shm.close()
 
@@ -500,6 +607,9 @@ class CoreWorker:
             return True
         if method == "pubsub.message":
             self._dispatch_pubsub(data)
+            return True
+        if method == "obj.spill_release":
+            self._on_spill_release(data)
             return True
         if method == "owner.resolve":
             return await self._serve_owner_resolve(data)
@@ -573,6 +683,30 @@ class CoreWorker:
                 self._pending[oid] = cell
             return cell
 
+    def _pin_args(self, key, packed: Dict[str, Any]):
+        """Submission-time references for ref args (reference:
+        reference_count.cc 'submitted task references'): a ref passed into
+        a task must keep its object alive until that task completes, even
+        if the caller drops its own ObjectRef right after submission — the
+        streaming executor does exactly that."""
+        if not packed.get("hr"):
+            return
+        oids = [
+            bytes(p["r"])
+            for p in list(packed["a"]) + list(packed["kw"].values())
+            if "r" in p
+        ]
+        if oids:
+            self._task_arg_pins[key] = oids
+            for oid in oids:
+                self._ref_events.append((True, oid))
+
+    def _unpin_args(self, key):
+        oids = self._task_arg_pins.pop(key, None)
+        if oids:
+            for oid in oids:
+                self._ref_events.append((False, oid))
+
     def _register_returns(self, returns: List[bytes]):
         """Submit-path fast helper: mark each return oid pending AND owned
         under a single lock acquisition (two lock round trips per call was
@@ -606,6 +740,7 @@ class CoreWorker:
         to actor_call_batch_max of them."""
         wake: List[_Cell] = []
         special: List[Tuple[bytes, Dict[str, Any]]] = []
+        pin: List[Tuple[bytes, Dict[str, Any]]] = []
         with self._store_lock:
             for oid, env in zip(oids, envs):
                 oid = bytes(oid)
@@ -613,10 +748,14 @@ class CoreWorker:
                     special.append((oid, env))
                     continue
                 self._store[oid] = env
+                if env.get("k") == "s" and oid in self._owned:
+                    pin.append((oid, env))
                 cell = self._pending.pop(oid, None)
                 if cell is not None:
                     cell.env = env
                     wake.append(cell)
+        for oid, env in pin:
+            self._pin_owned(oid, env)
         for cell in wake:
             if cell.event is not None:
                 cell.event.set()
@@ -648,6 +787,8 @@ class CoreWorker:
                 return
             self._store[oid] = env
             cell = self._pending.pop(oid, None)
+        if env.get("k") == "s" and oid in self._owned:
+            self._pin_owned(oid, env)
         if cell is not None:
             cell.env = env
             if cell.event is not None:
@@ -678,7 +819,7 @@ class CoreWorker:
             self._deliver(oid, env)
             self._push_gcs("obj.put_inline", {"oid": oid, "data": env["d"]})
         else:
-            buf = self._shm.create_buffer(oid, total)
+            buf = self._create_with_gc(oid, total)
             serialization.write_to(buf, pickled, buffers)
             buf.release()
             self._shm.seal(oid)
@@ -696,11 +837,97 @@ class CoreWorker:
             lambda: self._loop.create_task(self._gcs.push(method, data))
         )
 
+    def force_ref_gc(self):
+        """Synchronous sweep of dead refs + parked pins, callable from any
+        thread. Allocation pressure calls this: a fan-out burst can create
+        blocks faster than the 0.1s ref-gc cadence releases consumed ones,
+        and failing a put while dozens of release-eligible pins are queued
+        would be a spurious ObjectStoreFullError."""
+        self._drain_ref_events()
+        # under allocation pressure, shave the handoff grace to 0.1s — the
+        # owner's pin is normally in place within a reply round trip
+        self._sweep_handoff_pins(early_by=0.4)
+        self._sweep_release_retry()
+
+    def _sweep_release_retry(self):
+        """Retry parked pin releases (buffers whose zero-copy views were
+        alive). Swap-out under the store lock: plain list-rebind sweeps
+        raced with concurrent appends from executor threads and silently
+        dropped buffers (a permanent arena refcount leak)."""
+        with self._store_lock:
+            if not self._release_retry:
+                return
+            items, self._release_retry = self._release_retry, []
+        survivors = [b for b in items if not b.try_release()]
+        if survivors:
+            with self._store_lock:
+                self._release_retry.extend(survivors)
+
+    def _sweep_handoff_pins(self, early_by: float = 0.0):
+        """Swap-out under the store lock (same race as _sweep_release_retry:
+        producer threads append concurrently with gc-loop and
+        pressure-path sweeps; an unlocked rebind drops or double-releases
+        pins)."""
+        with self._store_lock:
+            if not self._handoff_pins:
+                return
+            items, self._handoff_pins = self._handoff_pins, []
+        now = time.monotonic() + early_by
+        keep: List[Tuple[float, Any]] = []
+        for deadline, buf in items:
+            if deadline <= now:
+                buf.release()
+            else:
+                keep.append((deadline, buf))
+        if keep:
+            with self._store_lock:
+                self._handoff_pins.extend(keep)
+
+    def _create_with_gc(self, oid: bytes, total: int):
+        from ray_tpu.exceptions import ObjectStoreFullError
+
+        try:
+            return self._shm.create_buffer(oid, total)
+        except ObjectStoreFullError:
+            pass
+        # Pressure: most "full" arenas during fan-out bursts are pins whose
+        # refs just died but whose gc sweep hasn't run — ours runs now; the
+        # OTHER processes' sweeps (the driver's, typically) run on their
+        # 0.1s loops, so back off across a few of their cycles. Sustained
+        # pressure (live refs > arena) is resolved by SPILLING — hint the
+        # raylet immediately instead of waiting out its 1s loop, and give
+        # the spill+owner-release+reclaim chain a few seconds to land.
+        self._hint_spill()
+        delay = 0.05
+        for _ in range(9):
+            self.force_ref_gc()
+            time.sleep(delay)
+            delay = min(delay * 2, 0.8)
+            try:
+                return self._shm.create_buffer(oid, total)
+            except ObjectStoreFullError:
+                continue
+        return self._shm.create_buffer(oid, total)  # final raise
+
+    def _hint_spill(self):
+        """Fire-and-forget pressure signal to the local raylet's spiller."""
+        if self._raylet_addr is None:
+            return
+
+        async def _send():
+            try:
+                rl = await self._raylet()
+                await rl.push("raylet.spill_hint", {})
+            except Exception:
+                pass
+
+        self._loop.call_soon_threadsafe(lambda: self._loop.create_task(_send()))
+
     def put_serialized_to_shm(self, oid: bytes, pickled, buffers) -> Dict[str, Any]:
         """Write an already-serialized value into the node arena; returns env."""
         total = serialization.serialized_size(pickled, buffers)
         try:
-            buf = self._shm.create_buffer(oid, total)
+            buf = self._create_with_gc(oid, total)
         except FileExistsError:
             # Task retry re-executing on this node after a crash between seal
             # and owner push: the sealed bytes are the same deterministic
@@ -739,8 +966,19 @@ class CoreWorker:
                 pinned.release()
                 return _adopt(size)
         serialization.write_to(buf, pickled, buffers)
-        buf.release()
+        buf.release()  # view only; seal below drops the creator refcount
         self._shm.seal(oid)
+        # HANDOFF pin: take a REAL store ref for a short grace — between
+        # seal (which drops the creator refcount) and the owner pinning on
+        # delivery, the entry would be refcount-0 and an eviction burst in
+        # that window destroys a result nobody has seen yet. The gc loop
+        # releases expired handoffs (the owner's pin lands within a reply
+        # round trip — ms — so a short grace suffices; a long one would
+        # itself pin production-rate × grace worth of arena).
+        hbuf = self._shm.get(oid, timeout_ms=0)
+        if hbuf is not None:
+            with self._store_lock:
+                self._handoff_pins.append((time.monotonic() + 0.5, hbuf))
         self._call(self._gcs.request("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": total}))
         return _env_shm(self.node_id, total)
 
@@ -836,19 +1074,41 @@ class CoreWorker:
                     if buf is None:
                         # possibly SPILLED: a resolve makes the directory
                         # restore it from disk (awaited server-side, so a
-                        # "local" answer means the bytes are back)
-                        try:
-                            reply = self._call(
-                                self._gcs.request("obj.resolve", {"oid": oid, "node_id": self.node_id})
-                            )
-                            if reply.get("status") == "local":
-                                # a restore is awaited server-side, so the
-                                # bytes are already back; if the location
-                                # was just stale (LRU-evicted, not spilled)
-                                # no wait will make it appear
-                                buf = self._shm.get(oid, timeout_ms=500)
-                        except Exception:
-                            pass
+                        # "local" answer means the bytes are back). Two
+                        # rounds: a restored object can be re-evicted by a
+                        # concurrent pressure burst before our get lands.
+                        for attempt in range(4):
+                            try:
+                                reply = self._call(
+                                    self._gcs.request("obj.resolve", {"oid": oid, "node_id": self.node_id})
+                                )
+                                status = reply.get("status")
+                                if status == "local":
+                                    buf = self._shm.get(oid, timeout_ms=500)
+                                    if buf is not None:
+                                        break
+                                    # STALE location (evicted behind the
+                                    # directory's back): retract it SYNCHRONOUSLY
+                                    # so the next resolve takes the
+                                    # restore-from-spill path instead of
+                                    # re-answering from the stale record.
+                                    self._call(
+                                        self._gcs.request(
+                                            "obj.location_gone",
+                                            {"oid": oid, "node_id": self.node_id},
+                                        )
+                                    )
+                                elif status == "owner":
+                                    # a just-spilled object's notice may not
+                                    # have reached the directory yet (spill
+                                    # deletes the arena entry BEFORE the GCS
+                                    # learns of the file) — give it a beat
+                                    pass
+                                else:
+                                    break  # lost/unknown: no wait helps
+                            except Exception:
+                                break
+                            time.sleep(0.05 * (attempt + 1))
                     if buf is None:
                         # evicted behind the directory's back: invalidate
                         # the stale location so later resolvers don't keep
@@ -857,9 +1117,23 @@ class CoreWorker:
                             "obj.location_gone", {"oid": oid, "node_id": self.node_id}
                         )
                         raise exceptions.ObjectLostError(oid.hex(), "evicted from local store")
-                    # hold the store refcount for the life of this process
-                    # (or until free()) so zero-copy views stay valid
-                    self._pinned[oid] = buf
+                    if oid in self._owned:
+                        # owner keeps its primary-copy pin until its refs
+                        # die (or a spill notice releases it)
+                        self._pinned[oid] = buf
+                        return serialization.from_buffer(buf.view, zero_copy=True)
+                    # BORROWED object (task arg in a worker): no ObjectRef
+                    # tracks this access — tie the pin to the VALUE instead:
+                    # deserialize first (views now export the buffer), then
+                    # park the buffer on the release-retry list, whose
+                    # try_release fails while views live and reclaims the
+                    # refcount the moment the value dies. Without this,
+                    # every block a worker ever read stayed pinned for the
+                    # worker's lifetime (the consumed-block arena leak).
+                    value = serialization.from_buffer(buf.view, zero_copy=True)
+                    with self._store_lock:
+                        self._release_retry.append(buf)
+                    return value
                 return serialization.from_buffer(buf.view, zero_copy=True)
             # no local arena (remote driver) — chunk-fetch from the raylet
             # that has it (reference: object_manager Pull into a client
@@ -973,7 +1247,8 @@ class CoreWorker:
         cells = [self._make_pending(roid) for roid in respec["returns"]]
         buf = self._pinned.pop(oid, None)
         if buf is not None and not buf.try_release():
-            self._release_retry.append(buf)
+            with self._store_lock:
+                self._release_retry.append(buf)
         self._submitted[respec["task_id"]] = {"spec": respec, "retries_left": respec.get("max_retries", 0)}
         self._call(self._gcs.request("task.submit", {"spec": respec}))
         cell = next(c for c, roid in zip(cells, respec["returns"]) if roid == oid)
@@ -1148,6 +1423,7 @@ class CoreWorker:
             **(scheduling or {}),
         }
         self._register_returns(returns)
+        self._pin_args(task_id, spec["args"])
         self._submitted[spec["task_id"]] = {"spec": spec, "retries_left": spec.get("max_retries", 0)}
         if self._direct_eligible(spec):
             deps = (
@@ -1238,12 +1514,13 @@ class CoreWorker:
         return tuple(sorted((spec.get("resources") or {}).items()))
 
     def _register_owned(self, oids):
-        """Loop-side micro-batched ownership registration: every call in
-        one loop iteration rides a single GCS push."""
+        """Micro-batched ownership registration: every call coalesced into
+        a single GCS push per loop turn. Callable from any thread (pin
+        paths run on the submitting thread for local puts)."""
         self._owned_pending.extend(oids)
         if not self._owned_flush_scheduled:
             self._owned_flush_scheduled = True
-            self._loop.call_soon(self._flush_owned)
+            self._loop.call_soon_threadsafe(self._flush_owned)
 
     def _ensure_registered(self, oids):
         """Share-time ownership registration (any thread). The directory
@@ -1261,7 +1538,11 @@ class CoreWorker:
         need = []
         with self._store_lock:
             for oid in oids:
-                if oid in self._gcs_registered or oid not in self._owned:
+                if oid not in self._owned:
+                    continue
+                # genuinely shared now — pin-only registration upgrade
+                self._pin_registered.discard(oid)
+                if oid in self._gcs_registered:
                     continue
                 self._gcs_registered.add(oid)
                 need.append(oid)
@@ -1520,6 +1801,7 @@ class CoreWorker:
             err = _env_err(
                 exceptions.WorkerCrashedError(f"task failed: {data.get('error')}"), rec["spec"].get("name", "")
             )
+        self._unpin_args(data["task_id"])
         for oid in rec["spec"]["returns"]:
             self._deliver(oid, err)
 
@@ -1531,6 +1813,7 @@ class CoreWorker:
         later loss is reconstructible. Bounded FIFO — very old results
         lose reconstructibility, matching the reference's lineage
         eviction (task_manager.cc lineage pinning budget)."""
+        self._unpin_args(task_id)
         rec = self._submitted.pop(task_id, None)
         if rec is None:
             return
@@ -1574,6 +1857,7 @@ class CoreWorker:
             "returns": returns,
         }
         self._register_returns(returns)
+        self._pin_args(returns[0], spec["args"])
         # fire-and-forget enqueue: the caller holds refs whose cells are
         # already waitable; the loop does the sending
         self._post(lambda: self._enqueue_actor_call(actor_id, spec, max_task_retries))
@@ -1592,6 +1876,7 @@ class CoreWorker:
         # third of the hot path's syscalls)
 
     def _fail_call(self, spec, exc: BaseException):
+        self._unpin_args(spec.get("task_id") or spec["returns"][0])
         err = _env_err(exc)
         err["t"] = type(exc).__name__
         for oid in spec["returns"]:
@@ -1698,6 +1983,8 @@ class CoreWorker:
                 loop.create_task(self._actor_reply_failed(actor_id, spec, retries_left, exc))
             return
         r = fut.result()
+        for spec, _ in batch:
+            self._unpin_args(spec["returns"][0])
         self._deliver_batch(r["o"], r["e"])
 
     async def _actor_reply_failed(self, actor_id: str, spec, retries_left: int, exc):

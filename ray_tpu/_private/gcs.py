@@ -972,6 +972,8 @@ class GcsServer:
         return True
 
     async def _rpc_obj_add_location(self, d, conn):
+        if os.environ.get("RAY_TPU_DEBUG_DIR"):
+            logger.info("DIR add_location %s node=%s", bytes(d["oid"]).hex()[:12], d["node_id"])
         rec = self.objects.get(d["oid"])
         if rec is None:
             owner = self.conn_client.get(conn)
@@ -986,6 +988,8 @@ class GcsServer:
         (reference: ADVICE r1 — resolve must not keep answering 'local'
         for data that no longer exists)."""
         rec = self.objects.get(bytes(d["oid"]))
+        if os.environ.get("RAY_TPU_DEBUG_DIR"):
+            logger.info("DIR location_gone %s rec=%s", bytes(d["oid"]).hex()[:12], rec and {"loc": list(rec["locations"]), "sp": bool(rec.get("spilled"))})
         if rec is not None:
             rec["locations"].discard(d["node_id"])
         return True
@@ -995,12 +999,24 @@ class GcsServer:
         remember the file (reference: spilled URL tracking in the object
         directory)."""
         oid = bytes(d["oid"])
+        if os.environ.get("RAY_TPU_DEBUG_DIR"):
+            logger.info("DIR spilled %s", oid.hex()[:12])
         rec = self.objects.setdefault(
             oid, {"owner": self.conn_client.get(conn), "inline": None, "locations": set(), "size": 0}
         )
         rec["locations"].discard(d["node_id"])
         rec["spilled"] = {"node_id": d["node_id"], "path": d["path"]}
         rec["size"] = d.get("size", rec["size"])
+        # tell the owner so it releases its primary-copy pin — that pin is
+        # what kept the entry unevictable; with the bytes on disk the
+        # arena slot may now be reclaimed (reference: spilled objects are
+        # unpinned once their spill URL is recorded)
+        owner = self.clients.get(rec.get("owner") or "")
+        if owner is not None and owner.get("conn") is not None:
+            try:
+                await owner["conn"].push("obj.spill_release", {"oid": oid})
+            except Exception:
+                pass
         return True
 
     async def _restore_from_spill(self, oid, rec) -> bool:
@@ -1058,6 +1074,14 @@ class GcsServer:
                     rec["locations"].add(requester_node)
                     return {"status": "local", "size": rec["size"]}
         owner = self.clients.get(rec.get("owner") or "")
+        if os.environ.get("RAY_TPU_DEBUG_DIR"):
+            logger.info(
+                "DIR resolve %s -> %s (loc=%s sp=%s)",
+                bytes(oid).hex()[:12],
+                "lost" if owner is None else "owner",
+                list(rec["locations"]),
+                bool(rec.get("spilled")),
+            )
         if owner is None:
             return {"status": "lost"}
         return {"status": "owner", "owner_addr": owner["addr"]}

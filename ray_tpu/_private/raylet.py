@@ -170,23 +170,34 @@ class Raylet:
         external storage): once usage crosses the spilling threshold,
         write the coldest evictable objects out and free their arena
         space — the C++ LRU would otherwise DROP them, forcing lineage
-        rebuilds. Spilled objects restore on demand."""
+        rebuilds. Spilled objects restore on demand. A writer that hits
+        FULL kicks `_spill_wakeup` instead of waiting out the period."""
+        self._spill_wakeup = asyncio.Event()
         while True:
-            await asyncio.sleep(1.0)
             try:
-                u = self.store.usage()
-                cap = u["capacity_bytes"]
-                if cap == 0 or u["used_bytes"] <= RayConfig.object_spilling_threshold * cap:
-                    continue
-                target = int(0.6 * cap)
-                used = u["used_bytes"]
-                for oid, size in self.store.list_evictable(256):
-                    if used <= target:
-                        break
-                    if await self._spill_one(oid):
-                        used -= size
+                await asyncio.wait_for(self._spill_wakeup.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+            self._spill_wakeup.clear()
+            try:
+                await self._spill_pass()
             except Exception:
                 logger.exception("spill loop iteration failed")
+
+    async def _spill_pass(self, force: bool = False):
+        u = self.store.usage()
+        cap = u["capacity_bytes"]
+        if cap == 0:
+            return
+        if not force and u["used_bytes"] <= RayConfig.object_spilling_threshold * cap:
+            return
+        target = int(0.6 * cap)
+        used = u["used_bytes"]
+        for oid, size in self.store.list_spillable(256):
+            if used <= target:
+                break
+            if await self._spill_one(oid):
+                used -= size
 
     async def _spill_one(self, oid: bytes) -> bool:
         buf = self.store.get(oid, timeout_ms=0)
@@ -215,7 +226,21 @@ class Raylet:
         path = data["path"]
         with open(path, "rb") as f:
             blob = f.read()
-        self.store.put_bytes(oid, blob)
+        # the arena may still be briefly full right after the pressure
+        # that caused the spill — owner pin releases land on 0.1s gc
+        # cycles, so ride a few of them before failing the restore
+        from ray_tpu.exceptions import ObjectStoreFullError
+
+        delay = 0.05
+        for attempt in range(6):
+            try:
+                self.store.put_bytes(oid, blob)
+                break
+            except ObjectStoreFullError:
+                if attempt == 5:
+                    raise
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 0.5)
         try:
             os.unlink(path)
         except OSError:
@@ -519,6 +544,14 @@ class Raylet:
             return await self._fetch(data)
         if method == "raylet.restore_spilled":
             return await self._restore_spilled(data)
+        if method == "raylet.spill_hint":
+            # a writer hit FULL: spill NOW — even if usage is below the
+            # proactive threshold, everything left may be pinned
+            ev = getattr(self, "_spill_wakeup", None)
+            if ev is not None:
+                ev.set()
+            asyncio.get_running_loop().create_task(self._spill_pass(force=True))
+            return True
         if method == "raylet.unlink_spilled":
             try:
                 os.unlink(data["path"])

@@ -78,6 +78,15 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
         ]
         lib.shm_store_list_evictable.restype = ctypes.c_int
+        lib.shm_store_list_spillable.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ]
+        lib.shm_store_list_spillable.restype = ctypes.c_int
+        lib.shm_store_dump_entries.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ]
+        lib.shm_store_dump_entries.restype = ctypes.c_int
         _lib = lib
     return _lib
 
@@ -239,6 +248,27 @@ class ShmStore:
         buf = ctypes.create_string_buffer(max_n * 16)
         sizes = (ctypes.c_uint64 * max_n)()
         n = self._lib.shm_store_list_evictable(self._handle, buf, sizes, max_n)
+        return [(buf.raw[i * 16 : (i + 1) * 16], sizes[i]) for i in range(n)]
+
+    def dump_entries(self, max_n: int = 4096):
+        """Debug: [(oid, refcount, size, state, pending_delete)]."""
+        ids = ctypes.create_string_buffer(max_n * 16)
+        refs = (ctypes.c_int64 * max_n)()
+        sizes = (ctypes.c_uint64 * max_n)()
+        states = (ctypes.c_int32 * max_n)()
+        n = self._lib.shm_store_dump_entries(self._handle, ids, refs, sizes, states, max_n)
+        return [
+            (ids.raw[i * 16 : (i + 1) * 16], refs[i], sizes[i], states[i] & 0xFF, bool(states[i] & 0x100))
+            for i in range(n)
+        ]
+
+    def list_spillable(self, max_n: int = 256):
+        """(oid, size) of sealed objects coldest first, INCLUDING
+        owner-pinned entries (spill copies the bytes out; the owner then
+        releases its pin via the GCS spill notice)."""
+        buf = ctypes.create_string_buffer(max_n * 16)
+        sizes = (ctypes.c_uint64 * max_n)()
+        n = self._lib.shm_store_list_spillable(self._handle, buf, sizes, max_n)
         return [(buf.raw[i * 16 : (i + 1) * 16], sizes[i]) for i in range(n)]
 
 

@@ -222,6 +222,7 @@ class Executor:
             self._exec_prof.enable()
         try:
             last = len(specs) - 1
+            unsent = []  # results finished but not yet streamed to the owner
             for i, spec in enumerate(specs):
                 appended = False
                 t0 = _time.time()
@@ -234,19 +235,21 @@ class Executor:
                     for oid, env in zip(spec["returns"], envs):
                         self.core._deliver(bytes(oid), env)
                         staged.append(bytes(oid))
+                    unsent.extend(
+                        {"oid": oid, "env": env}
+                        for oid, env in zip(spec["returns"], envs)
+                    )
                     if conn is not None and i < last and t1 - t0 > 0.002:
-                        # SLOW spec in a batch: stream its results to the
-                        # owner NOW instead of holding them hostage to the
-                        # rest of the batch (head-of-line blocking would
-                        # break wait()/pipelining semantics — a 5s task
-                        # must not delay an already-finished 10ms task's
-                        # result). The batch reply re-delivers them later,
-                        # which is an idempotent no-op. Fast bursts (the
+                        # SLOW spec in a batch: stream EVERYTHING finished
+                        # so far (this spec AND any fast predecessors still
+                        # unsent) to the owner NOW instead of holding it
+                        # hostage to the rest of the batch — head-of-line
+                        # blocking would break wait()/pipelining semantics:
+                        # a 5s task must not delay an already-finished 10ms
+                        # task's result. The batch reply re-delivers them
+                        # later, an idempotent no-op. Fast bursts (the
                         # fan-out hot path) never hit this branch.
-                        results = [
-                            {"oid": oid, "env": env}
-                            for oid, env in zip(spec["returns"], envs)
-                        ]
+                        results, unsent = unsent, []
                         loop.call_soon_threadsafe(
                             lambda r=results: loop.create_task(
                                 self._push_early(conn, r)

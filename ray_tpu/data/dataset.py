@@ -87,12 +87,51 @@ def _zip_blocks(left, *right_parts):
     return left
 
 
+class LazyBlock:
+    """A block the streaming executor launches ON PULL rather than at
+    dataset construction (reference: read tasks are operators inside the
+    streaming executor, data/_internal/planner/plan_read_op.py — eager
+    reads would materialize the whole input ahead of the consumer and
+    defeat backpressure on larger-than-arena datasets)."""
+
+    __slots__ = ("_thunk", "_ref")
+
+    def __init__(self, thunk):
+        self._thunk = thunk
+        self._ref = None
+
+    def force(self):
+        """Launch (or return the already-launched) read. The ref is CACHED
+        — eager paths force the same dataset several times (stats pass +
+        exchange) and must not duplicate reads."""
+        if self._ref is None:
+            self._ref = self._thunk()
+        return self._ref
+
+    def force_transient(self):
+        """Launch WITHOUT caching: the streaming executor's form. A cached
+        ref would stay alive (and owner-pinned in the arena) for the
+        dataset's lifetime — the consumed-block leak that streaming
+        windows exist to prevent. Re-iteration re-runs the read, matching
+        un-materialized dataset semantics."""
+        return self._ref if self._ref is not None else self._thunk()
+
+
+def _force(r):
+    return r.force() if isinstance(r, LazyBlock) else r
+
+
 class Dataset:
     """Lazy dataset over block refs + a pending op chain."""
 
     def __init__(self, block_refs: List[Any], ops: Optional[List] = None):
         self._block_refs = block_refs
         self._ops: List = ops or []
+
+    def _forced(self) -> List[Any]:
+        """Source refs with any lazy reads launched (the non-streaming
+        paths — shuffles, stats — need them all in flight at once)."""
+        return [_force(r) for r in self._block_refs]
 
     # ------------------------------------------------------------ transforms
     def _with_op(self, kind: str, fn, **kw) -> "Dataset":
@@ -101,8 +140,21 @@ class Dataset:
     def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
         return self._with_op("map", fn)
 
-    def map_batches(self, fn: Callable, *, batch_format: str = "numpy", **kw) -> "Dataset":
-        return self._with_op("map_batches", fn, batch_format=batch_format)
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    compute: Optional[str] = None, num_actors: int = 2,
+                    fn_constructor_args=None, fn_constructor_kwargs=None,
+                    ray_actor_options: Optional[Dict] = None, **kw) -> "Dataset":
+        """Per-batch transform. compute="actors" runs it on a pool of
+        `num_actors` STATEFUL workers — `fn` may be a class constructed
+        once per worker (reference: actor_pool_map_operator.py; the
+        TPU-host shape for tokenizers/encoders too expensive to build per
+        task)."""
+        return self._with_op(
+            "map_batches", fn, batch_format=batch_format, compute=compute,
+            num_actors=num_actors, fn_constructor_args=fn_constructor_args,
+            fn_constructor_kwargs=fn_constructor_kwargs,
+            ray_actor_options=ray_actor_options,
+        )
 
     def flat_map(self, fn) -> "Dataset":
         return self._with_op("flat_map", fn)
@@ -123,12 +175,26 @@ class Dataset:
         return self._with_op("rename_columns", mapping)
 
     # ------------------------------------------------------------- execution
+    def _has_actor_stage(self) -> bool:
+        return any(
+            k == "map_batches" and kw.get("compute") == "actors"
+            for k, _, kw in (self._ops or [])
+        )
+
     def _execute_refs(self) -> List[Any]:
         """Launch per-block pipelines; returns refs of transformed blocks."""
         if not self._ops:
-            return list(self._block_refs)
+            return self._forced()
+        if self._has_actor_stage():
+            from ray_tpu.data._executor import execute_streaming
+
+            # wide window: materialization wants max parallelism, the
+            # executor handles the actor-stage plumbing
+            return list(
+                execute_streaming(self._block_refs, self._ops, max_in_flight=16)
+            )
         ops = ray_tpu.put(self._ops)
-        return [_apply_ops.remote(ref, ops) for ref in self._block_refs]
+        return [_apply_ops.remote(ref, ops) for ref in self._forced()]
 
     def materialize(self) -> "Dataset":
         refs = self._execute_refs()
@@ -150,7 +216,7 @@ class Dataset:
         if not self._block_refs:
             return Dataset([])
         ops_ref = ray_tpu.put(self._ops) if self._ops else None
-        counts = ray_tpu.get([_block_count.remote(r, ops_ref) for r in self._block_refs])
+        counts = ray_tpu.get([_block_count.remote(r, ops_ref) for r in self._forced()])
         total = sum(counts)
         per = max(1, (total + num_blocks - 1) // num_blocks)
         offsets = []
@@ -159,7 +225,7 @@ class Dataset:
             offsets.append((acc, per))
             acc += c
         refs = shuffle_exchange(
-            self._block_refs, self._ops, "chunk", num_blocks, per_map_args=offsets, ops_ref=ops_ref
+            self._forced(), self._ops, "chunk", num_blocks, per_map_args=offsets, ops_ref=ops_ref
         )
         return Dataset(refs)
 
@@ -169,7 +235,7 @@ class Dataset:
         if not self._block_refs:
             return Dataset([])
         M = max(1, len(self._block_refs))
-        refs = shuffle_exchange(self._block_refs, self._ops, "random", M, seed=seed)
+        refs = shuffle_exchange(self._forced(), self._ops, "random", M, seed=seed)
         return Dataset(refs)
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
@@ -185,7 +251,7 @@ class Dataset:
         M = max(1, len(self._block_refs))
         ops_ref = ray_tpu.put(self._ops) if self._ops else None
         samples = ray_tpu.get(
-            [_sample_keys.remote(r, ops_ref, key, 64, 11 * i) for i, r in enumerate(self._block_refs)]
+            [_sample_keys.remote(r, ops_ref, key, 64, 11 * i) for i, r in enumerate(self._forced())]
         )
         allkeys = np.sort(np.concatenate([s for s in samples if len(s)]))
         if len(allkeys) == 0 or M == 1:
@@ -194,7 +260,7 @@ class Dataset:
             qs = [len(allkeys) * j // M for j in builtins.range(1, M)]
             boundaries = list(allkeys[qs])
         refs = shuffle_exchange(
-            self._block_refs,
+            self._forced(),
             self._ops,
             "range",
             M,
@@ -230,28 +296,21 @@ class Dataset:
         prefetch_blocks: int = 2,
         drop_last: bool = False,
     ) -> Iterator[Any]:
-        """Streaming iteration: at most `prefetch_blocks` block-pipelines
-        in flight ahead of the consumer."""
+        """Streaming iteration through the pull-based executor: each
+        stage keeps at most its window in flight ahead of the consumer,
+        so a slow consumer bounds both compute and arena footprint
+        (reference: streaming_executor.py backpressure)."""
         if not self._block_refs:
             return
-        ops_ref = ray_tpu.put(self._ops) if self._ops else None
+        from ray_tpu.data._executor import execute_streaming
 
-        def launch(ref):
-            return _apply_ops.remote(ref, ops_ref) if ops_ref is not None else ref
-
-        window: List[Any] = []
-        pending = iter(self._block_refs)
-        for _ in builtins.range(prefetch_blocks + 1):
-            nxt = next(pending, None)
-            if nxt is not None:
-                window.append(launch(nxt))
+        ref_iter = execute_streaming(
+            self._block_refs, self._ops, max_in_flight=2 * (prefetch_blocks + 1)
+        )
 
         leftover = None
-        while window:
-            blk = ray_tpu.get(window.pop(0))
-            nxt = next(pending, None)
-            if nxt is not None:
-                window.append(launch(nxt))
+        for ref in ref_iter:
+            blk = ray_tpu.get(ref)
             if leftover is not None and leftover.num_rows > 0:
                 blk = B.concat_blocks([leftover, blk])
                 leftover = None

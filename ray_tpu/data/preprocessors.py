@@ -79,7 +79,7 @@ def _column_uniques(blk, ops, columns):
 
 def _gather_moments(ds, columns) -> Dict[str, Dict[str, float]]:
     ops = ray_tpu.put(ds._ops) if ds._ops else None
-    parts = ray_tpu.get([_column_moments.remote(r, ops, columns) for r in ds._block_refs])
+    parts = ray_tpu.get([_column_moments.remote(r, ops, columns) for r in ds._forced()])
     stats = {}
     for c in columns:
         n = sum(p[c][0] for p in parts)
@@ -139,7 +139,7 @@ class LabelEncoder(Preprocessor):
     def _fit(self, ds):
         ops = ray_tpu.put(ds._ops) if ds._ops else None
         parts = ray_tpu.get(
-            [_column_uniques.remote(r, ops, [self.label_column]) for r in ds._block_refs]
+            [_column_uniques.remote(r, ops, [self.label_column]) for r in ds._forced()]
         )
         values = sorted({v for p in parts for v in p[self.label_column]}, key=str)
         self.mapping_ = {v: i for i, v in enumerate(values)}
@@ -160,7 +160,7 @@ class OneHotEncoder(Preprocessor):
 
     def _fit(self, ds):
         ops = ray_tpu.put(ds._ops) if ds._ops else None
-        parts = ray_tpu.get([_column_uniques.remote(r, ops, self.columns) for r in ds._block_refs])
+        parts = ray_tpu.get([_column_uniques.remote(r, ops, self.columns) for r in ds._forced()])
         for c in self.columns:
             self.categories_[c] = sorted({v for p in parts for v in p[c]}, key=str)
 

@@ -9,7 +9,7 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.data import block as B
-from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.dataset import Dataset, LazyBlock
 
 
 def _partition(items: List[Any], parallelism: int) -> List[List[Any]]:
@@ -102,27 +102,27 @@ def _read_binary(path):
 
 
 def read_parquet(paths, **kw) -> Dataset:
-    return Dataset([_read_parquet.remote(p) for p in _expand(paths)])
+    return Dataset([LazyBlock(lambda p=p: _read_parquet.remote(p)) for p in _expand(paths)])
 
 
 def read_csv(paths, **kw) -> Dataset:
-    return Dataset([_read_csv.remote(p) for p in _expand(paths)])
+    return Dataset([LazyBlock(lambda p=p: _read_csv.remote(p)) for p in _expand(paths)])
 
 
 def read_json(paths, **kw) -> Dataset:
-    return Dataset([_read_json.remote(p) for p in _expand(paths)])
+    return Dataset([LazyBlock(lambda p=p: _read_json.remote(p)) for p in _expand(paths)])
 
 
 def read_text(paths, **kw) -> Dataset:
-    return Dataset([_read_text.remote(p) for p in _expand(paths)])
+    return Dataset([LazyBlock(lambda p=p: _read_text.remote(p)) for p in _expand(paths)])
 
 
 def read_numpy(paths, **kw) -> Dataset:
-    return Dataset([_read_numpy.remote(p) for p in _expand(paths)])
+    return Dataset([LazyBlock(lambda p=p: _read_numpy.remote(p)) for p in _expand(paths)])
 
 
 def read_binary_files(paths, **kw) -> Dataset:
-    return Dataset([_read_binary.remote(p) for p in _expand(paths)])
+    return Dataset([LazyBlock(lambda p=p: _read_binary.remote(p)) for p in _expand(paths)])
 
 
 _IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp", ".tiff")
@@ -158,4 +158,4 @@ def read_images(paths, *, size=None, **kw) -> Dataset:
     the directory are skipped by extension (reference image datasource
     filters the same way)."""
     files = [p for p in _expand(paths) if p.lower().endswith(_IMAGE_EXTENSIONS)]
-    return Dataset([_read_image.remote(p, size) for p in files])
+    return Dataset([LazyBlock(lambda p=p: _read_image.remote(p, size)) for p in files])

@@ -553,8 +553,8 @@ void shm_store_usage(void* handle, uint64_t* used, uint64_t* capacity, uint64_t*
 // (coldest first) into out (16 bytes each + 8-byte size each in sizes);
 // returns count. Backs the raylet's proactive spiller: these are exactly
 // the objects evict_one() would drop under pressure.
-int shm_store_list_evictable(void* handle, uint8_t* out, uint64_t* sizes, int max_n) {
-  Store* s = reinterpret_cast<Store*>(handle);
+static int list_cold(Store* s, uint8_t* out, uint64_t* sizes, int max_n,
+                     bool include_pinned) {
   if (max_n > 256) max_n = 256;
   // ONE table scan under the lock (an O(max_n * capacity) selection sort
   // would stall every concurrent get/put for the duration): keep the
@@ -566,7 +566,9 @@ int shm_store_list_evictable(void* handle, uint8_t* out, uint64_t* sizes, int ma
   Entry* t = table(s);
   for (uint64_t i = 0; i < s->hdr->table_capacity; i++) {
     Entry* e = &t[i];
-    if (e->state != kSealed || e->refcount != 0) continue;
+    if (e->state != kSealed) continue;
+    if (e->pending_delete) continue;
+    if (!include_pinned && e->refcount != 0) continue;
     if (n == max_n && e->lru_tick >= cand[n - 1].tick) continue;
     int pos = (n < max_n) ? n : max_n - 1;
     while (pos > 0 && cand[pos - 1].tick > e->lru_tick) {
@@ -583,6 +585,38 @@ int shm_store_list_evictable(void* handle, uint8_t* out, uint64_t* sizes, int ma
     memcpy(out + i * kIdLen, cand[i].id, kIdLen);
     sizes[i] = cand[i].size;
   }
+  return n;
+}
+
+int shm_store_list_evictable(void* handle, uint8_t* out, uint64_t* sizes, int max_n) {
+  return list_cold(reinterpret_cast<Store*>(handle), out, sizes, max_n, false);
+}
+
+// Spill candidates additionally include PINNED sealed entries: spilling
+// copies the bytes to disk and the owner then releases its pin (GCS
+// spill notice), which is how owner-pinned data yields arena space under
+// pressure — eviction proper must still never touch a pinned entry.
+int shm_store_list_spillable(void* handle, uint8_t* out, uint64_t* sizes, int max_n) {
+  return list_cold(reinterpret_cast<Store*>(handle), out, sizes, max_n, true);
+}
+
+// Debug probe: ids + refcounts + sizes + states of up to max_n entries.
+int shm_store_dump_entries(void* handle, uint8_t* ids, int64_t* refs,
+                           uint64_t* sizes, int32_t* states, int max_n) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* t = table(s);
+  int n = 0;
+  for (uint64_t i = 0; i < s->hdr->table_capacity && n < max_n; i++) {
+    Entry* e = &t[i];
+    if (e->state == 0) continue;
+    memcpy(ids + n * kIdLen, e->id, kIdLen);
+    refs[n] = (int64_t)e->refcount;
+    sizes[n] = e->size;
+    states[n] = (int32_t)e->state | (e->pending_delete ? 0x100 : 0);
+    n++;
+  }
+  unlock(s);
   return n;
 }
 
