@@ -184,6 +184,9 @@ class CoreWorker:
         self._ref_events: collections.deque = collections.deque()
         # submission-time arg references: task_id/returns[0] -> arg oids
         self._task_arg_pins: Dict[Any, List[bytes]] = {}
+        # borrows awaiting directory registration (flushed sync before a
+        # task reply, async by the gc loop otherwise)
+        self._borrows_to_flush: set = set()
 
         # function table cache
         self._fn_cache: Dict[str, Any] = {}
@@ -386,6 +389,7 @@ class CoreWorker:
             await asyncio.sleep(0.1)
             self._sweep_handoff_pins()
             self._drain_ref_events()
+            self._flush_borrows_async()
             # pins whose numpy views were still alive at free time:
             # re-try here so arena space is reclaimed promptly once
             # the views die, not only at the next unrelated free
@@ -398,11 +402,19 @@ class CoreWorker:
         dead: List[bytes] = []
         borrowed_done: List[bytes] = []
         pin_done: List[bytes] = []
+        borrow_new: List[bytes] = []
         with self._store_lock:
             while self._ref_events:
                 created, oid = self._ref_events.popleft()
                 if created:
-                    self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+                    n = self._local_refs.get(oid, 0)
+                    self._local_refs[oid] = n + 1
+                    if n == 0 and oid not in self._owned:
+                        # first local ref to someone ELSE's object: we are
+                        # now a BORROWER — the owner must not free it until
+                        # we let go (reference: reference_count.cc borrowed
+                        # refs / WaitForRefRemoved)
+                        borrow_new.append(oid)
                     continue
                 n = self._local_refs.get(oid, 0) - 1
                 if n > 0:
@@ -420,12 +432,12 @@ class CoreWorker:
                         self._dir_free_pending.append(oid)
                         dead.append(oid)
                     else:
-                        # escaped (shared) owned object: full deletion
-                        # still needs explicit free() (borrowers may hold
-                        # it), but OUR primary-copy pin must drop — the
-                        # entry becomes evictable/spillable once borrowers
-                        # release theirs too. The cached env STAYS (the
-                        # owner keeps serving owner.resolve for it).
+                        # escaped (shared) owned object whose last OWNER
+                        # ref died: hand the liveness decision to the
+                        # directory — it frees everything if no borrower
+                        # holds a ref, or waits for the last borrower's
+                        # release (reference: WaitForRefRemoved). The pin
+                        # and env stay until the verdict comes back.
                         pin_done.append(oid)
                 else:
                     # BORROWED ref: this process only holds a read pin on
@@ -447,11 +459,82 @@ class CoreWorker:
                     self._gcs.push("obj.free", {"oids": o})
                 )
             )
-        for oid in pin_done:
-            buf = self._pinned.pop(oid, None)
-            if buf is not None and not buf.try_release():
+        if pin_done:
+            self._push_gcs_batched("obj.owner_released", pin_done)
+        if borrow_new:
+            with self._store_lock:
+                self._borrows_to_flush.update(borrow_new)
+        if borrowed_done:
+            # a borrow that died before it was ever flushed needs no
+            # registration at all (transient borrow)
+            with self._store_lock:
+                unflushed = self._borrows_to_flush.intersection(borrowed_done)
+                self._borrows_to_flush.difference_update(unflushed)
+            notify = [o for o in borrowed_done if o not in unflushed]
+            if notify:
+                self._push_gcs_batched("obj.borrow_release", notify)
+
+    def _flush_borrows_async(self):
+        """gc-loop flush for borrows originating outside task execution
+        (e.g. a driver unpickling refs out of a get() result).
+        Task-execution borrows are flushed SYNCHRONOUSLY before the task
+        reply (flush_borrows_sync) so the owner cannot release first —
+        which is why _drain_ref_events itself must NOT flush: it runs at
+        the top of flush_borrows_sync, and flushing there would turn the
+        synchronous registration into a fire-and-forget race."""
+        with self._store_lock:
+            if not self._borrows_to_flush:
+                return
+            flush = list(self._borrows_to_flush)
+            self._borrows_to_flush.clear()
+        self._push_gcs_batched("obj.borrow", flush)
+
+    def flush_borrows_sync(self):
+        """Called by the executor BEFORE a task's reply ships: register any
+        still-held borrows with the directory synchronously. The caller's
+        submission-time arg pin guarantees the owner cannot have released
+        yet, and the awaited request guarantees the directory knows about
+        the borrow before the owner's release can possibly be processed
+        (reference: borrowed refs are reported in the task reply,
+        reference_count.cc OnWorkerTaskReply)."""
+        self._drain_ref_events()
+        with self._store_lock:
+            if not self._borrows_to_flush:
+                return
+            oids = [o for o in self._borrows_to_flush if self._local_refs.get(o)]
+            self._borrows_to_flush.clear()
+        if oids:
+            try:
+                self._call(
+                    self._gcs.request("obj.borrow", {"oids": oids, "client": self.client_id}),
+                    timeout=30,
+                )
+            except Exception:
+                # keep them queued: the async gc-loop path retries — losing
+                # the registration would let the owner free a live borrow
                 with self._store_lock:
-                    self._release_retry.append(buf)
+                    self._borrows_to_flush.update(oids)
+                logger.warning("borrow registration failed for %d oids (requeued)", len(oids))
+
+    def _push_gcs_batched(self, method: str, oids: List[bytes]):
+        """Loop-safe fire-and-forget GCS push of an oid batch."""
+        self._loop.call_soon_threadsafe(
+            lambda m=method, o=list(oids): self._loop.create_task(
+                self._gcs.push(m, {"oids": o, "client": self.client_id})
+            )
+        )
+
+    def _on_all_borrows_done(self, data):
+        """GCS verdict: our owner refs AND every borrower's refs are gone —
+        free the object fully (pin, env, arena entry, bookkeeping)."""
+        for oid in data["oids"]:
+            oid = bytes(oid)
+            with self._store_lock:
+                if self._local_refs.get(oid):
+                    continue  # resurrected (new local ref) — GCS re-asks later
+                self._gcs_registered.discard(oid)
+                self._pin_registered.discard(oid)
+            self._local_free(oid)
 
     def _pin_owned(self, oid: bytes, env: Dict[str, Any]):
         """OWNER-PINNED primary copies (reference: plasma pinning of
@@ -611,6 +694,9 @@ class CoreWorker:
         if method == "obj.spill_release":
             self._on_spill_release(data)
             return True
+        if method == "obj.all_borrows_done":
+            self._on_all_borrows_done(data)
+            return True
         if method == "owner.resolve":
             return await self._serve_owner_resolve(data)
         raise ValueError(f"unexpected GCS push {method}")
@@ -689,13 +775,13 @@ class CoreWorker:
         a task must keep its object alive until that task completes, even
         if the caller drops its own ObjectRef right after submission — the
         streaming executor does exactly that."""
-        if not packed.get("hr"):
+        if not packed.get("hr") and not packed.get("nr"):
             return
         oids = [
             bytes(p["r"])
             for p in list(packed["a"]) + list(packed["kw"].values())
             if "r" in p
-        ]
+        ] + [bytes(o) for o in packed.get("nr", ())]
         if oids:
             self._task_arg_pins[key] = oids
             for oid in oids:
@@ -1245,6 +1331,9 @@ class CoreWorker:
                 self._store.pop(roid, None)
             self._owned.update(respec["returns"])
         cells = [self._make_pending(roid) for roid in respec["returns"]]
+        # the re-flight needs its ref args protected exactly like a fresh
+        # submission (unpinned again at _record_lineage on completion)
+        self._pin_args(respec["task_id"], respec["args"])
         buf = self._pinned.pop(oid, None)
         if buf is not None and not buf.try_release():
             with self._store_lock:
@@ -1347,18 +1436,25 @@ class CoreWorker:
         """Top-level ObjectRefs are passed by reference (resolved to values
         by the executor); everything else is serialized inline or via shm
         (reference: inline-small-args in dependency_resolver.cc)."""
+        nested: List[bytes] = []
         packed = []
         for a in args:
-            packed.append(self._pack_one(a))
-        packed_kw = {k: self._pack_one(v) for k, v in kwargs.items()}
+            packed.append(self._pack_one(a, nested))
+        packed_kw = {k: self._pack_one(v, nested) for k, v in kwargs.items()}
         out = {"a": packed, "kw": packed_kw}
         # "hr" (has refs) lets the hot paths (sender-loop dep scan, worker
         # batch staging) skip per-call ref scans for the common ref-free call
         if any("r" in p for p in packed) or any("r" in p for p in packed_kw.values()):
             out["hr"] = 1
+        if nested:
+            # refs NESTED inside serialized values: the submitter must pin
+            # these for the task's flight too (_pin_args) — the consumer
+            # resolves them mid-execution, possibly after the caller
+            # dropped its own handles
+            out["nr"] = nested
         return out
 
-    def _pack_one(self, value):
+    def _pack_one(self, value, nested: Optional[List[bytes]] = None):
         if isinstance(value, ObjectRef):
             # the executor will resolve this ref: the directory must know us
             self._ensure_registered([value.binary()])
@@ -1367,6 +1463,8 @@ class CoreWorker:
         if refs:
             # refs nested inside the value can be resolved by the receiver
             self._ensure_registered([r.binary() for r in refs])
+            if nested is not None:
+                nested.extend(r.binary() for r in refs)
         total = serialization.serialized_size(pickled, buffers)
         if total <= RayConfig.object_store_inline_max_bytes or self._shm is None:
             return {"v": serialization.to_wire(pickled, buffers)}

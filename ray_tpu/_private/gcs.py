@@ -44,6 +44,9 @@ from ray_tpu._private.ids import hex_id, new_id
 
 logger = logging.getLogger("ray_tpu.gcs")
 
+# directory-trace debug logging (hot paths check this constant, not environ)
+_DEBUG_DIR = bool(os.environ.get("RAY_TPU_DEBUG_DIR"))
+
 # actor lifecycle states (reference: rpc::ActorTableData states)
 DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
 PENDING_CREATION = "PENDING_CREATION"
@@ -355,6 +358,17 @@ class GcsServer:
                 job["end_time"] = time.time()
                 self._persist("jobs", "put", job)
             await self._cleanup_driver(client_id, info)
+        # a dead client can never send borrow_release: sweep its borrows so
+        # owner-released objects it was holding up get freed
+        freed = []
+        for oid, rec in list(self.objects.items()):
+            borrowers = rec.get("borrowers")
+            if borrowers and client_id in borrowers:
+                borrowers.discard(client_id)
+                if rec.get("owner_released") and not borrowers:
+                    freed.append(oid)
+        for oid in freed:
+            await self._free_object_everywhere(oid)
 
     async def _cleanup_driver(self, client_id: str, info):
         """Kill non-detached actors owned by the exiting driver; drop owned objects."""
@@ -972,7 +986,7 @@ class GcsServer:
         return True
 
     async def _rpc_obj_add_location(self, d, conn):
-        if os.environ.get("RAY_TPU_DEBUG_DIR"):
+        if _DEBUG_DIR:
             logger.info("DIR add_location %s node=%s", bytes(d["oid"]).hex()[:12], d["node_id"])
         rec = self.objects.get(d["oid"])
         if rec is None:
@@ -988,7 +1002,7 @@ class GcsServer:
         (reference: ADVICE r1 — resolve must not keep answering 'local'
         for data that no longer exists)."""
         rec = self.objects.get(bytes(d["oid"]))
-        if os.environ.get("RAY_TPU_DEBUG_DIR"):
+        if _DEBUG_DIR:
             logger.info("DIR location_gone %s rec=%s", bytes(d["oid"]).hex()[:12], rec and {"loc": list(rec["locations"]), "sp": bool(rec.get("spilled"))})
         if rec is not None:
             rec["locations"].discard(d["node_id"])
@@ -999,7 +1013,7 @@ class GcsServer:
         remember the file (reference: spilled URL tracking in the object
         directory)."""
         oid = bytes(d["oid"])
-        if os.environ.get("RAY_TPU_DEBUG_DIR"):
+        if _DEBUG_DIR:
             logger.info("DIR spilled %s", oid.hex()[:12])
         rec = self.objects.setdefault(
             oid, {"owner": self.conn_client.get(conn), "inline": None, "locations": set(), "size": 0}
@@ -1074,7 +1088,7 @@ class GcsServer:
                     rec["locations"].add(requester_node)
                     return {"status": "local", "size": rec["size"]}
         owner = self.clients.get(rec.get("owner") or "")
-        if os.environ.get("RAY_TPU_DEBUG_DIR"):
+        if _DEBUG_DIR:
             logger.info(
                 "DIR resolve %s -> %s (loc=%s sp=%s)",
                 bytes(oid).hex()[:12],
@@ -1085,6 +1099,88 @@ class GcsServer:
         if owner is None:
             return {"status": "lost"}
         return {"status": "owner", "owner_addr": owner["addr"]}
+
+    # ---- borrower protocol (reference: reference_count.cc borrowed refs:
+    # the owner defers freeing a shared object until every process that
+    # unpickled a ref to it has dropped theirs; here the directory holds
+    # the borrower sets and arbitrates, batched pushes both ways) ----
+    async def _rpc_obj_borrow(self, d, conn):
+        client = d.get("client") or self.conn_client.get(conn)
+        if _DEBUG_DIR:
+            logger.info("DIR borrow %s by %s", [bytes(o).hex()[:12] for o in d["oids"]], (client or "?")[:12])
+        for oid in d["oids"]:
+            oid = bytes(oid)
+            rec = self.objects.setdefault(
+                oid, {"owner": None, "inline": None, "locations": set(), "size": 0}
+            )
+            rec.setdefault("borrowers", set()).add(client)
+        return True
+
+    async def _rpc_obj_borrow_release(self, d, conn):
+        client = d.get("client") or self.conn_client.get(conn)
+        if _DEBUG_DIR:
+            logger.info("DIR borrow_release %s by %s", [bytes(o).hex()[:12] for o in d["oids"]], (client or "?")[:12])
+        done = []
+        for oid in d["oids"]:
+            oid = bytes(oid)
+            rec = self.objects.get(oid)
+            if rec is None:
+                continue
+            borrowers = rec.get("borrowers")
+            if borrowers is not None:
+                borrowers.discard(client)
+            if rec.get("owner_released") and not borrowers:
+                done.append(oid)
+        for oid in done:
+            await self._free_object_everywhere(oid)
+        return True
+
+    async def _rpc_obj_owner_released(self, d, conn):
+        if _DEBUG_DIR:
+            logger.info("DIR owner_released %s", [bytes(o).hex()[:12] for o in d["oids"]])
+        done = []
+        for oid in d["oids"]:
+            oid = bytes(oid)
+            rec = self.objects.get(oid)
+            if rec is None:
+                continue
+            if rec.get("borrowers"):
+                rec["owner_released"] = True  # wait for the last borrower
+            else:
+                done.append(oid)
+        for oid in done:
+            await self._free_object_everywhere(oid)
+        return True
+
+    async def _free_object_everywhere(self, oid: bytes):
+        """No refs anywhere: retire the record, delete arena copies,
+        unlink spill files, tell the owner to drop its pin/env."""
+        rec = self.objects.pop(oid, None)
+        if _DEBUG_DIR:
+            logger.info("DIR free_everywhere %s rec=%s", oid.hex()[:12], rec is not None)
+        if rec is None:
+            return
+        for node_id in rec["locations"]:
+            node = self.nodes.get(node_id)
+            if node and node["state"] == "ALIVE":
+                try:
+                    await node["conn"].push("raylet.delete_objects", {"oids": [oid]})
+                except Exception:
+                    pass
+        sp = rec.get("spilled")
+        if sp:
+            node = self.nodes.get(sp["node_id"])
+            if node and node["state"] == "ALIVE":
+                try:
+                    await node["conn"].push("raylet.unlink_spilled", {"path": sp["path"]})
+                except Exception:
+                    pass
+        owner = self.clients.get(rec.get("owner") or "")
+        if owner is not None and owner.get("conn") is not None:
+            try:
+                await owner["conn"].push("obj.all_borrows_done", {"oids": [oid]})
+            except Exception:
+                pass
 
     async def _rpc_obj_free(self, d, conn):
         for oid in d["oids"]:

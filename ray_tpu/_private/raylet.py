@@ -173,14 +173,16 @@ class Raylet:
         rebuilds. Spilled objects restore on demand. A writer that hits
         FULL kicks `_spill_wakeup` instead of waiting out the period."""
         self._spill_wakeup = asyncio.Event()
+        self._spill_force = False
         while True:
             try:
                 await asyncio.wait_for(self._spill_wakeup.wait(), timeout=1.0)
             except asyncio.TimeoutError:
                 pass
             self._spill_wakeup.clear()
+            force, self._spill_force = self._spill_force, False
             try:
-                await self._spill_pass()
+                await self._spill_pass(force=force)
             except Exception:
                 logger.exception("spill loop iteration failed")
 
@@ -223,6 +225,10 @@ class Raylet:
         oid = bytes(data["oid"])
         if self.store.contains(oid):
             return True
+        if self.store.undelete(oid):
+            # the spilled entry was pending_delete (a pin released late):
+            # its bytes never left the arena — resurrect in place
+            return True
         path = data["path"]
         with open(path, "rb") as f:
             blob = f.read()
@@ -241,6 +247,9 @@ class Raylet:
                     raise
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, 0.5)
+            except FileExistsError:
+                # raced with a concurrent restore/undelete
+                break
         try:
             os.unlink(path)
         except OSError:
@@ -545,12 +554,14 @@ class Raylet:
         if method == "raylet.restore_spilled":
             return await self._restore_spilled(data)
         if method == "raylet.spill_hint":
-            # a writer hit FULL: spill NOW — even if usage is below the
-            # proactive threshold, everything left may be pinned
+            # a writer hit FULL: wake the spill loop NOW with the force
+            # flag — even if usage is below the proactive threshold,
+            # everything left may be pinned. (One loop, not an ad-hoc
+            # task: concurrent passes would double-spill candidates.)
+            self._spill_force = True
             ev = getattr(self, "_spill_wakeup", None)
             if ev is not None:
                 ev.set()
-            asyncio.get_running_loop().create_task(self._spill_pass(force=True))
             return True
         if method == "raylet.unlink_spilled":
             try:

@@ -67,6 +67,8 @@ def _load() -> ctypes.CDLL:
         lib.shm_store_get.restype = ctypes.c_int
         lib.shm_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.shm_store_contains.restype = ctypes.c_int
+        lib.shm_store_undelete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_undelete.restype = ctypes.c_int
         lib.shm_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.shm_store_release.restype = ctypes.c_int
         lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -230,6 +232,10 @@ class ShmStore:
 
     def delete(self, object_id: bytes):
         self._lib.shm_store_delete(self._handle, object_id)
+
+    def undelete(self, object_id: bytes) -> bool:
+        """Resurrect a pending-delete entry whose bytes are still intact."""
+        return self._lib.shm_store_undelete(self._handle, object_id) == ST_OK
 
     def usage(self):
         used = ctypes.c_uint64()

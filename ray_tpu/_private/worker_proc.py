@@ -262,6 +262,15 @@ class Executor:
                     # rest of the batch
                     if not appended:
                         out.append(_cancelled_envs(spec))
+            # BEFORE the reply ships: register any borrows this batch's
+            # tasks retained (refs unpickled from args and stored). The
+            # caller's arg pin is still held until it processes our reply,
+            # so the directory learns of the borrow strictly before the
+            # owner could release (reference: borrows ride the task
+            # reply). Cheap guard keeps ref-free fan-out batches at zero
+            # extra work.
+            if self.core._ref_events or self.core._borrows_to_flush:
+                self.core.flush_borrows_sync()
             return out
         finally:
             if self._exec_prof is not None:
@@ -375,7 +384,13 @@ class Executor:
             # sync path: ONE executor hop covering unpack → invoke →
             # serialize (each hop is a loop⇄thread round trip; the 1:1
             # sync actor-call benchmark lives and dies on these)
-            return await loop.run_in_executor(self.pool, self._exec_sync_one, spec, actor, loop)
+            envs = await loop.run_in_executor(self.pool, self._exec_sync_one, spec, actor, loop)
+            if self.core._ref_events or self.core._borrows_to_flush:
+                # the call touched ObjectRefs: register retained borrows
+                # BEFORE the reply ships (cheap check keeps the ref-free
+                # fan-out path at zero extra hops)
+                await loop.run_in_executor(None, self.core.flush_borrows_sync)
+            return envs
         try:
             # async actor: unpack off-loop, run the coroutine on the
             # dedicated user loop (awaited from here without blocking)
@@ -386,7 +401,11 @@ class Executor:
             values = self._split_returns(spec, result)
             if values is None:
                 return [self._bad_arity_env(spec, name)] * len(spec["returns"])
-            return [await self._to_env(oid, v) for oid, v in zip(spec["returns"], values)]
+            envs = [await self._to_env(oid, v) for oid, v in zip(spec["returns"], values)]
+            # borrow registration before the reply (same contract as the
+            # sync batch path; run off-loop — it blocks on a GCS request)
+            await loop.run_in_executor(None, self.core.flush_borrows_sync)
+            return envs
         except (Exception, KeyboardInterrupt) as e:
             tb = traceback.format_exc()
             logger.info("task %s failed: %s", name, tb)

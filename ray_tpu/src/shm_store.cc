@@ -470,7 +470,9 @@ int shm_store_get(void* handle, const uint8_t* id, uint64_t* offset_out,
   lock(s);
   for (;;) {
     Entry* e = find_entry(s, id);
-    if (e && e->state == kSealed) {
+    if (e && e->state == kSealed && !e->pending_delete) {
+      // pending_delete entries are DELETED from readers' point of view:
+      // their payload only survives for refs taken before the delete
       e->refcount++;
       e->lru_tick = ++s->hdr->lru_counter;
       *offset_out = e->offset;
@@ -504,9 +506,27 @@ int shm_store_contains(void* handle, const uint8_t* id) {
   Store* s = reinterpret_cast<Store*>(handle);
   lock(s);
   Entry* e = find_entry(s, id);
-  int r = (e && e->state == kSealed) ? 1 : 0;
+  // pending_delete entries are deleted from readers' point of view
+  int r = (e && e->state == kSealed && !e->pending_delete) ? 1 : 0;
   unlock(s);
   return r;
+}
+
+int shm_store_undelete(void* handle, const uint8_t* id) {
+  // Resurrect a pending_delete entry whose payload is still intact (its
+  // last readers haven't released yet): restore-from-spill uses this to
+  // bring a just-spilled object back without re-reading the file.
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* e = find_entry(s, id);
+  if (e && e->state == kSealed && e->pending_delete) {
+    e->pending_delete = 0;
+    e->lru_tick = ++s->hdr->lru_counter;
+    unlock(s);
+    return ST_OK;
+  }
+  unlock(s);
+  return ST_NOT_FOUND;
 }
 
 int shm_store_release(void* handle, const uint8_t* id) {
