@@ -187,6 +187,9 @@ class CoreWorker:
         # borrows awaiting directory registration (flushed sync before a
         # task reply, async by the gc loop otherwise)
         self._borrows_to_flush: set = set()
+        # oid -> [ObjectRef]: receiver-side holds for refs embedded in a
+        # delivered value ("rf"), dropped when the env leaves the store
+        self._ref_holds: Dict[bytes, List[Any]] = {}
 
         # function table cache
         self._fn_cache: Dict[str, Any] = {}
@@ -485,9 +488,10 @@ class CoreWorker:
         with self._store_lock:
             if not self._borrows_to_flush:
                 return
-            flush = list(self._borrows_to_flush)
+            flush = [o for o in self._borrows_to_flush if self._local_refs.get(o)]
             self._borrows_to_flush.clear()
-        self._push_gcs_batched("obj.borrow", flush)
+        if flush:
+            self._push_gcs_batched("obj.borrow", flush)
 
     def flush_borrows_sync(self):
         """Called by the executor BEFORE a task's reply ships: register any
@@ -536,6 +540,36 @@ class CoreWorker:
                 self._pin_registered.discard(oid)
             self._local_free(oid)
 
+    def escrow_refs(self, oids: List[bytes], grace_s: float = 60.0):
+        """Producer-side synthetic hold on refs embedded in a RESULT: our
+        local refs for them die when the task frame exits, and without
+        this the owner-release could reach the directory before the
+        caller (who learns of the refs from the envelope's "rf") registers
+        its borrow. The hold expires after `grace_s` — delivery-side
+        registration happens within one reply round trip."""
+        for oid in oids:
+            self._ref_events.append((True, oid))
+
+        def _expire():
+            for oid in oids:
+                self._ref_events.append((False, oid))
+
+        self._loop.call_soon_threadsafe(lambda: self._loop.call_later(grace_s, _expire))
+
+    def _attach_ref_holds(self, oid: bytes, env: Dict[str, Any]):
+        """Receiver side of "rf": hold live ObjectRefs for refs embedded
+        in a delivered value, tied to the envelope's residency in our
+        store (side table — the env dict itself travels on the wire and
+        must stay msgpack-clean). Makes this process a BORROWER of the
+        inner objects the moment the outer value arrives — not at
+        (possibly much later) decode — closing the producer escrow."""
+        rf = env.get("rf")
+        if rf and oid not in self._ref_holds:
+            self._ref_holds[oid] = [ObjectRef(bytes(o)) for o in rf]
+
+    def _drop_ref_holds(self, oid: bytes):
+        self._ref_holds.pop(oid, None)
+
     def _pin_owned(self, oid: bytes, env: Dict[str, Any]):
         """OWNER-PINNED primary copies (reference: plasma pinning of
         objects with live references — eviction must not take an object
@@ -582,6 +616,7 @@ class CoreWorker:
             if self._local_refs.get(oid):  # resurrected meanwhile
                 return
             self._store.pop(oid, None)
+            self._drop_ref_holds(oid)
         buf = self._pinned.pop(oid, None)
         if buf is not None and not buf.try_release():
             with self._store_lock:
@@ -600,6 +635,7 @@ class CoreWorker:
             self._store.pop(oid, None)
             self._owned.discard(oid)
             self._lineage.pop(oid, None)
+            self._drop_ref_holds(oid)
         buf = self._pinned.pop(oid, None)
         if buf is not None and not buf.try_release():
             with self._store_lock:
@@ -834,6 +870,7 @@ class CoreWorker:
                     special.append((oid, env))
                     continue
                 self._store[oid] = env
+                self._attach_ref_holds(oid, env)
                 if env.get("k") == "s" and oid in self._owned:
                     pin.append((oid, env))
                 cell = self._pending.pop(oid, None)
@@ -872,6 +909,7 @@ class CoreWorker:
                         self._loop.create_task(self._free_remote_shm(env["n"], oid))
                 return
             self._store[oid] = env
+            self._attach_ref_holds(oid, env)
             cell = self._pending.pop(oid, None)
         if env.get("k") == "s" and oid in self._owned:
             self._pin_owned(oid, env)
@@ -895,21 +933,29 @@ class CoreWorker:
         with self._store_lock:
             self._owned.add(oid)
         pickled, buffers, refs = serialization.serialize(value)
+        roids = [r.binary() for r in refs]
         if refs:
-            self._ensure_registered([r.binary() for r in refs])
+            self._ensure_registered(roids)
         total = serialization.serialized_size(pickled, buffers)
         if total <= RayConfig.object_store_inline_max_bytes or self._shm is None:
             data = bytearray(total)
             n = serialization.write_to(memoryview(data), pickled, buffers)
             env = _env_inline(bytes(data[:n]))
+            if refs:
+                env["rf"] = roids
             self._deliver(oid, env)
-            self._push_gcs("obj.put_inline", {"oid": oid, "data": env["d"]})
+            msg = {"oid": oid, "data": env["d"]}
+            if refs:
+                msg["rf"] = roids
+            self._push_gcs("obj.put_inline", msg)
         else:
             buf = self._create_with_gc(oid, total)
             serialization.write_to(buf, pickled, buffers)
             buf.release()
             self._shm.seal(oid)
             env = _env_shm(self.node_id, total)
+            if refs:
+                env["rf"] = roids
             self._deliver(oid, env)
             self._push_gcs("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": total})
         with self._store_lock:
@@ -1100,7 +1146,10 @@ class CoreWorker:
                 continue
             if status == "inline":
                 env = _env_inline(reply["data"])
+                if reply.get("rf"):
+                    env["rf"] = reply["rf"]
                 self._store[oid] = env
+                self._attach_ref_holds(oid, env)
                 return env
             if status == "local":
                 return _env_shm(self.node_id, reply["size"])
@@ -1129,6 +1178,7 @@ class CoreWorker:
                     await asyncio.sleep(0.01)
                     continue
                 self._store[oid] = env
+                self._attach_ref_holds(oid, env)
                 return env
             if status == "unknown" or status == "lost":
                 raise exceptions.ObjectLostError(oid.hex(), f"object {oid.hex()} {status}")

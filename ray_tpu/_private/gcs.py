@@ -983,6 +983,8 @@ class GcsServer:
         rec = self.objects.setdefault(d["oid"], {"owner": owner, "inline": None, "locations": set(), "size": 0})
         rec["inline"] = d["data"]
         rec["size"] = len(d["data"])
+        if d.get("rf"):
+            rec["rf"] = d["rf"]  # embedded refs: travel with resolves
         return True
 
     async def _rpc_obj_add_location(self, d, conn):
@@ -1061,7 +1063,10 @@ class GcsServer:
         if rec is None:
             return {"status": "unknown"}
         if rec["inline"] is not None:
-            return {"status": "inline", "data": rec["inline"]}
+            out = {"status": "inline", "data": rec["inline"]}
+            if rec.get("rf"):
+                out["rf"] = rec["rf"]
+            return out
         if not rec["locations"] and rec.get("spilled"):
             await self._restore_from_spill(oid, rec)
         requester_node = d.get("node_id")
@@ -1110,9 +1115,12 @@ class GcsServer:
             logger.info("DIR borrow %s by %s", [bytes(o).hex()[:12] for o in d["oids"]], (client or "?")[:12])
         for oid in d["oids"]:
             oid = bytes(oid)
-            rec = self.objects.setdefault(
-                oid, {"owner": None, "inline": None, "locations": set(), "size": 0}
-            )
+            rec = self.objects.get(oid)
+            if rec is None:
+                # already freed (or never registered): recreating a record
+                # here would leave an unreclaimable ghost — the borrower's
+                # eventual get() fails with lost, which is the truth
+                continue
             rec.setdefault("borrowers", set()).add(client)
         return True
 
@@ -1139,10 +1147,12 @@ class GcsServer:
         if _DEBUG_DIR:
             logger.info("DIR owner_released %s", [bytes(o).hex()[:12] for o in d["oids"]])
         done = []
+        gone = []
         for oid in d["oids"]:
             oid = bytes(oid)
             rec = self.objects.get(oid)
             if rec is None:
+                gone.append(oid)  # record already freed: tell the owner now
                 continue
             if rec.get("borrowers"):
                 rec["owner_released"] = True  # wait for the last borrower
@@ -1150,6 +1160,11 @@ class GcsServer:
                 done.append(oid)
         for oid in done:
             await self._free_object_everywhere(oid)
+        if gone:
+            try:
+                await conn.push("obj.all_borrows_done", {"oids": gone})
+            except Exception:
+                pass
         return True
 
     async def _free_object_everywhere(self, oid: bytes):

@@ -227,7 +227,13 @@ class Raylet:
             return True
         if self.store.undelete(oid):
             # the spilled entry was pending_delete (a pin released late):
-            # its bytes never left the arena — resurrect in place
+            # its bytes never left the arena — resurrect in place and drop
+            # the now-orphaned spill file (the GCS pops its spill record
+            # on restore success, so nothing else would ever unlink it)
+            try:
+                os.unlink(data["path"])
+            except OSError:
+                pass
             return True
         path = data["path"]
         with open(path, "rb") as f:
@@ -710,6 +716,10 @@ class Raylet:
         try:
             buf = self.store.create_buffer(oid, size)
         except FileExistsError:
+            # present — or pending_delete (invisible to readers but still
+            # blocking create): resurrect the intact bytes in that case
+            if not self.store.contains(oid):
+                self.store.undelete(oid)
             return True
         off = 0
         try:

@@ -400,19 +400,30 @@ class Executor:
             result = await asyncio.wrap_future(cfut)
             values = self._split_returns(spec, result)
             if values is None:
+                await self._flush_borrows_off_loop(loop)
                 return [self._bad_arity_env(spec, name)] * len(spec["returns"])
             envs = [await self._to_env(oid, v) for oid, v in zip(spec["returns"], values)]
-            # borrow registration before the reply (same contract as the
-            # sync batch path; run off-loop — it blocks on a GCS request)
-            await loop.run_in_executor(None, self.core.flush_borrows_sync)
+            await self._flush_borrows_off_loop(loop)
             return envs
         except (Exception, KeyboardInterrupt) as e:
             tb = traceback.format_exc()
             logger.info("task %s failed: %s", name, tb)
+            # a FAILED call may still have retained borrows (self.ref = x
+            # before raising) — same register-before-reply contract
+            try:
+                await self._flush_borrows_off_loop(loop)
+            except Exception:
+                pass
             tid = spec.get("task_id") or spec["returns"][0]
             if isinstance(e, (KeyboardInterrupt,)) or tid in self._cancelled:
                 return _cancelled_envs(spec)
             return [_env_err(e, name)] * len(spec["returns"])
+
+    async def _flush_borrows_off_loop(self, loop):
+        """Guarded borrow flush for async-actor paths: zero extra hops on
+        the ref-free hot path, one executor hop only when refs moved."""
+        if self.core._ref_events or self.core._borrows_to_flush:
+            await loop.run_in_executor(None, self.core.flush_borrows_sync)
 
     def _split_returns(self, spec, result):
         n = len(spec["returns"])
@@ -430,28 +441,42 @@ class Executor:
         """Serialize a result on the current (executor) thread."""
         pickled, buffers, refs = serialization.serialize(value)
         if refs:
-            # refs nested in a RESULT escape to the caller: any we own
-            # (created inside this task) must hit the directory before
-            # the caller resolves them (same contract as put/pack_args)
-            self.core._ensure_registered([r.binary() for r in refs])
+            # refs nested in a RESULT escape to the caller: register them
+            # with the directory, ESCROW them locally (a synthetic hold so
+            # our owner-release can't fire before the caller becomes a
+            # borrower), and advertise them in the envelope ("rf") so the
+            # caller registers its borrow at DELIVERY, not at lazy decode
+            # (reference: returned refs tracked through the reply,
+            # reference_count.cc nested return ids)
+            roids = [r.binary() for r in refs]
+            self.core._ensure_registered(roids)
+            self.core.escrow_refs(roids)
         total = serialization.serialized_size(pickled, buffers)
         if total <= RayConfig.object_store_inline_max_bytes or self.core._shm is None:
-            return _env_inline(serialization.to_wire(pickled, buffers))
-        return self.core.put_serialized_to_shm(bytes(oid), pickled, buffers)
+            env = _env_inline(serialization.to_wire(pickled, buffers))
+        else:
+            env = self.core.put_serialized_to_shm(bytes(oid), pickled, buffers)
+        if refs:
+            env["rf"] = roids
+        return env
 
     async def _to_env(self, oid: bytes, value: Any):
         loop = asyncio.get_running_loop()
 
         def _ser():
             pickled, buffers, refs = serialization.serialize(value)
+            roids = [r.binary() for r in refs]
             if refs:
-                self.core._ensure_registered([r.binary() for r in refs])
+                self.core._ensure_registered(roids)
+                self.core.escrow_refs(roids)
             total = serialization.serialized_size(pickled, buffers)
             if total <= RayConfig.object_store_inline_max_bytes or self.core._shm is None:
-                data = bytearray(total)
-                n = serialization.write_to(memoryview(data), pickled, buffers)
-                return _env_inline(bytes(data[:n]))
-            return self.core.put_serialized_to_shm(bytes(oid), pickled, buffers)
+                env = _env_inline(serialization.to_wire(pickled, buffers))
+            else:
+                env = self.core.put_serialized_to_shm(bytes(oid), pickled, buffers)
+            if refs:
+                env["rf"] = roids
+            return env
 
         try:
             return await loop.run_in_executor(self.pool, _ser)

@@ -1,0 +1,139 @@
+"""Multi-host SPMD on one box: jax.distributed over GCS-KV rendezvous.
+
+Converts the framework's central multi-host claim from prose to fact
+(reference semantics: train/torch/config.py:47-99 — what the NCCL
+rendezvous achieves there, jax.distributed + the GCS KV achieve here;
+testable on one machine exactly like the reference's multi-process
+Gloo/NCCL tests, using the jax CPU backend).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    ray_tpu.init(num_cpus=8, object_store_memory=128 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_two_process_jax_distributed_psum(ray_start_regular):
+    """Two worker processes rendezvous through initialize_multihost (the
+    coordinator address travels through the GCS KV) and run a REAL
+    cross-process collective on the jax CPU backend."""
+
+    @ray_tpu.remote(max_concurrency=2)
+    class SpmdWorker:
+        def run(self, rank, port):
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.parallel.mesh import initialize_multihost
+
+            initialize_multihost(
+                coordinator_address=f"127.0.0.1:{port}" if rank == 0 else None,
+                num_processes=2,
+                process_id=rank,
+                rendezvous_key=f"test_mh_{port}",
+            )
+            assert jax.process_count() == 2
+            nloc = jax.local_device_count()
+            assert len(jax.devices()) == 2 * nloc  # both processes' devices, global view
+            out = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+                jnp.ones((nloc,)) * (rank + 1)
+            )
+            # global psum over both processes' shards: nloc*1 + nloc*2
+            return float(np.asarray(out)[0]) / nloc
+
+    port = 29870 + int(time.time()) % 1000  # avoid cross-run collisions
+    w0 = SpmdWorker.remote()
+    w1 = SpmdWorker.remote()
+    r0 = w0.run.remote(0, port)
+    r1 = w1.run.remote(1, port)
+    v0, v1 = ray_tpu.get([r0, r1], timeout=180)
+    assert v0 == 3.0 and v1 == 3.0
+
+
+def test_jax_trainer_multiworker_global_mesh(ray_start_regular):
+    """JaxTrainer with num_workers=2: each worker initializes the global
+    mesh through the GCS-KV rendezvous and trains data-parallel with a
+    cross-process gradient psum; both report the same global result."""
+    from ray_tpu.air.config import RunConfig, ScalingConfig
+    from ray_tpu.train.jax_trainer import JaxTrainer
+
+    import time as _t
+
+    port = 29370 + int(_t.time()) % 500
+
+    def train_loop(config):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import train as train_api
+        from ray_tpu.parallel.mesh import initialize_multihost
+
+        ctx = train_api.get_context()
+        rank, world = ctx.get_world_rank(), ctx.get_world_size()
+        initialize_multihost(
+            coordinator_address=f"127.0.0.1:{config['port']}" if rank == 0 else None,
+            num_processes=world,
+            process_id=rank,
+            rendezvous_key=f"trainer_mh_{config['port']}",
+        )
+        assert jax.process_count() == world
+        # data-parallel sgd step on a shared scalar model: grad averaging
+        # across processes via psum — the NCCL-allreduce equivalent
+        w = jnp.zeros(())
+        nloc = jax.local_device_count()
+        local_grad = jnp.ones((nloc,)) * (rank + 1)
+        avg = jax.pmap(
+            lambda g: jax.lax.psum(g, "i") / jax.device_count(), axis_name="i"
+        )(local_grad)[0]
+        w = w - 0.1 * avg
+        train_api.report({"w": float(w), "rank": rank})
+
+    trainer = JaxTrainer(
+        train_loop,
+        train_loop_config={"port": port},
+        scaling_config=ScalingConfig(num_workers=2, use_tpu=False),
+        run_config=RunConfig(name="mh_trainer_test"),
+    )
+    result = trainer.fit()
+    # avg grad = (1 + 2) / 2 = 1.5 -> w = -0.15 on every rank
+    assert abs(result.metrics["w"] + 0.15) < 1e-6
+
+
+def test_learner_group_lockstep_weight_equality(ray_start_regular):
+    """2 remote learners: after lockstep averaged updates, both hold
+    IDENTICAL weights (the DDP-equality contract; reference:
+    core/learner/torch/torch_learner.py DDP wrapping)."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib.algorithms.bc.bc import BCConfig
+    from ray_tpu.rllib.core.learner.learner_group import LearnerGroup
+
+    config = BCConfig().environment("CartPole-v1").training(num_learners=2)
+    env = gym.make("CartPole-v1")
+    group = LearnerGroup(config, env.observation_space, env.action_space)
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": rng.normal(size=(64, 4)).astype(np.float32),
+        "actions": rng.integers(0, 2, size=(64,)).astype(np.int32),
+    }
+    for _ in range(3):
+        group.update(batch)
+    weights = ray_tpu.get([w.get_weights.remote() for w in group._workers])
+    assert len(weights) == 2
+    import jax
+
+    flat0 = jax.tree_util.tree_leaves(weights[0])
+    flat1 = jax.tree_util.tree_leaves(weights[1])
+    assert len(flat0) == len(flat1) and len(flat0) > 0
+    for a, b in zip(flat0, flat1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    for w in group._workers:
+        ray_tpu.kill(w)
