@@ -73,6 +73,15 @@ class LlamaConfig:
             vocab_size=32000, d_model=1024, n_layers=8, n_heads=8,
             n_kv_heads=8, d_ff=4096, max_seq_len=2048), **kw})
 
+    @staticmethod
+    def b1_tpu(**kw) -> "LlamaConfig":
+        """~1.2B-param chip-filling bench config (bf16 params ≈ 2.4 GB):
+        with grads + Adam state + activations this exercises the remat
+        and donation machinery a 165M nano model never touches."""
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, d_model=2048, n_layers=18, n_heads=16,
+            n_kv_heads=16, d_ff=8192, max_seq_len=4096), **kw})
+
 
 def init_params(key, cfg: LlamaConfig) -> Dict[str, Any]:
     """Returns a params pytree; see logical_axes() for its sharding twin."""
@@ -190,7 +199,20 @@ def _layer_fn(layer, x, cos_sin, cfg: LlamaConfig, mesh=None, rules=None):
 def forward(params, tokens, cfg: LlamaConfig, mesh=None, rules=None):
     """tokens: [B, T] int32 → logits [B, T, vocab] (fp32)."""
     B, T = tokens.shape
-    x = params["embed"][tokens].astype(cfg.dtype)
+    embed = params["embed"]
+    if mesh is not None and rules is not None:
+        from ray_tpu.parallel.sharding import constraint
+
+        # explicit all-gather of the (fsdp-sharded) table before the
+        # lookup: a gather of a value-sharded table by batch-sharded
+        # indices otherwise trips SPMD's replicate-as-last-resort path
+        # ("Involuntary full rematerialization" warnings)
+        embed = constraint(embed, mesh, (None, None), rules)
+    x = embed[tokens].astype(cfg.dtype)
+    if mesh is not None and rules is not None:
+        from ray_tpu.parallel.sharding import constraint
+
+        x = constraint(x, mesh, ("batch", "seq", "act_embed"), rules)
     cos, sin = rope_frequencies(cfg.head_dim, T, cfg.rope_theta)
 
     layer_fn = functools.partial(_layer_fn, cfg=cfg, mesh=mesh, rules=rules)

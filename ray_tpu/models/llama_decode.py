@@ -43,16 +43,20 @@ def _gqa_attend(q, k_cache, v_cache, pos, cfg: LlamaConfig):
     B, _, h, hd = q.shape
     S = k_cache.shape[1]
     groups = h // cfg.n_kv_heads
-    qf = q.astype(jnp.float32).reshape(B, h, hd)
-    kf = k_cache.astype(jnp.float32)
-    vf = v_cache.astype(jnp.float32)
-    # scores: (B, h, S) — broadcast q heads onto their kv group
-    qg = qf.reshape(B, cfg.n_kv_heads, groups, hd)
-    scores = jnp.einsum("bkgd,bskd->bkgs", qg, kf) * (hd**-0.5)
+    # decode is CACHE-BANDWIDTH bound: read K/V in their stored bf16 and
+    # let the MXU accumulate in f32 (preferred_element_type) — upcasting
+    # the whole cache to f32 doubled the HBM traffic of every step
+    qg = q.reshape(B, cfg.n_kv_heads, groups, hd)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", qg, k_cache, preferred_element_type=jnp.float32
+    ) * (hd**-0.5)
     mask = jnp.arange(S)[None, None, None, :] <= pos
     scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgs,bskd->bkgd", probs, vf)
+    out = jnp.einsum(
+        "bkgs,bskd->bkgd", probs.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
     return out.reshape(B, 1, h * hd).astype(cfg.dtype)
 
 
@@ -66,24 +70,37 @@ def decode_step(params, cache, tokens, cfg: LlamaConfig):
     cos, sin = rope_frequencies(hd, cache["k"].shape[2], cfg.rope_theta)
     positions = jnp.full((B, 1), pos, jnp.int32)
 
-    def body(x, layer_and_cache):
-        layer, k_cache, v_cache = layer_and_cache
+    def body(carry, layer_and_idx):
+        # the FULL stacked cache rides the carry and is updated in place
+        # (one dynamic_update_slice per layer). Scanning per-layer caches
+        # as xs with stacked ys instead makes XLA materialize a second
+        # full-cache copy every step — at B=16/S=1024 that is ~512 MB of
+        # extra writes per decoded token.
+        x, k_full, v_full = carry
+        layer, li = layer_and_idx
         a = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
         q = (a @ layer["wq"]).reshape(B, 1, h, hd)
         k = (a @ layer["wk"]).reshape(B, 1, kvh, hd)
         v = (a @ layer["wv"]).reshape(B, 1, kvh, hd)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        k_full = jax.lax.dynamic_update_slice(k_full, k[None], (li, 0, pos, 0, 0))
+        v_full = jax.lax.dynamic_update_slice(v_full, v[None], (li, 0, pos, 0, 0))
+        k_cache = jax.lax.dynamic_index_in_dim(k_full, li, 0, keepdims=False)
+        v_cache = jax.lax.dynamic_index_in_dim(v_full, li, 0, keepdims=False)
         o = _gqa_attend(q, k_cache, v_cache, pos, cfg) @ layer["wo"]
         x = x + o
         m = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
         gate = jax.nn.silu((m @ layer["w_gate"]).astype(jnp.float32)).astype(cfg.dtype)
         x = x + (gate * (m @ layer["w_up"])) @ layer["w_down"]
-        return x, (k_cache, v_cache)
+        return (x, k_full, v_full), None
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    (x, new_k, new_v), _ = jax.lax.scan(
+        body,
+        (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)),
+        unroll=True,
+    )
     x = rms_norm(x[:, 0, :], params["final_norm"], cfg.rms_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v, "pos": pos + 1}

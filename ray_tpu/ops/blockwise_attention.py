@@ -138,13 +138,18 @@ def _bwd(causal, block_size, sm_scale, q_offset, kv_offset, res, do):
     kb = kfull.reshape(B, nblocks, blk, H, D).transpose(1, 0, 2, 3, 4)
     vb = vfull.reshape(B, nblocks, blk, H, D).transpose(1, 0, 2, 3, 4)
 
-    qf = q.astype(jnp.float32) * scale
-    dof = do.astype(jnp.float32)
-    delta = (dof * o.astype(jnp.float32)).sum(axis=-1)  # [B,T,H]
+    # MATMUL inputs stay in the model dtype (bf16): the MXU multiplies
+    # bf16 at full rate with f32 accumulation (preferred_element_type);
+    # upcasting inputs first forces f32xf32 multiplies at ~1/4 throughput
+    # — measured as the long-context backward running at <15% MFU.
+    # Softmax/correction arithmetic stays in f32.
+    in_dtype = q.dtype
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(axis=-1)  # [B,T,H]
+    pref = dict(preferred_element_type=jnp.float32)
 
     def step(dq, inputs):
         jblk, kj, vj = inputs
-        s = jnp.einsum("bthd,bshd->bths", qf, kj.astype(jnp.float32))
+        s = jnp.einsum("bthd,bshd->bths", q, kj, **pref) * scale
         base = jblk * blk
         if causal:
             q_ids = q_offset + jax.lax.broadcasted_iota(jnp.int32, (T, blk), 0)
@@ -153,12 +158,13 @@ def _bwd(causal, block_size, sm_scale, q_offset, kv_offset, res, do):
         if pad:
             kv_ids2 = base + jax.lax.broadcasted_iota(jnp.int32, (1, blk), 1)
             s = s + jnp.where(kv_ids2 < S, 0.0, NEG_INF)[:, None, :]
-        p = jnp.exp(s - lse[..., None])  # [B,T,H,blk]
-        dv_j = jnp.einsum("bths,bthd->bshd", p, dof)
-        dp = jnp.einsum("bthd,bshd->bths", dof, vj.astype(jnp.float32))
-        ds = p * (dp - delta[..., None])
-        dq = dq + jnp.einsum("bths,bshd->bthd", ds, kj.astype(jnp.float32))
-        dk_j = jnp.einsum("bths,bthd->bshd", ds, qf)
+        p = jnp.exp(s - lse[..., None])  # [B,T,H,blk] f32
+        pl_ = p.astype(in_dtype)
+        dv_j = jnp.einsum("bths,bthd->bshd", pl_, do, **pref)
+        dp = jnp.einsum("bthd,bshd->bths", do, vj, **pref)
+        ds = (p * (dp - delta[..., None])).astype(in_dtype)
+        dq = dq + jnp.einsum("bths,bshd->bthd", ds, kj, **pref)
+        dk_j = jnp.einsum("bths,bthd->bshd", ds, q, **pref)
         return dq, (dk_j, dv_j)
 
     dq0 = jnp.zeros((B, T, H, D), jnp.float32)
@@ -166,8 +172,9 @@ def _bwd(causal, block_size, sm_scale, q_offset, kv_offset, res, do):
     dq = (dq * scale).astype(q.dtype)
     dk = dkb.transpose(1, 0, 2, 3, 4).reshape(B, nblocks * blk, H, D)[:, :S]
     dv = dvb.transpose(1, 0, 2, 3, 4).reshape(B, nblocks * blk, H, D)[:, :S]
-    # dk_j was computed against qf (already scaled), so no extra scale here
-    dk = dk.astype(k.dtype)
+    # dk_j was computed against RAW q (bf16 matmul path), so it needs the
+    # same scale factor dq does
+    dk = (dk * scale).astype(k.dtype)
     dv = dv.astype(v.dtype)
     if kvh != H:
         g = H // kvh

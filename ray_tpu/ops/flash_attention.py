@@ -45,24 +45,30 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *, scale, cau
 
     @pl.when(run)
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
-        k = k_ref[0].astype(jnp.float32)                  # [bk, D]
-        v = v_ref[0].astype(jnp.float32)                  # [bk, D]
+        # keep the MATMUL INPUTS in their native (bf16) dtype: the MXU
+        # multiplies bf16 at full rate with f32 accumulation
+        # (preferred_element_type) — upcasting inputs to f32 first forces
+        # f32xf32 multiplies at ~1/4 throughput, which measured as the
+        # whole kernel running at 5% MFU. Softmax stays in f32.
+        q = q_ref[0]                                       # [bq, D] bf16
+        k = k_ref[0]                                       # [bk, D] bf16
+        v = v_ref[0]                                       # [bk, D] bf16
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )                                                  # [bq, bk]
+        ) * scale                                          # [bq, bk] f32
         if causal:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows, s, NEG_INF)
         m_prev = m_s[:]                                    # [bq, 1]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                             # [bq, bk]
+        p = jnp.exp(s - m_new)                             # [bq, bk] f32
         corr = jnp.exp(m_prev - m_new)                     # [bq, 1]
         l_s[:] = l_s[:] * corr + p.sum(axis=-1, keepdims=True)
         m_s[:] = m_new
         acc[:] = acc[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(j == nk - 1)
@@ -120,6 +126,185 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     return o, lse
 
 
+def _fa_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dl_ref, k_ref, v_ref,
+                        dk_ref, dv_ref, dk_acc, dv_acc,
+                        *, scale, causal, bq, bk, nq):
+    """dK/dV kernel: fixed KV block j (grid dim 1), iterate Q blocks i
+    (innermost). P is recomputed from q/k and the saved logsumexp — no
+    [T,S] materialization, everything VMEM-resident (FlashAttention-2
+    backward structure)."""
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = i * bq + bq - 1 >= j * bk  # q block reaches this kv block
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]                                       # [bq, D] bf16
+        do = do_ref[0]                                     # [bq, D] bf16
+        k = k_ref[0]                                       # [bk, D] bf16
+        v = v_ref[0]                                       # [bk, D] bf16
+        lse = lse_ref[0][:, :1]                            # [bq, 1] f32
+        delta = dl_ref[0][:, :1]                           # [bq, 1] f32
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                          # [bq, bk]
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse)                               # [bq, bk] f32
+        pb = p.astype(v.dtype)
+        # dv += P^T @ dO
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            pb, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                  # [bq, bk]
+        ds = (p * (dp - delta)).astype(q.dtype)
+        # dk += dS^T @ q (scale applied at writeout)
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(q_ref, do_ref, lse_ref, dl_ref, k_ref, v_ref,
+                      dq_ref, dq_acc, *, scale, causal, bq, bk, nk):
+    """dQ kernel: fixed Q block i, iterate KV blocks j (innermost)."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = j * bk <= i * bq + bq - 1
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0]
+        do = do_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = dl_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    kvh = k.shape[2]
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    kf = _broadcast_kv(k, H)
+    vf = _broadcast_kv(v, H)
+    bq = min(block_q, T)
+    bk = min(block_k, S)
+    nq, nk = T // bq, S // bk
+
+    qr = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    dor = do.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kr = kf.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    vr = vf.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    # lse arrives [B, T, H]; delta = rowsum(do * o). Both ride as
+    # (BH, T, 128)-tiled f32 (TPU tiling wants a 128 lane dim; kernels
+    # read lane 0)
+    lse_r = lse.transpose(0, 2, 1).reshape(B * H, T)
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(axis=-1)  # [B,T,H]
+    delta_r = delta.transpose(0, 2, 1).reshape(B * H, T)
+    lse_t = jnp.broadcast_to(lse_r[:, :, None], (B * H, T, 128))
+    delta_t = jnp.broadcast_to(delta_r[:, :, None], (B * H, T, 128))
+
+    dkdv = functools.partial(
+        _fa_bwd_dkdv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq
+    )
+    dk_r, dv_r = pl.pallas_call(
+        dkdv,
+        grid=(B * H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),    # q
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),    # do
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),  # lse
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),  # delta
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),    # k
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),    # v
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+    )(qr, dor, lse_t, delta_t, kr, vr)
+
+    dqk = functools.partial(
+        _fa_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk
+    )
+    dq_r = pl.pallas_call(
+        dqk,
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, D), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+    )(qr, dor, lse_t, delta_t, kr, vr)[0]
+
+    dq = dq_r.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    dk = dk_r.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    dv = dv_r.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    if kvh != H:
+        g = H // kvh
+        dk = dk.reshape(B, S, kvh, g, D).sum(axis=3)
+        dv = dv.reshape(B, S, kvh, g, D).sum(axis=3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _on_tpu() -> bool:
     try:
         return jax.default_backend() == "tpu"
@@ -134,14 +319,14 @@ def flash_attention(
     v,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
 ):
     o, _ = _flash_fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k)
     return o
 
 
-def kernel_supported(seq_q: int, seq_k: int, head_dim: int, block_q: int = 128, block_k: int = 128) -> bool:
+def kernel_supported(seq_q: int, seq_k: int, head_dim: int, block_q: int = 512, block_k: int = 1024) -> bool:
     """True iff these shapes dispatch to the pallas kernel on a TPU backend.
     head_dim 64 (validated on-chip; covers most small models) or a
     128-multiple (MXU-native); seq lengths must divide the block sizes."""
@@ -166,6 +351,10 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    T, S = q.shape[1], k.shape[1]
+    if _on_tpu() and kernel_supported(T, S, q.shape[3], block_q, block_k):
+        return _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k)
     return _blockwise_bwd(causal, max(block_q, block_k), sm_scale, 0, 0, res, do)
 
 

@@ -50,8 +50,52 @@ class AlgorithmConfig:
         self.env_runner_cls = None
         # "complete" → flat GAE batches; "time_major" → (E, T) sequences
         self.batch_mode = "complete"
+        # multi-agent (reference: AlgorithmConfig.multi_agent —
+        # policies: {module_id: None}; policy_mapping_fn: agent_id -> module_id)
+        self.policies: Optional[Dict[str, Any]] = None
+        self.policy_mapping_fn: Optional[Callable] = None
+        # connector pipelines (reference: ConnectorV2 slots); each entry is
+        # a callable/Connector or a list composed into a pipeline
+        self.env_to_module_connector = None
+        self.module_to_env_connector = None
+        self.learner_connector = None
         # misc
         self.seed = 0
+
+    @property
+    def is_multi_agent(self) -> bool:
+        return bool(self.policies)
+
+    def multi_agent(self, policies=None, policy_mapping_fn=None):
+        """reference: AlgorithmConfig.multi_agent(policies=...,
+        policy_mapping_fn=...)."""
+        if policies is not None:
+            self.policies = (
+                {p: None for p in policies} if not isinstance(policies, dict) else policies
+            )
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    def connectors(self, env_to_module=None, module_to_env=None, learner=None):
+        """reference: config.env_to_module_connector(...) etc."""
+        if env_to_module is not None:
+            self.env_to_module_connector = env_to_module
+        if module_to_env is not None:
+            self.module_to_env_connector = module_to_env
+        if learner is not None:
+            self.learner_connector = learner
+        return self
+
+    def build_connector(self, which: str):
+        from ray_tpu.rllib.connectors import ConnectorPipeline
+
+        spec = getattr(self, which + "_connector", None)
+        if spec is None:
+            return None
+        if isinstance(spec, (list, tuple)):
+            return ConnectorPipeline(spec)
+        return ConnectorPipeline([spec])
 
     # -- fluent setters (reference: AlgorithmConfig.environment/env_runners/...)
     def environment(self, env=None, env_config=None):
@@ -135,7 +179,12 @@ class EnvRunnerGroup:
         from ray_tpu.rllib.env.single_agent_env_runner import SingleAgentEnvRunner
 
         # getattr: configs unpickled from older checkpoints predate the attr
-        runner_cls = getattr(config, "env_runner_cls", None) or SingleAgentEnvRunner
+        runner_cls = getattr(config, "env_runner_cls", None)
+        if runner_cls is None and getattr(config, "policies", None):
+            from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
+
+            runner_cls = MultiAgentEnvRunner
+        runner_cls = runner_cls or SingleAgentEnvRunner
         self.config = config
         self.local_runner = None
         self.remote_runners: List[Any] = []
@@ -151,6 +200,21 @@ class EnvRunnerGroup:
             ]
 
     def spaces(self):
+        if getattr(self.config, "policies", None):
+            # multi-agent: {module_id: (obs_space, action_space)} via a
+            # representative agent of each module
+            if self.local_runner is not None:
+                env = self.local_runner.env
+            else:
+                env = self.config.env(self.config.env_config) if self.config.env_config else self.config.env()
+            from ray_tpu.rllib.env.multi_agent_env_runner import agent_for_policy
+
+            mapping = self.config.policy_mapping_fn
+            out = {}
+            for mid in self.config.policies:
+                agent = agent_for_policy(env, mapping, mid)
+                out[mid] = (env.observation_space(agent), env.action_space(agent))
+            return out, None
         if self.local_runner is not None:
             env = self.local_runner.env
             return env.single_observation_space, env.single_action_space
@@ -202,6 +266,9 @@ class Algorithm:
         self.env_runner_group = EnvRunnerGroup(config)
         obs_space, action_space = self.env_runner_group.spaces()
         self.learner_group = LearnerGroup(config, obs_space, action_space)
+        # built ONCE: stateful learner connectors keep their state across
+        # training iterations (the env runner builds its pipelines once too)
+        self.learner_connector = config.build_connector("learner")
         self._iteration = 0
         self._weights_seq = 0
         self._env_steps_lifetime = 0
@@ -234,20 +301,33 @@ class Algorithm:
         }
 
     # -- inference -----------------------------------------------------------
-    def compute_single_action(self, obs, explore: bool = False):
+    def compute_single_action(self, obs, explore: bool = False, policy_id: Optional[str] = None):
         import jax
         import jax.numpy as jnp
 
         # cache module + weights across calls; refresh when training moved on
-        if getattr(self, "_infer_cache_seq", None) != self._weights_seq:
+        if getattr(self, "_infer_cache_seq", None) != (self._weights_seq, policy_id):
             group = self.env_runner_group
-            self._infer_module = (
-                group.local_runner.module
-                if group.local_runner is not None
-                else self.config.build_module(*group.spaces())
-            )
-            self._infer_weights = self.learner_group.get_weights()
-            self._infer_cache_seq = self._weights_seq
+            if self.config.is_multi_agent:
+                if policy_id is None:
+                    raise ValueError(
+                        "multi-agent compute_single_action needs policy_id="
+                        f"one of {sorted(self.config.policies)}"
+                    )
+                if group.local_runner is not None:
+                    self._infer_module = group.local_runner.modules[policy_id]
+                else:
+                    spaces, _ = group.spaces()
+                    self._infer_module = self.config.build_module(*spaces[policy_id])
+                self._infer_weights = self.learner_group.get_weights()[policy_id]
+            else:
+                self._infer_module = (
+                    group.local_runner.module
+                    if group.local_runner is not None
+                    else self.config.build_module(*group.spaces())
+                )
+                self._infer_weights = self.learner_group.get_weights()
+            self._infer_cache_seq = (self._weights_seq, policy_id)
         module, weights = self._infer_module, self._infer_weights
         out = module.forward(weights, jnp.asarray(obs, dtype=jnp.float32)[None])
         if explore:

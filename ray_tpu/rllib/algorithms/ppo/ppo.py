@@ -43,8 +43,21 @@ class PPO(Algorithm):
             samples.extend(round_samples)
             collected += sum(s["metrics"]["num_env_steps"] for s in round_samples)
 
-        keys = samples[0]["batch"].keys()
-        batch = {k: np.concatenate([s["batch"][k] for s in samples], axis=0) for k in keys}
+        learner_conn = self.learner_connector
+        if self.config.is_multi_agent:
+            # per-MODULE concat across samples (reference: MultiAgentBatch)
+            mids = sorted({m for s in samples for m in s["batch"]})
+            batch = {}
+            for mid in mids:
+                parts = [s["batch"][mid] for s in samples if mid in s["batch"]]
+                keys = parts[0].keys()
+                b = {k: np.concatenate([p[k] for p in parts], axis=0) for k in keys}
+                batch[mid] = learner_conn(b) if learner_conn else b
+        else:
+            keys = samples[0]["batch"].keys()
+            batch = {k: np.concatenate([s["batch"][k] for s in samples], axis=0) for k in keys}
+            if learner_conn:
+                batch = learner_conn(batch)
 
         # 3. learn
         learner_stats = self.learner_group.update(batch)

@@ -1,0 +1,25 @@
+"""ConnectorV2-style data pipelines.
+
+Equivalent of the reference's connector framework (reference:
+rllib/connectors/connector_v2.py + env_to_module/, module_to_env/,
+learner/ — composable transforms between the three data boundaries:
+raw env output → module input, module output → env actions, and
+collected episodes → learner batches). Same three pipeline slots here;
+connectors are plain callables over dict batches, jax/numpy agnostic.
+"""
+from ray_tpu.rllib.connectors.connector import (  # noqa: F401
+    Connector,
+    ConnectorPipeline,
+)
+from ray_tpu.rllib.connectors.env_to_module import (  # noqa: F401
+    FlattenObservations,
+    NormalizeObservations,
+    OneHotDiscreteObservations,
+)
+from ray_tpu.rllib.connectors.learner import (  # noqa: F401
+    StandardizeAdvantages,
+)
+from ray_tpu.rllib.connectors.module_to_env import (  # noqa: F401
+    ClipActions,
+    UnsquashActions,
+)

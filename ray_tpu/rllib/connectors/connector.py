@@ -1,0 +1,61 @@
+"""Connector base + pipeline (reference: rllib/connectors/connector_v2.py
+ConnectorV2 — a transform with (input, context) → output composed into
+ConnectorPipelineV2; here context travels as keyword args so connectors
+stay pure callables)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+
+class Connector:
+    """One transform in a pipeline. Subclasses override __call__.
+
+    data is a dict batch ({"obs": ..., ...} single-agent, or
+    {module_id: {...}} multi-agent at the learner boundary); ctx carries
+    spaces/config when a connector needs them."""
+
+    def __call__(self, data: Any, **ctx) -> Any:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class _FnConnector(Connector):
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def __call__(self, data: Any, **ctx) -> Any:
+        return self._fn(data, **ctx)
+
+    def __repr__(self):
+        return getattr(self._fn, "__name__", "fn")
+
+
+class ConnectorPipeline(Connector):
+    """Ordered connector composition (reference: ConnectorPipelineV2;
+    append/prepend match its mutation API so algorithms can inject
+    defaults around user connectors)."""
+
+    def __init__(self, connectors: Optional[Sequence[Union[Connector, Callable]]] = None):
+        self.connectors: List[Connector] = [self._wrap(c) for c in (connectors or [])]
+
+    @staticmethod
+    def _wrap(c) -> Connector:
+        return c if isinstance(c, Connector) else _FnConnector(c)
+
+    def append(self, connector) -> "ConnectorPipeline":
+        self.connectors.append(self._wrap(connector))
+        return self
+
+    def prepend(self, connector) -> "ConnectorPipeline":
+        self.connectors.insert(0, self._wrap(connector))
+        return self
+
+    def __call__(self, data: Any, **ctx) -> Any:
+        for c in self.connectors:
+            data = c(data, **ctx)
+        return data
+
+    def __repr__(self):
+        return f"ConnectorPipeline({', '.join(map(repr, self.connectors))})"

@@ -1,0 +1,65 @@
+"""env→module connectors (reference: rllib/connectors/env_to_module/ —
+observation preprocessing applied on the env runner before the module
+forward)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.connectors.connector import Connector
+
+
+class FlattenObservations(Connector):
+    """Flatten any trailing obs dims to one vector per row (reference:
+    env_to_module/flatten_observations.py)."""
+
+    def __call__(self, obs, **ctx):
+        obs = np.asarray(obs)
+        return obs.reshape(obs.shape[0], -1)
+
+
+class NormalizeObservations(Connector):
+    """Running mean/std normalization (reference:
+    env_to_module/mean_std_filter.py — per-runner running filter; stats
+    ride get_state so restores keep the filter)."""
+
+    def __init__(self, eps: float = 1e-8, clip: float = 10.0):
+        self.eps = eps
+        self.clip = clip
+        self._count = 0
+        self._mean = None
+        self._m2 = None
+
+    def __call__(self, obs, **ctx):
+        obs = np.asarray(obs, np.float32)
+        flat = obs.reshape(obs.shape[0], -1)
+        if self._mean is None:
+            self._mean = np.zeros(flat.shape[1], np.float64)
+            self._m2 = np.zeros(flat.shape[1], np.float64)
+        for row in flat:  # Welford; batches are small on env runners
+            self._count += 1
+            d = row - self._mean
+            self._mean += d / self._count
+            self._m2 += d * (row - self._mean)
+        std = np.sqrt(self._m2 / max(1, self._count - 1) + self.eps)
+        out = (flat - self._mean) / std
+        return np.clip(out, -self.clip, self.clip).astype(np.float32).reshape(obs.shape)
+
+    def get_state(self):
+        return {"count": self._count, "mean": self._mean, "m2": self._m2}
+
+    def set_state(self, st):
+        self._count = st["count"]
+        self._mean = st["mean"]
+        self._m2 = st["m2"]
+
+
+class OneHotDiscreteObservations(Connector):
+    """Discrete obs → one-hot vectors (reference:
+    env_to_module/one_hot_observations.py). Needs obs_space in ctx."""
+
+    def __call__(self, obs, *, obs_space=None, **ctx):
+        n = obs_space.n
+        obs = np.asarray(obs, np.int64).reshape(-1)
+        out = np.zeros((obs.shape[0], n), np.float32)
+        out[np.arange(obs.shape[0]), obs] = 1.0
+        return out

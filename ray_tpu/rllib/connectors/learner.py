@@ -1,0 +1,19 @@
+"""learner connectors (reference: rllib/connectors/learner/ — batch
+transforms applied on the learner before the update)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.connectors.connector import Connector
+
+
+class StandardizeAdvantages(Connector):
+    """Zero-mean/unit-std advantages per train batch (reference:
+    learner/general_advantage_estimation.py standardization step)."""
+
+    def __call__(self, batch, **ctx):
+        if "advantages" in batch:
+            adv = np.asarray(batch["advantages"], np.float32)
+            batch = dict(batch)
+            batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        return batch

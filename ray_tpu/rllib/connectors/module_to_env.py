@@ -1,0 +1,28 @@
+"""module→env connectors (reference: rllib/connectors/module_to_env/ —
+action postprocessing applied before env.step)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.connectors.connector import Connector
+
+
+class ClipActions(Connector):
+    """Clip continuous actions to the action-space bounds (reference:
+    module_to_env/ action clipping path)."""
+
+    def __call__(self, actions, *, action_space=None, **ctx):
+        if action_space is None or not hasattr(action_space, "low"):
+            return actions
+        return np.clip(actions, action_space.low, action_space.high)
+
+
+class UnsquashActions(Connector):
+    """Map tanh-squashed [-1, 1] module outputs onto the action-space
+    range (reference: unsquash_actions path in module_to_env)."""
+
+    def __call__(self, actions, *, action_space=None, **ctx):
+        if action_space is None or not hasattr(action_space, "low"):
+            return actions
+        low, high = action_space.low, action_space.high
+        return low + (np.asarray(actions) + 1.0) * 0.5 * (high - low)

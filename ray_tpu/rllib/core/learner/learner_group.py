@@ -61,6 +61,56 @@ class _LearnerActor:
         return True
 
 
+class MultiAgentLearner:
+    """Per-module learners updated from per-module batches (reference:
+    the Learner's native MultiRLModule support — one loss/optimizer per
+    module id, reference core/learner/learner.py multi-module paths).
+    Local-process only; each module's params are independent trees."""
+
+    def __init__(self, config, spaces: Dict[str, tuple]):
+        self.learners = {
+            mid: config.learner_class(config, o, a, mesh=None)
+            for mid, (o, a) in spaces.items()
+        }
+
+    def update(self, batches: Dict[str, Dict[str, np.ndarray]]) -> Dict[str, float]:
+        per_module: Dict[str, Any] = {}
+        for mid, b in batches.items():
+            if mid in self.learners and b:
+                per_module[mid] = self.learners[mid].update(b)
+        # namespaced: per-module stats under "modules", cross-module means
+        # flat (a module id can then never collide with a stat key)
+        out: Dict[str, Any] = {"modules": per_module}
+        flat_keys = {k for s in per_module.values() for k in s}
+        for k in flat_keys:
+            vals = [s[k] for s in per_module.values() if k in s]
+            if vals:
+                out[k] = float(np.mean(vals))
+        return out
+
+    def get_weights(self):
+        return {mid: l.get_weights() for mid, l in self.learners.items()}
+
+    def set_weights(self, weights):
+        for mid, w in weights.items():
+            if mid in self.learners:
+                self.learners[mid].set_weights(w)
+
+    def update_once(self, batches):
+        raise NotImplementedError(
+            "multi-agent training currently supports the on-policy update() "
+            "path only (off-policy update_once per-module is not implemented)"
+        )
+
+    def get_state(self):
+        return {mid: l.get_state() for mid, l in self.learners.items()}
+
+    def set_state(self, state):
+        for mid, st in state.items():
+            if mid in self.learners:
+                self.learners[mid].set_state(st)
+
+
 class LearnerGroup:
     def __init__(self, config, obs_space=None, action_space=None):
         self.config = config
@@ -68,7 +118,16 @@ class LearnerGroup:
         self._local = None
         self._workers: List[Any] = []
         learner_cls = config.learner_class
-        if self.num_learners == 0:
+        if getattr(config, "policies", None):
+            if self.num_learners > 0:
+                raise ValueError(
+                    "multi-agent training uses the local learner "
+                    "(num_learners=0); distributed multi-agent learners "
+                    "are not implemented yet"
+                )
+            # obs_space/action_space arrive as {module_id: (obs, act)}
+            self._local = MultiAgentLearner(config, obs_space)
+        elif self.num_learners == 0:
             mesh = config.build_learner_mesh()
             self._local = learner_cls(config, obs_space, action_space, mesh=mesh)
         else:

@@ -1,0 +1,103 @@
+"""MultiAgentEnv — the dict-keyed multi-agent environment API.
+
+Equivalent of the reference's MultiAgentEnv (reference:
+rllib/env/multi_agent_env.py — reset() returns per-agent obs dicts,
+step() takes an action dict for the agents that acted and returns
+per-agent obs/reward/terminated/truncated dicts with the special
+"__all__" key signalling episode end; agents may come and go between
+steps)."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Set, Tuple
+
+import numpy as np
+
+
+class MultiAgentEnv:
+    """Subclass contract:
+
+    - ``possible_agents``: list of all agent ids.
+    - ``observation_spaces`` / ``action_spaces``: dicts keyed by agent id
+      (or implement ``observation_space(agent)`` / ``action_space(agent)``).
+    - ``reset(seed=None)`` -> (obs_dict, info_dict)
+    - ``step(action_dict)`` -> (obs, rewards, terminateds, truncateds,
+      infos), each a per-agent dict; terminateds/truncateds carry
+      "__all__".
+    """
+
+    possible_agents: list = []
+    observation_spaces: Dict[str, Any] = {}
+    action_spaces: Dict[str, Any] = {}
+
+    def observation_space(self, agent_id):
+        return self.observation_spaces[agent_id]
+
+    def action_space(self, agent_id):
+        return self.action_spaces[agent_id]
+
+    def reset(self, *, seed: Optional[int] = None) -> Tuple[Dict, Dict]:
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+
+class TwoAgentTarget(MultiAgentEnv):
+    """Tiny learnable 2-agent env (test fixture, original): each agent
+    walks a 1-D line toward its own target; the REWARD IS SHARED (sum of
+    both agents' progress), so credit assignment crosses agents — the
+    minimal shape that exercises per-agent batches + policy mapping."""
+
+    N = 9  # line length; agents start centered, targets at the ends
+
+    def __init__(self, horizon: int = 32):
+        import gymnasium as gym
+
+        self.possible_agents = ["a0", "a1"]
+        obs_sp = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+        act_sp = gym.spaces.Discrete(2)  # left / right
+        self.observation_spaces = {a: obs_sp for a in self.possible_agents}
+        self.action_spaces = {a: act_sp for a in self.possible_agents}
+        self.horizon = horizon
+        self._rng = np.random.default_rng(0)
+
+    def _obs(self):
+        # per-agent: (own position, own target), scaled to [-1, 1]
+        return {
+            a: np.array(
+                [self._pos[a] / (self.N - 1) * 2 - 1, self._target[a] / (self.N - 1) * 2 - 1],
+                np.float32,
+            )
+            for a in self.possible_agents
+        }
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        mid = self.N // 2
+        self._pos = {"a0": mid, "a1": mid}
+        self._target = {
+            "a0": int(self._rng.integers(0, 2)) * (self.N - 1),
+            "a1": int(self._rng.integers(0, 2)) * (self.N - 1),
+        }
+        self._t = 0
+        return self._obs(), {a: {} for a in self.possible_agents}
+
+    def step(self, action_dict):
+        self._t += 1
+        shared = 0.0
+        for a, act in action_dict.items():
+            before = abs(self._pos[a] - self._target[a])
+            self._pos[a] = int(np.clip(self._pos[a] + (1 if act == 1 else -1), 0, self.N - 1))
+            after = abs(self._pos[a] - self._target[a])
+            shared += float(before - after)  # +1 toward the target, -1 away
+        done = self._t >= self.horizon or all(
+            self._pos[a] == self._target[a] for a in self.possible_agents
+        )
+        obs = self._obs()
+        rewards = {a: shared for a in self.possible_agents}
+        terms = {a: done for a in self.possible_agents}
+        terms["__all__"] = done
+        truncs = {a: False for a in self.possible_agents}
+        truncs["__all__"] = False
+        return obs, rewards, terms, truncs, {a: {} for a in self.possible_agents}

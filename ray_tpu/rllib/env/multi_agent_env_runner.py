@@ -1,0 +1,213 @@
+"""MultiAgentEnvRunner — samples a MultiAgentEnv into per-MODULE batches.
+
+Equivalent of the reference's MultiAgentEnvRunner + MultiAgentEpisode
+(reference: rllib/env/multi_agent_env_runner.py,
+rllib/env/multi_agent_episode.py): agents are routed to RLModules by the
+config's policy_mapping_fn; each module forwards ONCE per step over the
+stacked observations of the agents it controls; per-agent trajectories
+get their own GAE and land in their module's batch. Runs complete
+episodes (the reference's complete_episodes batch mode) so bootstraps
+only matter at truncation."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env.env_runner import EnvRunner
+from ray_tpu.rllib.utils.postprocessing import compute_gae
+
+
+def agent_for_policy(env, mapping, module_id: str) -> str:
+    """Representative agent for a policy id, with a CLEAR error when the
+    mapping covers no agent (a bare next() would raise StopIteration)."""
+    for a in env.possible_agents:
+        if mapping(a) == module_id:
+            return a
+    raise ValueError(
+        f"no agent in {env.possible_agents} maps to policy {module_id!r} "
+        "under the configured policy_mapping_fn"
+    )
+
+
+class MultiAgentEnvRunner(EnvRunner):
+    def __init__(self, config, worker_index: int = 0):
+        import jax
+
+        self.config = config
+        self.worker_index = worker_index
+        self._jax = jax
+        env_maker = config.env if callable(config.env) else None
+        if env_maker is None:
+            raise ValueError("multi-agent config.env must be a callable returning a MultiAgentEnv")
+        self.env = env_maker(config.env_config) if config.env_config else env_maker()
+        self.mapping: Callable[[str], str] = config.policy_mapping_fn
+        # one RLModule per policy id, built against a representative
+        # agent's spaces
+        self.modules: Dict[str, Any] = {}
+        self.params: Dict[str, Any] = {}
+        rng = jax.random.PRNGKey(config.seed + 1000 * (worker_index + 1))
+        for mid in config.policies:
+            agent = agent_for_policy(self.env, self.mapping, mid)
+            rng, key = jax.random.split(rng)
+            self.modules[mid] = config.build_module(
+                self.env.observation_space(agent), self.env.action_space(agent)
+            )
+            self.params[mid] = self.modules[mid].init_params(key)
+        self._rng = rng
+        self._weights_seq = 0
+
+        import jax.numpy as jnp
+
+        def make_forward(module):
+            def _f(params, obs, rng):
+                out = module.forward(params, obs)
+                logits = out["logits"]
+                action = jax.random.categorical(rng, logits)
+                logp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits), action[:, None], axis=1
+                )[:, 0]
+                return action, logp, out["vf"]
+
+            return jax.jit(_f)
+
+        self._forwards = {mid: make_forward(m) for mid, m in self.modules.items()}
+        self._value_fns = {
+            mid: jax.jit(lambda p, o, m=m: m.forward(p, o)["vf"])
+            for mid, m in self.modules.items()
+        }
+        self._episode_count = 0
+        # per-worker deterministic env seeding (same scheme as the
+        # single-agent runner): episode i of worker w reseeds from the
+        # stream base so runs reproduce under .debugging(seed=...)
+        self._seed_base = config.seed + 10_000 * (worker_index + 1)
+
+    # -- weights --------------------------------------------------------
+    def set_weights(self, weights: Dict[str, Any], seq: int = 0):
+        for mid, w in weights.items():
+            if mid in self.params:
+                self.params[mid] = w
+        self._weights_seq = seq
+        return True
+
+    def get_weights(self):
+        return self.params
+
+    # -- sampling -------------------------------------------------------
+    def sample(self) -> Dict[str, Any]:
+        budget = self.config.rollout_fragment_length * max(1, self.config.num_envs_per_env_runner)
+        steps = 0
+        # per (agent): trajectory columns
+        module_rows: Dict[str, Dict[str, List]] = {
+            mid: {k: [] for k in ("obs", "actions", "logp_old", "values",
+                                  "advantages", "value_targets")}
+            for mid in self.modules
+        }
+        episode_returns: List[float] = []
+        episode_lens: List[int] = []
+
+        while steps < budget:
+            ep_steps, ep_return = self._run_episode(module_rows)
+            steps += ep_steps
+            episode_returns.append(ep_return)
+            episode_lens.append(ep_steps)
+            self._episode_count += 1
+
+        batches = {}
+        for mid, cols in module_rows.items():
+            if cols["obs"]:
+                batches[mid] = {
+                    "obs": np.concatenate(cols["obs"], axis=0).astype(np.float32),
+                    "actions": np.concatenate(cols["actions"], axis=0),
+                    "logp_old": np.concatenate(cols["logp_old"], axis=0).astype(np.float32),
+                    "values": np.concatenate(cols["values"], axis=0).astype(np.float32),
+                    "advantages": np.concatenate(cols["advantages"], axis=0).astype(np.float32),
+                    "value_targets": np.concatenate(cols["value_targets"], axis=0).astype(np.float32),
+                }
+        metrics = {
+            "num_env_steps": steps,
+            "episodes_this_iter": len(episode_returns),
+            "episode_returns": episode_returns,
+            "episode_lens": episode_lens,
+            "weights_seq": self._weights_seq,
+        }
+        return {"batch": batches, "metrics": metrics}
+
+    def _run_episode(self, module_rows):
+        env = self.env
+        obs, _ = env.reset(seed=self._seed_base + self._episode_count)
+        agents = list(env.possible_agents)
+        traj = {a: {k: [] for k in ("obs", "act", "logp", "vf", "rew")} for a in agents}
+        ep_return = 0.0
+        t = 0
+        done = False
+        while not done:
+            # group CURRENT agents by module, forward each module once
+            by_module: Dict[str, List[str]] = {}
+            for a in obs:
+                by_module.setdefault(self.mapping(a), []).append(a)
+            actions: Dict[str, Any] = {}
+            step_info = {}
+            for mid, members in by_module.items():
+                stacked = np.stack([np.asarray(obs[a], np.float32) for a in members])
+                self._rng, key = self._jax.random.split(self._rng)
+                act, logp, vf = self._forwards[mid](self.params[mid], stacked, key)
+                act, logp, vf = np.asarray(act), np.asarray(logp), np.asarray(vf)
+                for i, a in enumerate(members):
+                    actions[a] = act[i].item() if act[i].shape == () else act[i]
+                    step_info[a] = (logp[i], vf[i])
+            next_obs, rewards, terms, truncs, _ = env.step(actions)
+            for a in actions:
+                traj[a]["obs"].append(np.asarray(obs[a], np.float32))
+                traj[a]["act"].append(actions[a])
+                traj[a]["logp"].append(step_info[a][0])
+                traj[a]["vf"].append(step_info[a][1])
+                traj[a]["rew"].append(float(rewards.get(a, 0.0)))
+            ep_return += float(sum(rewards.values()))
+            t += 1
+            done = terms.get("__all__", False) or truncs.get("__all__", False)
+            terminated_all = terms.get("__all__", False)
+            obs = next_obs
+
+        # per-agent GAE over the whole episode (terminated → no bootstrap;
+        # truncated → bootstrap with V(the agent's final obs) under its
+        # module). NOTE the contiguity assumption: an agent's recorded
+        # steps are treated as consecutive decisions of ITS trajectory —
+        # which holds for agents that act every step they are present;
+        # sparse actors would need per-transition next-obs bookkeeping.
+        for a, tr in traj.items():
+            if not tr["obs"]:
+                continue
+            mid = self.mapping(a)
+            T = len(tr["obs"])
+            rew = np.asarray(tr["rew"], np.float32)[None, :]
+            vals = np.asarray(tr["vf"], np.float32)[None, :]
+            terms_row = np.zeros((1, T), bool)
+            terms_row[0, -1] = terminated_all
+            dones_row = np.zeros((1, T), bool)
+            dones_row[0, -1] = True
+            next_vals = np.zeros((1, T), np.float32)
+            next_vals[0, :-1] = vals[0, 1:]
+            final_obs = obs.get(a)  # absent if the agent left before the end
+            if not terminated_all and final_obs is not None:
+                final_v = self._value_fns[mid](
+                    self.params[mid], np.asarray(final_obs, np.float32)[None]
+                )
+                next_vals[0, -1] = float(np.asarray(final_v)[0])
+            adv, vt = compute_gae(
+                rew, vals, next_vals, terms_row, dones_row,
+                gamma=self.config.gamma, lambda_=self.config.lambda_,
+            )
+            rows = module_rows[mid]
+            rows["obs"].append(np.stack(tr["obs"]))
+            rows["actions"].append(np.asarray(tr["act"]))
+            rows["logp_old"].append(np.asarray(tr["logp"], np.float32))
+            rows["values"].append(vals[0])
+            rows["advantages"].append(adv[0])
+            rows["value_targets"].append(vt[0])
+        return t, ep_return
+
+    def stop(self) -> None:
+        close = getattr(self.env, "close", None)
+        if close:
+            close()

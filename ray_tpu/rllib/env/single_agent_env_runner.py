@@ -33,7 +33,29 @@ class SingleAgentEnvRunner(EnvRunner):
         self._jax = jax
         self.env = self._make_env(config)
         self.num_envs = config.num_envs_per_env_runner
-        self.module = config.build_module(self.env.single_observation_space, self.env.single_action_space)
+        # connector pipelines come FIRST: a shape-changing env→module
+        # connector (e.g. one-hot) means the module must be built against
+        # the TRANSFORMED observation space
+        build_conn = getattr(config, "build_connector", None)
+        self._env_conn = build_conn("env_to_module") if build_conn else None
+        self._act_conn = build_conn("module_to_env") if build_conn else None
+        module_obs_space = self.env.single_observation_space
+        if self._env_conn is not None:
+            import gymnasium as gym
+
+            # shape probe only: snapshot/restore stateful connector state
+            # (a running normalizer must never count this synthetic frame)
+            saved = [
+                (c, c.get_state()) for c in self._env_conn.connectors
+                if hasattr(c, "get_state")
+            ]
+            probe = self._transform_obs(
+                np.zeros((1,) + self.env.single_observation_space.shape, np.float32)
+            )
+            for c, st in saved:
+                c.set_state(st)
+            module_obs_space = gym.spaces.Box(-np.inf, np.inf, probe.shape[1:], np.float32)
+        self.module = config.build_module(module_obs_space, self.env.single_action_space)
         self._rng = jax.random.PRNGKey(config.seed + 1000 * (worker_index + 1))
         self.params = self.module.init_params(self._rng)
         self._weights_seq = 0
@@ -53,9 +75,22 @@ class SingleAgentEnvRunner(EnvRunner):
 
         seed = config.seed + 10_000 * (worker_index + 1)
         self._obs, _ = self.env.reset(seed=seed)
+        # module-view observations: what the module consumes AND what the
+        # train batch stores (transform may change the obs shape, e.g.
+        # one-hot). Transform each obs exactly ONCE (stateful connectors
+        # like running normalizers must not see the same frame twice).
+        self._mod_obs = self._transform_obs(self._obs)
         self._prev_done = np.zeros((self.num_envs,), dtype=bool)
         # Running per-env episode accounting (survives fragment edges).
         self._init_episode_accounting(self.num_envs)
+
+    def _transform_obs(self, obs):
+        obs = np.asarray(obs, np.float32)
+        if self._env_conn is None:
+            return obs
+        return np.asarray(
+            self._env_conn(obs, obs_space=self.env.single_observation_space), np.float32
+        )
 
     @staticmethod
     def _make_env(config):
@@ -76,7 +111,7 @@ class SingleAgentEnvRunner(EnvRunner):
     def sample(self) -> Dict[str, Any]:
         T = self.config.rollout_fragment_length
         E = self.num_envs
-        obs_shape = self.env.single_observation_space.shape
+        obs_shape = self._mod_obs.shape[1:]
         obs_buf = np.empty((E, T) + obs_shape, dtype=np.float32)
         act_buf = np.empty((E, T), dtype=np.int64)
         logp_buf = np.empty((E, T), dtype=np.float32)
@@ -88,29 +123,36 @@ class SingleAgentEnvRunner(EnvRunner):
         next_obs_buf = np.empty((E, T) + obs_shape, dtype=np.float32)
 
         obs = self._obs
+        mod_obs = self._mod_obs
         prev_done = self._prev_done
         for t in range(T):
             self._rng, key = self._jax.random.split(self._rng)
-            action, logp, vf = self._forward(self.params, obs.astype(np.float32), key)
+            action, logp, vf = self._forward(self.params, mod_obs, key)
             action = np.asarray(action)
-            obs_buf[:, t] = obs
+            env_action = action
+            if self._act_conn is not None:
+                env_action = self._act_conn(action, action_space=self.env.single_action_space)
+            obs_buf[:, t] = mod_obs
             act_buf[:, t] = action
             logp_buf[:, t] = np.asarray(logp)
             vf_buf[:, t] = np.asarray(vf)
             valid_buf[:, t] = ~prev_done
 
-            next_obs, reward, terminated, truncated, _ = self.env.step(action)
+            next_obs, reward, terminated, truncated, _ = self.env.step(env_action)
             done = terminated | truncated
+            mod_next = self._transform_obs(next_obs)
             rew_buf[:, t] = reward
             term_buf[:, t] = terminated
             done_buf[:, t] = done
-            next_obs_buf[:, t] = next_obs
+            next_obs_buf[:, t] = mod_next
 
             self._account_step(reward, done, prev_done)
 
             obs = next_obs
+            mod_obs = mod_next
             prev_done = done
         self._obs = obs
+        self._mod_obs = mod_obs
         self._prev_done = prev_done
 
         if getattr(self.config, "batch_mode", "complete") == "time_major":

@@ -1,0 +1,94 @@
+"""Multi-agent RLlib + connectors.
+
+Reference test shape: rllib/env/tests/test_multi_agent_env.py and
+per-algorithm multi-agent learning tests (behavioral parity, original
+tests and env)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    ray_tpu.init(num_cpus=4, object_store_memory=96 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_multi_agent_env_api():
+    from ray_tpu.rllib.env.multi_agent_env import TwoAgentTarget
+
+    env = TwoAgentTarget()
+    obs, info = env.reset(seed=0)
+    assert set(obs) == {"a0", "a1"}
+    obs, rew, term, trunc, info = env.step({"a0": 1, "a1": 0})
+    assert set(rew) == {"a0", "a1"}
+    assert "__all__" in term and "__all__" in trunc
+
+
+def test_multi_agent_ppo_learns(ray_start_regular):
+    """2 policies, one per agent, shared reward: PPO must learn to walk
+    both agents to their targets (optimal shared return ≈ 8; random ≈ 0)."""
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    from ray_tpu.rllib.env.multi_agent_env import TwoAgentTarget
+
+    config = (
+        PPOConfig()
+        .environment(lambda cfg=None: TwoAgentTarget())
+        .multi_agent(
+            policies=["p0", "p1"],
+            policy_mapping_fn=lambda agent_id: {"a0": "p0", "a1": "p1"}[agent_id],
+        )
+        .env_runners(num_env_runners=0, rollout_fragment_length=256)
+        .training(train_batch_size=512, minibatch_size=128, num_epochs=4, lr=3e-3)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    best = -1e9
+    for i in range(12):
+        result = algo.train()
+        best = max(best, result["episode_return_mean"])
+    assert best > 5.0, f"multi-agent PPO failed to learn: best={best}"
+    # both policies produced distinct learned params
+    w = algo.learner_group.get_weights()
+    assert set(w) == {"p0", "p1"}
+
+
+def test_connector_pipeline_composition():
+    from ray_tpu.rllib.connectors import (
+        ConnectorPipeline,
+        FlattenObservations,
+        StandardizeAdvantages,
+    )
+
+    pipe = ConnectorPipeline([FlattenObservations()])
+    pipe.append(lambda x, **ctx: x * 2.0)
+    out = pipe(np.ones((4, 2, 3), np.float32))
+    assert out.shape == (4, 6) and float(out[0, 0]) == 2.0
+
+    std = StandardizeAdvantages()
+    b = std({"advantages": np.array([1.0, 2.0, 3.0], np.float32)})
+    assert abs(float(b["advantages"].mean())) < 1e-6
+
+
+def test_ppo_with_connectors_learns(ray_start_regular):
+    """Single-agent PPO on CartPole with a normalize connector in the
+    env→module slot and advantage standardization in the learner slot."""
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    from ray_tpu.rllib.connectors import NormalizeObservations, StandardizeAdvantages
+
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .connectors(env_to_module=NormalizeObservations(), learner=StandardizeAdvantages())
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8, rollout_fragment_length=64)
+        .training(train_batch_size=2048, minibatch_size=256, num_epochs=6, lr=1e-3)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    best = 0.0
+    for _ in range(10):
+        result = algo.train()
+        best = max(best, result["episode_return_mean"])
+    assert best > 100.0, f"PPO+connectors failed to learn: best={best}"
