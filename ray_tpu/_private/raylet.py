@@ -66,7 +66,7 @@ def _gc_stale_arenas():
 
 
 class WorkerHandle:
-    def __init__(self, worker_id: str, proc: subprocess.Popen):
+    def __init__(self, worker_id: str, proc: subprocess.Popen, log_path: Optional[str] = None):
         self.worker_id = worker_id
         self.proc = proc
         self.conn: Optional[protocol.Connection] = None
@@ -76,6 +76,8 @@ class WorkerHandle:
         self.actor_id: Optional[str] = None
         self.lease_id: Optional[str] = None  # leased to an owner for direct dispatch
         self.registered = asyncio.Event()
+        self.log_path = log_path
+        self.log_offset = 0  # bytes already streamed to the driver
         self.idle_since = time.time()
         self.oom_killed = False  # set by the memory monitor before SIGKILL
 
@@ -147,6 +149,8 @@ class Raylet:
         asyncio.get_running_loop().create_task(self._heartbeat_loop())
         asyncio.get_running_loop().create_task(self._reap_loop())
         asyncio.get_running_loop().create_task(self._spill_loop())
+        if RayConfig.log_to_driver:
+            asyncio.get_running_loop().create_task(self._log_stream_loop())
         if RayConfig.memory_monitor_refresh_ms > 0:
             asyncio.get_running_loop().create_task(self._memory_monitor_loop())
         self._sync_event = asyncio.Event()
@@ -154,6 +158,65 @@ class Raylet:
         for _ in range(min(RayConfig.worker_pool_prestart, self.max_workers)):
             self._start_worker()
         logger.info("raylet %s node=%s up, %d prestarted", self.name, self.node_id, RayConfig.worker_pool_prestart)
+
+    async def _log_stream_loop(self):
+        """Tail every worker's log file and publish appended lines to the
+        GCS 'worker_logs' pubsub channel so drivers can print them
+        (reference: python/ray/_private/log_monitor.py — a per-node
+        process tailing worker logs into GCS pubsub; here the raylet IS
+        the per-node process, so the loop lives here)."""
+        while True:
+            await asyncio.sleep(0.5)
+            try:
+                batch = []
+                for h in list(self.workers.values()):
+                    entry = self._drain_worker_log(h)
+                    if entry:
+                        batch.append(entry)
+                if batch and self._gcs is not None:
+                    await self._gcs.push(
+                        "pub.publish", {"channel": "worker_logs", "data": {"entries": batch}}
+                    )
+            except Exception:
+                logger.exception("log stream iteration failed")
+
+    def _drain_worker_log(self, h, final: bool = False):
+        """Read NEW complete lines from one worker's log; returns a pubsub
+        entry or None. Only whole lines are consumed (a partial trailing
+        line would split a user print across publishes and defeat the
+        framework-chatter filter); `final` drains everything including a
+        trailing unterminated line (worker death)."""
+        if not h.log_path:
+            return None
+        try:
+            size = os.path.getsize(h.log_path)
+        except OSError:
+            return None
+        if size <= h.log_offset:
+            return None
+        try:
+            with open(h.log_path, "rb") as f:
+                f.seek(h.log_offset)
+                chunk = f.read(min(size - h.log_offset, 256 * 1024))
+        except OSError:
+            return None
+        if not final:
+            cut = chunk.rfind(b"\n")
+            if cut < 0:
+                return None  # no complete line yet
+            chunk = chunk[: cut + 1]
+        h.log_offset += len(chunk)
+        text = chunk.decode("utf-8", "replace")
+        # framework chatter (INFO/DEBUG from ray_tpu loggers) stays in
+        # the file; user prints + warnings/tracebacks stream
+        lines = [
+            ln for ln in text.split("\n")
+            if ln.strip() and not ln.startswith(("INFO:ray_tpu", "DEBUG:ray_tpu"))
+        ]
+        if not lines:
+            return None
+        job = (h.current_task or {}).get("job_id") or getattr(h, "job_id", None)
+        return {"worker": h.worker_id[:12], "job": job, "text": "\n".join(lines)}
 
     # ------------------------------------------------------------- spilling
     @property
@@ -420,7 +483,7 @@ class Raylet:
             start_new_session=True,
             preexec_fn=_worker_dies_with_raylet,
         )
-        h = WorkerHandle(worker_id, proc)
+        h = WorkerHandle(worker_id, proc, log_path=log_path)
         self.workers[worker_id] = h
         self.starting += 1
         self._mark_sync()
@@ -435,6 +498,19 @@ class Raylet:
                 if code is None:
                     continue
                 self.workers.pop(worker_id, None)
+                # final log drain BEFORE the handle disappears: the crash
+                # traceback a worker wrote on its way down is exactly what
+                # the driver needs to see
+                if RayConfig.log_to_driver and self._gcs is not None:
+                    entry = self._drain_worker_log(h, final=True)
+                    if entry:
+                        try:
+                            await self._gcs.push(
+                                "pub.publish",
+                                {"channel": "worker_logs", "data": {"entries": [entry]}},
+                            )
+                        except Exception:
+                            pass
                 self._mark_sync()
                 if not h.registered.is_set():
                     # died before registering — undo the startup slot
@@ -487,6 +563,8 @@ class Raylet:
     async def _run_on_worker(self, h: WorkerHandle, spec: Dict[str, Any]):
         spec["_dispatched_at"] = time.monotonic()  # OOM policy: newest-first
         h.current_task = spec
+        if spec.get("job_id"):
+            h.job_id = spec["job_id"]  # log-stream attribution outlives the task
         try:
             await self._gcs.request("task.worker_assigned", {"task_id": spec["task_id"], "worker_id": h.worker_id})
             reply = await h.conn.request("exec.task", {"spec": spec})

@@ -13,9 +13,17 @@ features, TPU-cluster style —
 - ``env_vars``: job-level vars applied at worker startup; per-task
   ``runtime_env={"env_vars": ...}`` overlays around a single execution.
 
-Conda/pip/container isolation is intentionally out of scope (workers
-share the host interpreter; the reference's agent-based materialization
-does not fit a single-image TPU pod).
+- ``pip``: per-job dependency sets (reference:
+  _private/runtime_env/pip.py — there a per-node agent materializes a
+  virtualenv and workers exec through it). Here the venv materializes
+  once per node into the session dir, hashed by the requirement list,
+  and its site-packages is PREPENDED to sys.path around each execution
+  of that job's tasks — a different dependency set per job on shared
+  pooled workers, without a process re-exec. Local package paths are
+  zipped through the GCS KV like py_modules and pip-installed offline
+  (--no-index) on the worker node.
+
+Conda/container isolation stays out of scope (single-image TPU pods).
 """
 from __future__ import annotations
 
@@ -71,6 +79,20 @@ def publish(core, runtime_env: Dict[str, Any]) -> None:
         })
     if mods:
         spec["py_module_pkgs"] = mods
+    pips = []
+    for req in runtime_env.get("pip") or []:
+        if os.path.exists(req):
+            # local package dir/wheel: ship the bytes; the worker node
+            # pip-installs from the extracted copy (offline-safe)
+            blob = _zip_dir(req)
+            digest = hashlib.sha256(blob).hexdigest()[:16]
+            core.gcs_request("kv.put", {"ns": _KV_NS, "key": f"pkg_{digest}", "value": blob})
+            pips.append({"digest": digest, "name": os.path.basename(os.path.abspath(req)),
+                         "is_file": os.path.isfile(req)})
+        else:
+            pips.append({"req": req})
+    if pips:
+        spec["pip"] = pips
     core.gcs_request(
         "kv.put", {"ns": _KV_NS, "key": f"job_{core.job_id}", "value": json.dumps(spec).encode()}
     )
@@ -130,19 +152,146 @@ def ensure_job_env(core, session_dir: str, job_id: Optional[str]) -> Dict[str, A
         if wd not in sys.path:
             sys.path.insert(0, wd)
         spec["cwd"] = wd
+    if raw.get("pip"):
+        site = _materialize_pip_env(core, session_dir, raw["pip"])
+        # NOT a permanent sys.path entry: pooled workers serve many jobs;
+        # the overlay prepends this around the job's executions only
+        spec["extra_sys_path"] = [site]
     _job_specs[job_id] = spec
     return spec
 
 
+def _materialize_pip_env(core, session_dir: str, pips) -> str:
+    """Build (once per node) a venv for this requirement set; returns its
+    site-packages path. Hashed by the resolved spec; a lock file guards
+    concurrent workers racing to build the same env (reference: pip.py's
+    per-URI locking in the runtime-env agent)."""
+    import subprocess
+    import time as _time
+
+    key = hashlib.sha256(json.dumps(pips, sort_keys=True).encode()).hexdigest()[:16]
+    root = os.path.join(session_dir, "pip_envs")
+    os.makedirs(root, exist_ok=True)
+    venv_dir = os.path.join(root, key)
+    marker = venv_dir + ".ready"
+    site = os.path.join(
+        venv_dir, "lib", f"python{sys.version_info.major}.{sys.version_info.minor}", "site-packages"
+    )
+    if os.path.exists(marker):
+        return site
+    lock = venv_dir + ".lock"
+
+    def _lock_is_stale() -> bool:
+        try:
+            with open(lock) as f:
+                pid = int(f.read().strip() or "0")
+        except (OSError, ValueError):
+            return False
+        if pid <= 0:
+            return False
+        try:
+            os.kill(pid, 0)
+            return False  # builder still alive
+        except ProcessLookupError:
+            return True  # builder died mid-build (e.g. OOM-killed)
+        except OSError:
+            return False
+
+    while True:
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(os.getpid()).encode())
+            os.close(fd)
+            break
+        except FileExistsError:
+            # another worker is building: wait for its marker, stealing
+            # the lock if the builder process died
+            deadline = _time.time() + 300
+            while _time.time() < deadline:
+                if os.path.exists(marker):
+                    return site
+                if _lock_is_stale():
+                    try:
+                        os.unlink(lock)
+                    except OSError:
+                        pass
+                    break  # retry the O_EXCL create
+                _time.sleep(0.5)
+            else:
+                raise TimeoutError(f"pip env {key} build by another worker timed out")
+    if os.path.exists(marker):
+        # built while we raced for the lock: never rebuild over a live env
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
+        return site
+    try:
+        targets = []
+        for p in pips:
+            if "digest" in p:
+                pkg_root = _materialize_pkg(core, session_dir, p["digest"])
+                targets.append(pkg_root if not p.get("is_file") else os.path.join(pkg_root, p["name"]))
+            else:
+                targets.append(p["req"])
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages", venv_dir],
+            check=True, capture_output=True,
+        )
+        # when the running interpreter is ITSELF a venv (common in
+        # container images), `venv` chains to the BASE python whose
+        # site-packages lacks this environment's packages — link ours in
+        # via a .pth so --system-site-packages means "the packages this
+        # cluster actually runs with"
+        os.makedirs(site, exist_ok=True)
+        import site as _site_mod
+
+        parents = list(_site_mod.getsitepackages()) + [
+            p for p in sys.path if p.endswith("site-packages")
+        ]
+        with open(os.path.join(site, "_parent_site.pth"), "w") as f:
+            f.write("\n".join(dict.fromkeys(parents)) + "\n")
+        pip_bin = os.path.join(venv_dir, "bin", "python")
+        out = subprocess.run(
+            [pip_bin, "-m", "pip", "install", "--no-input", "--disable-pip-version-check",
+             "--no-build-isolation", "--no-index", *targets],
+            capture_output=True, text=True,
+        )
+        if out.returncode != 0:
+            # retry WITH the index for name-based requirements (networked
+            # clusters); local paths already failed for a real reason
+            out2 = subprocess.run(
+                [pip_bin, "-m", "pip", "install", "--no-input",
+                 "--disable-pip-version-check", "--no-build-isolation", *targets],
+                capture_output=True, text=True,
+            )
+            if out2.returncode != 0:
+                raise RuntimeError(
+                    f"pip install failed for {targets}:\n{out.stderr}\n{out2.stderr}"
+                )
+        with open(marker, "w") as f:
+            f.write("ok")
+        return site
+    finally:
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
+
+
 class env_overlay:
     """Context manager applying env_vars (and optionally a working
-    directory) around one execution, restoring the previous state."""
+    directory and extra sys.path entries — the pip-venv site-packages)
+    around one execution, restoring the previous state."""
 
-    def __init__(self, env_vars: Optional[Dict[str, str]], cwd: Optional[str] = None):
+    def __init__(self, env_vars: Optional[Dict[str, str]], cwd: Optional[str] = None,
+                 sys_path: Optional[list] = None):
         self.env_vars = env_vars or {}
         self.cwd = cwd
+        self.sys_path = sys_path or []
         self._saved: Dict[str, Optional[str]] = {}
         self._saved_cwd: Optional[str] = None
+        self._added_paths: list = []
 
     def __enter__(self):
         for k, v in self.env_vars.items():
@@ -151,8 +300,27 @@ class env_overlay:
         if self.cwd:
             self._saved_cwd = os.getcwd()
             os.chdir(self.cwd)
+        for p in self.sys_path:
+            if p not in sys.path:
+                sys.path.insert(0, p)
+                self._added_paths.append(p)
 
     def __exit__(self, *exc):
+        if self._added_paths:
+            # modules imported FROM the overlay paths must not survive in
+            # sys.modules, or the next job on this pooled worker silently
+            # inherits this job's dependency versions (isolation, not
+            # caching). They re-import on the job's next task.
+            prefixes = tuple(os.path.abspath(p) + os.sep for p in self._added_paths)
+            for name, mod in list(sys.modules.items()):
+                f = getattr(mod, "__file__", None)
+                if f and os.path.abspath(f).startswith(prefixes):
+                    del sys.modules[name]
+        for p in self._added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
         for k, old in self._saved.items():
             if old is None:
                 os.environ.pop(k, None)

@@ -110,6 +110,32 @@ class Worker:
                 from ray_tpu._private import runtime_env as renv
 
                 renv.publish(self.core, runtime_env)
+            log_to_driver = kwargs.get("log_to_driver")
+            if log_to_driver is None:
+                from ray_tpu._private.config import RayConfig as _RC
+
+                log_to_driver = _RC.log_to_driver
+            if log_to_driver:
+                # worker stdout/stderr lands on the driver (reference:
+                # log_monitor.py tail → GCS pubsub → driver print). Raylets
+                # tail and publish; we subscribe and print with a worker
+                # prefix, like `ray` drivers do.
+                def _print_worker_logs(data):
+                    my_job = self.core.job_id
+                    for entry in data.get("entries", ()):
+                        # only OUR job's workers (entries from the direct
+                        # dispatch path may be unattributed → print those
+                        # too rather than lose user output)
+                        if entry.get("job") not in (None, my_job):
+                            continue
+                        prefix = f"(worker {entry['worker']}) "
+                        for line in entry["text"].rstrip("\n").split("\n"):
+                            print(prefix + line, file=sys.stderr)
+
+                try:
+                    self.core.subscribe("worker_logs", _print_worker_logs)
+                except Exception:
+                    pass
             self.mode = "driver"
             import atexit
 

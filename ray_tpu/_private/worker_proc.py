@@ -97,7 +97,10 @@ class Executor:
                 args, kwargs = self.core.unpack_args(spec["args"])
                 # an actor worker is bound to its job for life: its env
                 # may apply permanently (constructors often capture cwd)
-                env_overlay(job_env.get("env_vars"), cwd=job_env.get("cwd")).__enter__()
+                env_overlay(
+                    job_env.get("env_vars"), cwd=job_env.get("cwd"),
+                    sys_path=job_env.get("extra_sys_path"),
+                ).__enter__()
                 return cls(*args, **kwargs)
 
             instance = await asyncio.get_running_loop().run_in_executor(self.pool, _construct)
@@ -335,9 +338,10 @@ class Executor:
                 merged_env = {**job_env.get("env_vars", {}),
                               **((spec.get("runtime_env") or {}).get("env_vars") or {})}
 
+                extra_path = job_env.get("extra_sys_path")
                 overlay = (
-                    env_overlay(merged_env, cwd=job_env.get("cwd"))
-                    if merged_env or job_env.get("cwd")
+                    env_overlay(merged_env, cwd=job_env.get("cwd"), sys_path=extra_path)
+                    if merged_env or job_env.get("cwd") or extra_path
                     else _NULL_OVERLAY  # hot path: nothing to apply/restore
                 )
                 fn_key = spec.get("method") if actor else spec["fn_id"]
