@@ -1570,6 +1570,10 @@ class CoreWorker:
             "job_id": self.job_id,
             **(scheduling or {}),
         }
+        from ray_tpu.util import tracing
+
+        if tracing.should_trace():
+            spec["trace"] = tracing.submission_context(name)
         self._register_returns(returns)
         self._pin_args(task_id, spec["args"])
         self._submitted[spec["task_id"]] = {"spec": spec, "retries_left": spec.get("max_retries", 0)}
@@ -1855,6 +1859,7 @@ class CoreWorker:
                                     if s.get("runtime_env")
                                     else {}
                                 ),
+                                **({"trace": s["trace"]} if s.get("trace") else {}),
                             }
                             for s in batch
                         ]
@@ -2004,6 +2009,10 @@ class CoreWorker:
             "args": self.pack_args(args, kwargs),
             "returns": returns,
         }
+        from ray_tpu.util import tracing
+
+        if tracing.should_trace():
+            spec["trace"] = tracing.submission_context(method_name)
         self._register_returns(returns)
         self._pin_args(returns[0], spec["args"])
         # fire-and-forget enqueue: the caller holds refs whose cells are

@@ -1426,6 +1426,17 @@ class GcsServer:
             )
         return True
 
+    async def _rpc_spans_report(self, d, conn):
+        """Trace-span sink (reference: the OTLP exporter's collector role;
+        here spans aggregate in the GCS and export driver-side)."""
+        if not hasattr(self, "trace_spans"):
+            self.trace_spans = collections.deque(maxlen=100000)
+        self.trace_spans.extend(d["spans"])
+        return True
+
+    async def _rpc_spans_list(self, d, conn):
+        return list(getattr(self, "trace_spans", ()))
+
     async def _rpc_state_tasks(self, d, conn):
         limit = d.get("limit", 1000)
         return list(self.task_events)[-limit:]

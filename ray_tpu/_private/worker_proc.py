@@ -46,6 +46,14 @@ def _cancelled_envs(spec):
     return [err] * len(spec["returns"])
 
 
+async def _traced_coro(span_cm, fn, args, kwargs):
+    """Run an async-actor method under its tracing span: the span
+    contextvar is set inside THIS coroutine's context, so it stays active
+    across awaits and nested submissions parent correctly."""
+    with span_cm:
+        return await fn(*args, **kwargs)
+
+
 class Executor:
     def __init__(self, core: CoreWorker):
         self.core = core
@@ -348,7 +356,13 @@ class Executor:
                 is_coro = self._coro_cache.get(fn_key)
                 if is_coro is None:
                     is_coro = self._coro_cache[fn_key] = inspect.iscoroutinefunction(fn)
-                with overlay:
+                if spec.get("trace"):
+                    from ray_tpu.util import tracing as _tracing
+
+                    span_cm = _tracing.execution_span(spec["trace"], name)
+                else:
+                    span_cm = contextlib.nullcontext()
+                with overlay, span_cm:
                     if is_coro:
                         import asyncio as _a
 
@@ -398,9 +412,19 @@ class Executor:
         try:
             # async actor: unpack off-loop, run the coroutine on the
             # dedicated user loop (awaited from here without blocking)
+            if spec.get("trace"):
+                from ray_tpu.util import tracing as _tracing
+
+                span_cm = _tracing.execution_span(spec["trace"], name)
+            else:
+                import contextlib as _cl
+
+                span_cm = _cl.nullcontext()
             args, kwargs = await loop.run_in_executor(self.pool, self.core.unpack_args, spec["args"])
             fn = getattr(self.actor_instance, spec["method"])
-            cfut = asyncio.run_coroutine_threadsafe(fn(*args, **kwargs), self._ensure_user_loop())
+            cfut = asyncio.run_coroutine_threadsafe(
+                _traced_coro(span_cm, fn, args, kwargs), self._ensure_user_loop()
+            )
             result = await asyncio.wrap_future(cfut)
             values = self._split_returns(spec, result)
             if values is None:

@@ -39,11 +39,13 @@ class LocalNodeProvider(NodeProvider):
     that makes autoscaling testable without a cloud)."""
 
     def __init__(self, cluster, num_cpus: int = 2, object_store_memory: int = 64 * 1024 * 1024,
-                 resources: Optional[Dict[str, float]] = None):
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None):
         self.cluster = cluster
         self.num_cpus = num_cpus
         self.object_store_memory = object_store_memory
         self.resources = resources or {}
+        self.labels = labels or {}
         self._nodes: Dict[str, Any] = {}
         self._counter = 0
 
@@ -53,6 +55,7 @@ class LocalNodeProvider(NodeProvider):
             num_cpus=node_config.get("num_cpus", self.num_cpus),
             object_store_memory=self.object_store_memory,
             resources={**self.resources, **node_config.get("resources", {})},
+            labels={**self.labels, **(node_config.get("labels") or {})},
         )
         self._nodes[node.node_id] = node
         return node.node_id

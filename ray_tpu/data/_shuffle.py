@@ -112,6 +112,23 @@ def shuffle_exchange(
             ref, ops_ref, mode, M, map_arg, base_seed + 17 * i + 1
         )
         parts.append(out if isinstance(out, list) else [out])
+
+    # Hierarchical reduce for large exchanges (reference: push-based
+    # shuffle exists precisely because N_mappers x M_reducers part refs
+    # overwhelm flat exchanges): group mappers, concat-merge each group's
+    # column j, then run the REAL reduce over one partial per group —
+    # a reduce call never takes more than _GROUP inputs, and the final
+    # permute/sort still happens exactly once.
+    _GROUP = 64
+    if len(parts) > _GROUP:
+        grouped: List[List[Any]] = []
+        for g in range(0, len(parts), _GROUP):
+            chunk = parts[g : g + _GROUP]
+            grouped.append([
+                _reduce_merge.remote(None, None, 0, *(p[j] for p in chunk))
+                for j in range(M)
+            ])
+        parts = grouped
     return [
         _reduce_merge.remote(mode, reduce_arg, base_seed + 31 * j + 7, *(p[j] for p in parts))
         for j in range(M)

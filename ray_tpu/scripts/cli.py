@@ -117,9 +117,58 @@ def cmd_submit(args):
         sys.exit(0 if status == "SUCCEEDED" else 1)
 
 
+def cmd_up(args):
+    """Launch a cluster from a YAML config and keep the autoscaler
+    reconciling until interrupted (reference: `ray up` +
+    autoscaler/_private/commands.py create_or_update_cluster)."""
+    os.environ["RAY_TPU_DETACHED"] = "1"  # nodes must outlive this CLI
+    from ray_tpu.autoscaler.config import ClusterLauncher, load_config
+
+    config = load_config(args.config)
+    launcher = ClusterLauncher(config)
+    cluster = launcher.up()
+    # record pids so `ray_tpu down`/`stop` can find this cluster
+    with open(os.path.join(cluster.session_dir, "cluster_pids.json"), "w") as f:
+        json.dump([p.pid for p in cluster.procs.procs], f)
+    print(f"cluster '{config.get('cluster_name', 'cluster')}' up: "
+          f"gcs={cluster.gcs_address} session={cluster.session_dir}")
+    if args.no_monitor:
+        return
+    print("autoscaler monitoring (ctrl-c to stop; nodes keep running)...")
+    try:
+        while True:
+            actions = launcher.update()
+            changed = False
+            for group, act in actions.items():
+                if act.get("launched") or act.get("terminated"):
+                    changed = True
+                    print(f"  [{group}] +{act.get('launched', 0)} -{act.get('terminated', 0)}")
+            if changed:  # autoscaled nodes must be stoppable too
+                with open(os.path.join(cluster.session_dir, "cluster_pids.json"), "w") as f:
+                    json.dump([p.pid for p in cluster.procs.procs], f)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        print("monitor stopped (use `ray_tpu stop` to tear the cluster down)")
+
+
+def cmd_down(args):
+    """Tear down everything `up` (or start) launched on this machine."""
+    cmd_stop(args)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("up", help="launch a cluster from a YAML config")
+    p.add_argument("config", help="path to the cluster YAML")
+    p.add_argument("--no-monitor", action="store_true",
+                   help="launch min_workers and exit (no autoscaling loop)")
+    p.add_argument("--interval", type=float, default=5.0)
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear down the local cluster")
+    p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("start", help="start a head node or join a cluster")
     p.add_argument("--head", action="store_true")

@@ -1,0 +1,197 @@
+"""Distributed tracing spans around task/actor calls.
+
+Equivalent of the reference's OpenTelemetry integration (reference:
+python/ray/util/tracing/tracing_helper.py — spans wrap every remote
+submission and execution, with the trace context propagated inside the
+task spec so worker-side spans parent correctly). The OpenTelemetry SDK
+is not in this image, so spans are recorded natively (same fields OTLP
+wants: trace_id, span_id, parent_id, name, start/end, attributes),
+collected through the GCS, and exportable as OTLP-shaped JSON or a
+Chrome trace.
+
+Usage::
+
+    from ray_tpu.util import tracing
+    tracing.enable()                # BEFORE submitting work
+    ...
+    spans = tracing.get_spans()     # driver-side: all collected spans
+    tracing.export_otlp_json(path)  # or OTLP-shaped file
+"""
+from __future__ import annotations
+
+import contextvars
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.ids import hex_id, new_id
+
+_enabled = False
+_current_span: contextvars.ContextVar = contextvars.ContextVar("ray_tpu_span", default=None)
+
+
+def enable() -> None:
+    """Turn on span capture in THIS process and every worker it reaches
+    (propagated via the task specs themselves, so no env plumbing)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled or os.environ.get("RAY_TPU_TRACING") == "1"
+
+
+def should_trace() -> bool:
+    """Trace when explicitly enabled OR while executing a traced call
+    (the span contextvar carries per-CALL tracing through workers without
+    flipping any process-global state — a pooled worker must not stay in
+    tracing mode for other jobs' tasks)."""
+    return is_enabled() or _current_span.get() is not None
+
+
+# ---------------------------------------------------------------- context
+def current_context() -> Optional[Dict[str, str]]:
+    """The (trace_id, span_id) pair submissions should parent under."""
+    span = _current_span.get()
+    if span is not None:
+        return {"trace_id": span["trace_id"], "span_id": span["span_id"]}
+    return None
+
+
+def submission_context(name: str) -> Optional[Dict[str, str]]:
+    """Called by submit paths: mint the ctx that rides the spec. A fresh
+    trace starts when there is no enclosing span (driver root)."""
+    if not should_trace():
+        return None
+    parent = current_context()
+    ctx = {
+        "trace_id": parent["trace_id"] if parent else hex_id(new_id()),
+        "span_id": hex_id(new_id())[:16],
+        "name": name,
+    }
+    if parent:
+        ctx["parent_id"] = parent["span_id"]
+    _record(
+        {
+            "trace_id": ctx["trace_id"],
+            "span_id": ctx["span_id"],
+            "parent_id": ctx.get("parent_id"),
+            "name": f"submit:{name}",
+            "start": time.time(),
+            "end": time.time(),
+            "kind": "PRODUCER",
+        }
+    )
+    return ctx
+
+
+class execution_span:
+    """Worker-side: wraps one task execution as a child span of the
+    submission context carried in the spec."""
+
+    def __init__(self, ctx: Optional[Dict[str, str]], name: str):
+        self.ctx = ctx
+        self.name = name
+        self._token = None
+        self._span: Optional[Dict[str, Any]] = None
+
+    def __enter__(self):
+        if self.ctx is None:
+            return self
+        self._span = {
+            "trace_id": self.ctx["trace_id"],
+            "span_id": hex_id(new_id())[:16],
+            "parent_id": self.ctx["span_id"],
+            "name": f"run:{self.name}",
+            "start": time.time(),
+            "kind": "CONSUMER",
+        }
+        # NOTE: no process-global flag flip — nested submissions trace via
+        # should_trace() seeing this contextvar, scoped to THIS call only
+        self._token = _current_span.set(self._span)
+        return self
+
+    def __exit__(self, exc_type, *rest):
+        if self._span is None:
+            return False
+        self._span["end"] = time.time()
+        if exc_type is not None:
+            self._span["status"] = "ERROR"
+            self._span["error_type"] = exc_type.__name__
+        _current_span.reset(self._token)
+        _record(self._span)
+        # workers have no driver-side get_spans() to trigger a flush —
+        # ship this execution's spans now (tracing is opt-in; the extra
+        # GCS push per traced task is the feature's cost)
+        flush()
+        return False
+
+
+# ---------------------------------------------------------------- recording
+_buffer: List[Dict[str, Any]] = []
+
+
+def _record(span: Dict[str, Any]) -> None:
+    _buffer.append(span)
+    if len(_buffer) >= 128:
+        flush()
+
+
+def flush() -> None:
+    """Push buffered spans to the GCS collector (best-effort)."""
+    global _buffer
+    if not _buffer:
+        return
+    spans, _buffer = _buffer, []
+    try:
+        from ray_tpu._private.worker import get_global_core
+
+        get_global_core().gcs_request("spans.report", {"spans": spans})
+    except Exception:
+        _buffer = spans + _buffer  # keep for the next flush
+
+
+def get_spans() -> List[Dict[str, Any]]:
+    """All spans the GCS has collected (cluster-wide)."""
+    flush()
+    from ray_tpu._private.worker import get_global_core
+
+    return get_global_core().gcs_request("spans.list", {})
+
+
+def export_otlp_json(path: str) -> int:
+    """Write OTLP-shaped JSON (resourceSpans/scopeSpans/spans with ns
+    timestamps) — loadable by OTLP-compatible tooling."""
+    import json
+
+    spans = get_spans()
+    otlp = {
+        "resourceSpans": [{
+            "resource": {"attributes": [{"key": "service.name",
+                                         "value": {"stringValue": "ray_tpu"}}]},
+            "scopeSpans": [{
+                "scope": {"name": "ray_tpu.tracing"},
+                "spans": [
+                    {
+                        "traceId": s["trace_id"],
+                        "spanId": s["span_id"],
+                        **({"parentSpanId": s["parent_id"]} if s.get("parent_id") else {}),
+                        "name": s["name"],
+                        "startTimeUnixNano": int(s["start"] * 1e9),
+                        "endTimeUnixNano": int(s.get("end", s["start"]) * 1e9),
+                        "kind": 4 if s.get("kind") == "PRODUCER" else 5,
+                        **({"status": {"code": 2}} if s.get("status") == "ERROR" else {}),
+                    }
+                    for s in spans
+                ],
+            }],
+        }]
+    }
+    with open(path, "w") as f:
+        json.dump(otlp, f)
+    return len(spans)

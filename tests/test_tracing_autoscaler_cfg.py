@@ -1,0 +1,89 @@
+"""Tracing spans + autoscaler YAML config.
+
+Reference test shape: python/ray/tests/test_tracing.py (span capture
+around remote calls with context propagation) and
+test_autoscaler_yaml.py (schema validation)."""
+import os
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_tracing_spans_propagate(ray_start_regular, tmp_path):
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+
+    @ray_tpu.remote
+    def child():
+        return 1
+
+    @ray_tpu.remote
+    def parent():
+        import ray_tpu as rt
+
+        return rt.get(child.remote(), timeout=60)
+
+    assert ray_tpu.get(parent.remote(), timeout=120) == 1
+    import time
+
+    time.sleep(0.5)
+    spans = tracing.get_spans()
+    names = [s["name"] for s in spans]
+    assert any(n == "submit:parent" for n in names), names
+    assert any(n == "run:parent" for n in names), names
+    assert any(n == "run:child" for n in names), names
+    # context propagation: child's run span belongs to the SAME trace as
+    # the driver's parent submission, with a proper parent chain
+    root = next(s for s in spans if s["name"] == "submit:parent")
+    run_parent = next(s for s in spans if s["name"] == "run:parent")
+    run_child = next(s for s in spans if s["name"] == "run:child")
+    assert run_parent["trace_id"] == root["trace_id"]
+    assert run_child["trace_id"] == root["trace_id"]
+    assert run_parent["parent_id"] == root["span_id"]
+    # OTLP export round-trips
+    out = str(tmp_path / "spans.json")
+    n = tracing.export_otlp_json(out)
+    assert n >= 3 and os.path.getsize(out) > 0
+
+
+def test_autoscaler_yaml_validation(tmp_path):
+    from ray_tpu.autoscaler.config import load_config, validate_config
+
+    good = {
+        "cluster_name": "t",
+        "max_workers": 4,
+        "provider": {"type": "local"},
+        "available_node_types": {
+            "head": {"min_workers": 0, "max_workers": 1, "resources": {"CPU": 2}},
+            "v5e": {"min_workers": 0, "max_workers": 2,
+                    "resources": {"CPU": 4, "TPU": 4}, "labels": {"slice_type": "v5e-4"}},
+        },
+        "head_node_type": "head",
+    }
+    assert validate_config(dict(good))
+
+    import yaml
+
+    p = tmp_path / "cluster.yaml"
+    p.write_text(yaml.safe_dump(good))
+    assert load_config(str(p))["cluster_name"] == "t"
+
+    with pytest.raises(ValueError, match="unknown cluster config key"):
+        validate_config({**good, "bogus": 1})
+    with pytest.raises(ValueError, match="unknown provider type"):
+        validate_config({**good, "provider": {"type": "aws"}})
+    bad_types = dict(good["available_node_types"])
+    bad_types["v5e"] = {**bad_types["v5e"], "min_workers": 5}
+    with pytest.raises(ValueError, match="min_workers > max_workers"):
+        validate_config({**good, "available_node_types": bad_types})
+    with pytest.raises(ValueError, match="head_node_type"):
+        validate_config({**good, "head_node_type": "nope"})
