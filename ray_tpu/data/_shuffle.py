@@ -120,7 +120,7 @@ def shuffle_exchange(
     # a reduce call never takes more than _GROUP inputs, and the final
     # permute/sort still happens exactly once.
     _GROUP = 64
-    if len(parts) > _GROUP:
+    while len(parts) > _GROUP:  # loop: even 10k+ mappers converge to <=64
         grouped: List[List[Any]] = []
         for g in range(0, len(parts), _GROUP):
             chunk = parts[g : g + _GROUP]
