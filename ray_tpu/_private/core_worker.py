@@ -214,6 +214,9 @@ class CoreWorker:
 
     # ------------------------------------------------------------- lifecycle
     def _run_loop(self):
+        import sys as _sys
+
+        _sys.setswitchinterval(0.02)  # see worker_proc.main: 1-core GIL thrash
         asyncio.set_event_loop(self._loop)
         self._loop_ready.set()
         prof_dir = os.environ.get("RAY_TPU_PROFILE_DIR")
