@@ -1326,6 +1326,23 @@ class CoreWorker:
             return exceptions.TaskCancelledError(env.get("m", ""))
         return exceptions.TaskError(env.get("fn", "?"), env.get("tb", env.get("m", "")), env.get("t", ""))
 
+    async def aget_value(self, ref: "ObjectRef", timeout: Optional[float] = None):
+        """Async get for callers running on a FOREIGN event loop (the
+        serve proxies): the env resolve bridges onto the core IO loop;
+        inline envelopes decode right here (pure CPU), while shm-backed
+        envelopes — whose decode can block on arena reads, GCS resolves
+        and spill restores — run in a worker thread so the caller's loop
+        never stalls. One contract shared with get_values: both funnel
+        through _aget_envs + _decode_ref."""
+        oid = ref.binary()
+        cf = asyncio.run_coroutine_threadsafe(self._aget_envs([oid], timeout), self._loop)
+        envs = await asyncio.wrap_future(cf)
+        env = envs[0]
+        if env.get("k") == "i":
+            return self._decode(env)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self._decode_ref, oid, env)
+
     def get_values(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         """get() with local-shm decoding (the public path).
 

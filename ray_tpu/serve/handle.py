@@ -31,6 +31,19 @@ class DeploymentResponse:
             if self._on_done:
                 self._on_done()
 
+    async def async_result(self, timeout: Optional[float] = 60.0):
+        """Await the result natively (reference: the proxy awaits replica
+        responses; a run_in_executor per request burned a pool thread at
+        proxy QPS). Inline results resolve with zero thread hops; only
+        blocking decode paths (shm/spill) use a worker thread."""
+        from ray_tpu._private.worker import get_global_core
+
+        try:
+            return await get_global_core().aget_value(self._ref, timeout)
+        finally:
+            if self._on_done:
+                self._on_done()
+
     @property
     def ref(self):
         return self._ref

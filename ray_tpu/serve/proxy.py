@@ -110,7 +110,6 @@ class ProxyActor:
             body = await request.json() if request.can_read_body else {}
         except json.JSONDecodeError:
             body = {"raw": await request.text()}
-        loop = asyncio.get_running_loop()
         try:
             if is_ingress:
                 # path routing inside the deployment: forward (method,
@@ -120,7 +119,9 @@ class ProxyActor:
                 resp = handle.remote(request.method, sub, body, dict(request.query))
             else:
                 resp = handle.remote(body)
-            result = await loop.run_in_executor(None, resp.result, 60)
+            # native await (no executor-thread hop per request): resolves
+            # on the CoreWorker loop and bridges here
+            result = await resp.async_result(60)
             if isinstance(result, (dict, list, str, int, float, bool, type(None))):
                 return web.json_response({"result": result})
             return web.json_response({"result": str(result)})
