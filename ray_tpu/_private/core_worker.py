@@ -95,6 +95,10 @@ class _ShapeState:
         self.acquiring = 0
         self.event = asyncio.Event()
         self.denied_until = 0.0
+        # learned pipeline depth (adaptive batching carries across lease
+        # churn: an idle-released lease must not re-ramp from scratch)
+        self.batch_max = 2
+        self.window_max = 2
 
 
 class CoreWorker:
@@ -1765,10 +1769,12 @@ class CoreWorker:
         st.event.set()
         self._grow_leases(key, st)
 
-    def _fallback_to_gcs(self, st: "_ShapeState"):
-        """Hand the backlog to the central scheduler when no lease will
-        drain it (denial window / no direct capacity / connect failure)."""
-        while st.queue:
+    def _fallback_to_gcs(self, st: "_ShapeState", keep: int = 0):
+        """Hand the backlog (all but `keep` specs) to the central
+        scheduler — used when no lease will drain it (denial window / no
+        direct capacity / connect failure) and when local capacity is
+        exhausted under slow-task pressure (cross-node spill)."""
+        while len(st.queue) > keep:
             spec = st.queue.popleft()
             self._loop.create_task(self._gcs.request("task.submit", {"spec": spec}))
 
@@ -1789,7 +1795,7 @@ class CoreWorker:
             )
         return self._raylet_conn
 
-    async def _acquire_lease(self, key, st: _ShapeState):
+    async def _acquire_lease(self, key, st: _ShapeState, spill_on_deny: bool = False):
         try:
             rl = await self._raylet()
             reply = await rl.request("lease.request", {"resources": dict(key)})
@@ -1804,6 +1810,12 @@ class CoreWorker:
                 # no direct capacity at all: hand the backlog to the
                 # central scheduler (cross-node placement lives there)
                 self._fallback_to_gcs(st)
+            elif spill_on_deny:
+                # adaptive growth hit the LOCAL node's ceiling while slow
+                # tasks still queue: ship the excess to the central
+                # scheduler so OTHER nodes' workers drain it (keep a
+                # couple locally — the live leases are still chewing)
+                self._fallback_to_gcs(st, keep=2)
             return
         lease_id = reply["lease_id"]
         try:
@@ -1847,11 +1859,17 @@ class CoreWorker:
                     self._submitted.pop(tid, None)
             window.clear()
 
+        # ADAPTIVE pipeline depth: a deep window is what makes the noop
+        # fan-out fast (few loop wakeups per task), but it also COMMITS
+        # tasks to this worker before anyone knows they're slow — a batch
+        # of sleep(1)s pipelined behind one lease serializes while other
+        # nodes idle. Start shallow; double the batch size every time a
+        # reply proves the tasks are fast (<2ms avg), reset when slow.
         try:
             while True:
-                while st.queue and len(window) < 4:
+                while st.queue and len(window) < st.window_max:
                     batch = []
-                    while st.queue and len(batch) < RayConfig.direct_task_batch_max:
+                    while st.queue and len(batch) < st.batch_max:
                         spec = st.queue.popleft()
                         if spec.get("cancelled"):
                             self._fail_call(spec, exceptions.TaskCancelledError(spec.get("name", "")))
@@ -1924,10 +1942,40 @@ class CoreWorker:
                 now = time.time()
                 timings = reply.get("timings") or {}
                 buf = self._task_events
+                total_exec = 0.0
                 for spec in batch:
                     t0, t1 = timings.get(spec["task_id"], (now, now))
+                    total_exec += t1 - t0
                     buf.append((spec["task_id"], spec.get("name", ""), t0, t1))
                 self._schedule_event_flush()
+                avg_exec = total_exec / len(batch) if batch else 0.0
+                slow = avg_exec >= 0.002  # ONE threshold: no dead zone
+                if not slow:
+                    st.batch_max = min(st.batch_max * 2, RayConfig.direct_task_batch_max)
+                    st.window_max = 4
+                else:
+                    # SLOW tasks: shallow pipeline — leave the backlog in
+                    # the queue where freshly-grown leases can take it,
+                    # instead of re-committing it all to this worker
+                    st.batch_max = 2
+                    st.window_max = 1
+                # ADAPTIVE lease growth: the default lease count is sized
+                # for fast tasks (pipelining through few workers wins on
+                # small hosts), but SLOW tasks serialize behind it — when
+                # measured execution time says the backlog won't drain
+                # soon, take another lease (raylet admission control still
+                # bounds total concurrency by the node's resources).
+                if (
+                    st.queue
+                    and slow
+                    and len(st.leases) + st.acquiring
+                    < min(len(st.queue) + len(st.leases), 64)
+                    and time.monotonic() >= st.denied_until
+                ):
+                    st.acquiring += 1
+                    self._loop.create_task(
+                        self._acquire_lease(key, st, spill_on_deny=True)
+                    )
         finally:
             st.leases.discard(lease_id)
             try:

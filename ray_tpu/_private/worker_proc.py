@@ -132,8 +132,14 @@ class Executor:
         spec = data["spec"]
         if spec.get("cancelled") or spec["task_id"] in self._cancelled:
             return {"o": spec["returns"], "e": _cancelled_envs(spec)}
+        import time as _time
+
+        t0 = _time.time()
         envs = await self._run_user_function(spec)
-        return {"o": spec["returns"], "e": envs}
+        # timings feed the owner's adaptive pipeline-depth classifier —
+        # the single-spec path must report them like the batch path does
+        return {"o": spec["returns"], "e": envs,
+                "timings": {spec["task_id"]: (t0, _time.time())}}
 
     async def handle_direct_tasks(self, data, conn=None) -> Dict[str, Any]:
         """Batch of direct tasks from one lease drain: one executor hop
@@ -149,10 +155,9 @@ class Executor:
         timings = {}
         if runnable:
             loop = asyncio.get_running_loop()
-            env_lists = await loop.run_in_executor(
+            env_lists, timings = await loop.run_in_executor(
                 self.pool, self._exec_sync_batch, runnable, False, loop, conn
             )
-            timings = getattr(self, "_batch_timings", {})
             for spec, envs in zip(runnable, env_lists):
                 oids.extend(spec["returns"])
                 out_envs.extend(envs)
@@ -182,7 +187,7 @@ class Executor:
         if self.actor_instance is not None and not self.actor_is_async and self.actor_max_concurrency == 1:
             loop = asyncio.get_running_loop()
             async with self.actor_semaphore:
-                env_lists = await loop.run_in_executor(
+                env_lists, _ = await loop.run_in_executor(
                     self.pool, self._exec_sync_batch, specs, True, loop, conn
                 )
             return {
@@ -228,7 +233,7 @@ class Executor:
 
         out = []
         staged = []
-        self._batch_timings = {}
+        timings = {}  # LOCAL: concurrent batch handlers must not share
         if self._exec_prof is not None:
             self._exec_prof.enable()
         try:
@@ -242,7 +247,7 @@ class Executor:
                     out.append(envs)
                     appended = True
                     t1 = _time.time()
-                    self._batch_timings[spec.get("task_id") or spec["returns"][0]] = (t0, t1)
+                    timings[spec.get("task_id") or spec["returns"][0]] = (t0, t1)
                     for oid, env in zip(spec["returns"], envs):
                         self.core._deliver(bytes(oid), env)
                         staged.append(bytes(oid))
@@ -282,7 +287,7 @@ class Executor:
             # extra work.
             if self.core._ref_events or self.core._borrows_to_flush:
                 self.core.flush_borrows_sync()
-            return out
+            return out, timings
         finally:
             if self._exec_prof is not None:
                 self._exec_prof.disable()
