@@ -131,9 +131,10 @@ class CoreWorker:
         self._pin_registered: set = set()
         self._dir_free_pending: List[bytes] = []
         self._owned_flush_scheduled = False
-        # producer-side handoff pins: (deadline, buf) released by the gc
-        # loop once the owner has surely pinned (see put_serialized_to_shm)
-        self._handoff_pins: List[Tuple[float, Any]] = []
+        # producer-side handoff pins: (deadline, floor, buf) released by
+        # the gc loop once the owner has surely pinned — never before the
+        # floor, even under pressure (see put_serialized_to_shm)
+        self._handoff_pins: List[Tuple[float, float, Any]] = []
         # task-event buffer: direct-path task transitions accumulate here
         # and flush to the GCS on a timer (reference: TaskEventBuffer,
         # src/ray/core_worker/task_event_buffer.h:206)
@@ -220,7 +221,12 @@ class CoreWorker:
     def _run_loop(self):
         import sys as _sys
 
-        _sys.setswitchinterval(0.02)  # see worker_proc.main: 1-core GIL thrash
+        if self.mode != "driver" or os.environ.get("RAY_TPU_DRIVER_GIL_TUNE") == "1":
+            # see worker_proc.main: 1-core GIL thrash. NOT applied in the
+            # user's driver process by default — setswitchinterval is
+            # process-wide and would add scheduling latency to the user's
+            # own compute threads just from importing the library.
+            _sys.setswitchinterval(0.02)
         asyncio.set_event_loop(self._loop)
         self._loop_ready.set()
         prof_dir = os.environ.get("RAY_TPU_PROFILE_DIR")
@@ -681,11 +687,16 @@ class CoreWorker:
             # ref-gc loop, server-side read loops) so loop.stop() doesn't
             # strand pending tasks — the source of "Task was destroyed but
             # it is pending!" showers at interpreter exit
+            # loop until quiescent: a cancelled read loop can spawn one
+            # last _serve/_teardown task AFTER the first sweep, and a
+            # single-pass cancel would strand it
             cur = asyncio.current_task()
-            rest = [t for t in asyncio.all_tasks() if t is not cur]
-            for t in rest:
-                t.cancel()
-            if rest:
+            for _ in range(5):
+                rest = [t for t in asyncio.all_tasks() if t is not cur]
+                if not rest:
+                    break
+                for t in rest:
+                    t.cancel()
                 await asyncio.gather(*rest, return_exceptions=True)
 
         try:
@@ -696,7 +707,7 @@ class CoreWorker:
         self._loop_thread.join(timeout=5)
         with self._store_lock:
             pins, self._handoff_pins = self._handoff_pins, []
-        for _, buf in pins:
+        for *_, buf in pins:
             try:
                 buf.release()
             except Exception:
@@ -983,8 +994,9 @@ class CoreWorker:
         and failing a put while dozens of release-eligible pins are queued
         would be a spurious ObjectStoreFullError."""
         self._drain_ref_events()
-        # under allocation pressure, shave the handoff grace to 0.1s — the
-        # owner's pin is normally in place within a reply round trip
+        # under allocation pressure, shave the handoff grace down to its
+        # 0.2s floor — the owner's pin is normally in place within a
+        # reply round trip
         self._sweep_handoff_pins(early_by=0.4)
         self._sweep_release_retry()
 
@@ -1011,13 +1023,17 @@ class CoreWorker:
             if not self._handoff_pins:
                 return
             items, self._handoff_pins = self._handoff_pins, []
-        now = time.monotonic() + early_by
-        keep: List[Tuple[float, Any]] = []
-        for deadline, buf in items:
-            if deadline <= now:
+        real_now = time.monotonic()
+        now = real_now + early_by
+        keep: List[Tuple[float, float, Any]] = []
+        for deadline, floor, buf in items:
+            # the floor is a hard minimum grace: pressure sweeps (early_by
+            # > 0) may not release a pin before the owner's delivery pin
+            # has had one reply round trip to land
+            if deadline <= now and floor <= real_now:
                 buf.release()
             else:
-                keep.append((deadline, buf))
+                keep.append((deadline, floor, buf))
         if keep:
             with self._store_lock:
                 self._handoff_pins.extend(keep)
@@ -1116,8 +1132,9 @@ class CoreWorker:
         # itself pin production-rate × grace worth of arena).
         hbuf = self._shm.get(oid, timeout_ms=0)
         if hbuf is not None:
+            _hnow = time.monotonic()
             with self._store_lock:
-                self._handoff_pins.append((time.monotonic() + 0.5, hbuf))
+                self._handoff_pins.append((_hnow + 0.5, _hnow + 0.2, hbuf))
         self._call(self._gcs.request("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": total}))
         return _env_shm(self.node_id, total)
 

@@ -203,8 +203,19 @@ class Raylet:
         if not final:
             cut = chunk.rfind(b"\n")
             if cut < 0:
-                return None  # no complete line yet
-            chunk = chunk[: cut + 1]
+                if len(chunk) < 256 * 1024:
+                    return None  # no complete line yet
+                # the read window is FULL with no newline: a single line
+                # >256 KiB would otherwise stall this worker's streaming
+                # forever (offset never advances) — emit it as a partial
+                # line so the window moves. Back off to a UTF-8 boundary
+                # so a multi-byte char isn't split across publishes.
+                while chunk and chunk[-1] & 0xC0 == 0x80:
+                    chunk = chunk[:-1]
+                if chunk and chunk[-1] >= 0xC0:
+                    chunk = chunk[:-1]  # dangling lead byte
+            else:
+                chunk = chunk[: cut + 1]
         h.log_offset += len(chunk)
         text = chunk.decode("utf-8", "replace")
         # framework chatter (INFO/DEBUG from ray_tpu loggers) stays in

@@ -180,51 +180,31 @@ def _materialize_pip_env(core, session_dir: str, pips) -> str:
     if os.path.exists(marker):
         return site
     lock = venv_dir + ".lock"
+    import fcntl
 
-    def _lock_is_stale() -> bool:
-        try:
-            with open(lock) as f:
-                pid = int(f.read().strip() or "0")
-        except (OSError, ValueError):
-            return False
-        if pid <= 0:
-            return False
-        try:
-            os.kill(pid, 0)
-            return False  # builder still alive
-        except ProcessLookupError:
-            return True  # builder died mid-build (e.g. OOM-killed)
-        except OSError:
-            return False
-
+    # OS-arbitrated lock: the kernel releases flock automatically when the
+    # holder dies, so no pid-based staleness heuristics (and no TOCTOU
+    # steal race between two waiters).
+    lock_fd = os.open(lock, os.O_CREAT | os.O_RDWR, 0o644)
+    deadline = _time.time() + 300
     while True:
         try:
-            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            os.write(fd, str(os.getpid()).encode())
-            os.close(fd)
+            fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
             break
-        except FileExistsError:
-            # another worker is building: wait for its marker, stealing
-            # the lock if the builder process died
-            deadline = _time.time() + 300
-            while _time.time() < deadline:
-                if os.path.exists(marker):
-                    return site
-                if _lock_is_stale():
-                    try:
-                        os.unlink(lock)
-                    except OSError:
-                        pass
-                    break  # retry the O_EXCL create
-                _time.sleep(0.5)
-            else:
+        except BlockingIOError:
+            # contended (EAGAIN); any OTHER OSError (e.g. ENOLCK on a
+            # lockless fs) propagates — it is a real failure, not a
+            # "someone else is building" signal
+            if os.path.exists(marker):
+                os.close(lock_fd)
+                return site
+            if _time.time() >= deadline:
+                os.close(lock_fd)
                 raise TimeoutError(f"pip env {key} build by another worker timed out")
+            _time.sleep(0.5)
     if os.path.exists(marker):
         # built while we raced for the lock: never rebuild over a live env
-        try:
-            os.unlink(lock)
-        except OSError:
-            pass
+        os.close(lock_fd)
         return site
     try:
         targets = []
@@ -273,10 +253,10 @@ def _materialize_pip_env(core, session_dir: str, pips) -> str:
             f.write("ok")
         return site
     finally:
-        try:
-            os.unlink(lock)
-        except OSError:
-            pass
+        # closing releases the flock; the lock file itself is never
+        # unlinked (unlink would let a new locker create a fresh inode
+        # while an old waiter still holds the stale one)
+        os.close(lock_fd)
 
 
 class env_overlay:

@@ -253,7 +253,15 @@ def num_params(cfg: LlamaConfig) -> int:
     return V * d + L * per_layer + d + d * V
 
 
-def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
-    """Training FLOPs/token (fwd+bwd ≈ 6·params + attention term)."""
+def flops_per_token(cfg: LlamaConfig, seq_len: int, causal_computed: bool = False) -> float:
+    """Training FLOPs/token (fwd+bwd ≈ 6·params + attention term).
+
+    The default counts the full 12·L·d·T attention term (the standard MFU
+    convention). `causal_computed=True` halves it — the flash kernel skips
+    blocks strictly above the causal diagonal, so that's the FLOPs the
+    chip actually executes; useful as an honest companion number at long
+    context where attention dominates."""
     attn = 12 * cfg.n_layers * cfg.d_model * seq_len  # qk^T + pv fwd+bwd
+    if causal_computed:
+        attn /= 2
     return 6 * num_params(cfg) + attn

@@ -75,9 +75,12 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *, scale, cau
     def _():
         l_safe = jnp.where(l_s[:] == 0.0, 1.0, l_s[:])
         o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
-        # lse broadcast across a 128-lane tile (TPU block tiling forbids a
-        # bare [bq] vector output); caller slices lane 0
-        lse_ref[0] = jnp.broadcast_to(m_s[:] + jnp.log(l_safe), (bq, 128))
+        # lse rides as a compact (1, bq) lane vector — the [bq, 1] sublane
+        # column transposed into lanes (vs a 128-lane broadcast tile,
+        # which costs 128x the HBM traffic for the same data). The output
+        # is (BH, nq, 1, bq) so the block equals the trailing array dims
+        # (TPU lowering requires (8,128)-divisible or dim-equal blocks).
+        lse_ref[0, 0] = jnp.transpose(m_s[:] + jnp.log(l_safe), (1, 0))
 
 
 def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret):
@@ -108,11 +111,11 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b, i, j: (b, i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, T, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, nq, 1, bq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
@@ -122,7 +125,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         interpret=interpret,
     )(qr, kr, vr)
     o = o.reshape(B, H, T, D).transpose(0, 2, 1, 3)
-    lse = lse[:, :, 0].reshape(B, H, T).transpose(0, 2, 1)  # [B, T, H]
+    lse = lse.reshape(B, H, T).transpose(0, 2, 1)  # [B, T, H] (from (BH, nq, 1, bq))
     return o, lse
 
 
@@ -151,8 +154,9 @@ def _fa_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dl_ref, k_ref, v_ref,
         do = do_ref[0]                                     # [bq, D] bf16
         k = k_ref[0]                                       # [bk, D] bf16
         v = v_ref[0]                                       # [bk, D] bf16
-        lse = lse_ref[0][:, :1]                            # [bq, 1] f32
-        delta = dl_ref[0][:, :1]                           # [bq, 1] f32
+        # compact (1, bq) lane vectors -> [bq, 1] sublane columns
+        lse = jnp.transpose(lse_ref[0, 0], (1, 0))         # [bq, 1] f32
+        delta = jnp.transpose(dl_ref[0, 0], (1, 0))        # [bq, 1] f32
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                          # [bq, bk]
@@ -201,8 +205,8 @@ def _fa_bwd_dq_kernel(q_ref, do_ref, lse_ref, dl_ref, k_ref, v_ref,
         do = do_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        lse = lse_ref[0][:, :1]
-        delta = dl_ref[0][:, :1]
+        lse = jnp.transpose(lse_ref[0, 0], (1, 0))
+        delta = jnp.transpose(dl_ref[0, 0], (1, 0))
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -239,14 +243,13 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
     dor = do.transpose(0, 2, 1, 3).reshape(B * H, T, D)
     kr = kf.transpose(0, 2, 1, 3).reshape(B * H, S, D)
     vr = vf.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    # lse arrives [B, T, H]; delta = rowsum(do * o). Both ride as
-    # (BH, T, 128)-tiled f32 (TPU tiling wants a 128 lane dim; kernels
-    # read lane 0)
-    lse_r = lse.transpose(0, 2, 1).reshape(B * H, T)
+    # lse arrives [B, T, H]; delta = rowsum(do * o). Both ride as compact
+    # (BH, nq, 1, bq) f32 — (1, bq) lane-vector blocks transposed to
+    # sublane columns inside the kernels (a 128-lane broadcast tile would
+    # cost 128x the HBM traffic for the same per-row scalars)
+    lse_t = lse.transpose(0, 2, 1).reshape(B * H, nq, 1, bq)
     delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(axis=-1)  # [B,T,H]
-    delta_r = delta.transpose(0, 2, 1).reshape(B * H, T)
-    lse_t = jnp.broadcast_to(lse_r[:, :, None], (B * H, T, 128))
-    delta_t = jnp.broadcast_to(delta_r[:, :, None], (B * H, T, 128))
+    delta_t = delta.transpose(0, 2, 1).reshape(B * H, nq, 1, bq)
 
     dkdv = functools.partial(
         _fa_bwd_dkdv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq
@@ -257,8 +260,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),    # q
             pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),    # do
-            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),  # lse
-            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),  # delta
+            pl.BlockSpec((1, 1, 1, bq), lambda b, j, i: (b, i, 0, 0)),  # lse
+            pl.BlockSpec((1, 1, 1, bq), lambda b, j, i: (b, i, 0, 0)),  # delta
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),    # k
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),    # v
         ],
@@ -285,8 +288,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b, i, j: (b, i, 0, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b, i, j: (b, i, 0, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
         ],

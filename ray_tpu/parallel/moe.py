@@ -100,7 +100,7 @@ def expert_parallel_moe(mesh, x, gate_w, expert_fn, expert_params, capacity_fact
     """shard_map wrapper: x replicated/batch-sharded; expert_params sharded
     on `ep` along their leading expert dim."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     fn = functools.partial(
         moe_layer, axis_name=axis_name, capacity_factor=capacity_factor
@@ -111,6 +111,6 @@ def expert_parallel_moe(mesh, x, gate_w, expert_fn, expert_params, capacity_fact
         mesh=mesh,
         in_specs=(P(), P(), P(axis_name)),
         out_specs=(P(), P()),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(mapped)(x, gate_w, expert_params)

@@ -73,7 +73,7 @@ def pipelined(mesh, stage_fn, all_stage_params, x, num_microbatches: int, axis_n
     """shard_map wrapper. all_stage_params: pytree with leading dim P
     (one slice per stage, sharded on `pp`). x: [B, ...] global batch."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     B = x.shape[0]
     assert B % num_microbatches == 0
@@ -89,7 +89,7 @@ def pipelined(mesh, stage_fn, all_stage_params, x, num_microbatches: int, axis_n
         mesh=mesh,
         in_specs=(P(axis_name), P()),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
     out = jax.jit(mapped)(all_stage_params, xm)
     return out.reshape(B, *out.shape[2:])

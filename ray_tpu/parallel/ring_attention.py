@@ -136,7 +136,7 @@ def sequence_parallel_attention(
     """shard_map wrapper: q/k/v are global arrays sharded on `sp` along
     the sequence axis; returns the global output with the same sharding."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec = P(None, axis_name, None, None)
 
@@ -149,5 +149,5 @@ def sequence_parallel_attention(
     else:
         raise ValueError(f"unknown mode {mode}")
 
-    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
+    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return jax.jit(mapped)(q, k, v)
