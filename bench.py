@@ -436,6 +436,39 @@ def bench_tpu_train(extra):
         except Exception as e:
             log(f"[bench] 1B bench skipped: {e}")
 
+        # MoE config: top-1-gated experts through the same dispatch math
+        # the ep axis uses (single chip = dense dispatch, no all_to_all);
+        # exercises the gating/einsum path the multichip dryrun shards
+        try:
+            cfgm = LlamaConfig.nano_tpu(moe_experts=8, d_ff=2048, n_layers=8)
+            initm, stepm, shardm, _ = build_sharded_train_step(cfgm, mesh, strategy="dp")
+            statem = initm(jax.random.PRNGKey(0))
+            Bm, Tm = 8, 2048
+            tokm = jax.random.randint(jax.random.PRNGKey(5), (Bm, Tm + 1), 0, cfgm.vocab_size)
+            batchm = shardm({"tokens": tokm})
+            for _ in range(3):
+                statem, mm = stepm(statem, batchm)
+            float(mm["loss"])
+
+            def runm(n):
+                nonlocal statem
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    statem, mm = stepm(statem, batchm)
+                _ = float(mm["loss"])
+                return time.perf_counter() - t0
+
+            dtm = (runm(8) - runm(2)) / 6
+            extra["train_moe_ms_per_step"] = round(dtm * 1e3, 1)
+            extra["train_moe_tok_per_s_chip"] = round(Bm * Tm / dtm, 0)
+            log(
+                f"[bench] llama-nano MoE (8 experts) train: {dtm * 1e3:.1f} ms/step, "
+                f"{Bm * Tm / dtm:,.0f} tok/s/chip"
+            )
+            del statem, batchm
+        except Exception as e:
+            log(f"[bench] MoE bench skipped: {e}")
+
         # inference: KV-cache decode throughput on the same model
         try:
             import functools

@@ -49,12 +49,26 @@ class _Cell:
     (most pipelined results arrive before anyone waits — an Event per
     call was measurable on the fan-out hot path)."""
 
-    __slots__ = ("env", "event", "waiters")
+    __slots__ = ("env", "event", "waiters", "groups")
 
     def __init__(self):
         self.env = None
         self.event: Optional[threading.Event] = None
         self.waiters: List[asyncio.Future] = []
+        self.groups: Optional[List["_GetGroup"]] = None  # multi-ref get countdowns
+
+
+class _GetGroup:
+    """One get([many refs]) call's shared countdown: ONE futex wait for
+    the whole batch instead of an Event round trip per still-pending ref
+    (a thousand-ref fan-out get was paying a thousand futex wake/waits).
+    `remaining` is only mutated under the owning CoreWorker's store lock."""
+
+    __slots__ = ("remaining", "event")
+
+    def __init__(self):
+        self.remaining = 0
+        self.event = threading.Event()
 
 
 def _env_inline(data: bytes):
@@ -131,10 +145,10 @@ class CoreWorker:
         self._pin_registered: set = set()
         self._dir_free_pending: List[bytes] = []
         self._owned_flush_scheduled = False
-        # producer-side handoff pins: (deadline, floor, buf) released by
-        # the gc loop once the owner has surely pinned — never before the
-        # floor, even under pressure (see put_serialized_to_shm)
-        self._handoff_pins: List[Tuple[float, float, Any]] = []
+        # producer-side handoff pins: oid -> (deadline, floor, buf),
+        # released when the owner ACKS its pin ("pins.ack"); the deadline
+        # is a dead-owner backstop (see put_serialized_to_shm)
+        self._handoff_pins: Dict[bytes, Tuple[float, float, Any]] = {}
         # task-event buffer: direct-path task transitions accumulate here
         # and flush to the GCS on a timer (reference: TaskEventBuffer,
         # src/ray/core_worker/task_event_buffer.h:206)
@@ -645,7 +659,7 @@ class CoreWorker:
             pending = oid in self._pending
             if pending:
                 self._dropped.add(oid)
-            self._store.pop(oid, None)
+            env = self._store.pop(oid, None)
             self._owned.discard(oid)
             self._lineage.pop(oid, None)
             self._drop_ref_holds(oid)
@@ -653,13 +667,19 @@ class CoreWorker:
         if buf is not None and not buf.try_release():
             with self._store_lock:
                 self._release_retry.append(buf)  # numpy views still live
-        if not pending and self._shm is not None:
+        # inline results never touched the arena: skip the C-library
+        # delete (it was a measurable per-ref cost on fan-out gets).
+        # env None means we can't rule out an arena entry — stay safe.
+        if not pending and self._shm is not None and (env is None or env.get("k") == "s"):
             try:
                 self._shm.delete(oid)
             except Exception:
                 pass
         # opportunistic sweep of parked pins whose views have since died
-        self._sweep_release_retry()
+        # (lock-free emptiness probe: a missed append is swept by the
+        # next free / gc tick)
+        if self._release_retry:
+            self._sweep_release_retry()
 
     def shutdown(self):
         if self._closed:
@@ -706,8 +726,8 @@ class CoreWorker:
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._loop_thread.join(timeout=5)
         with self._store_lock:
-            pins, self._handoff_pins = self._handoff_pins, []
-        for *_, buf in pins:
+            pins, self._handoff_pins = self._handoff_pins, {}
+        for *_, buf in pins.values():
             try:
                 buf.release()
             except Exception:
@@ -758,10 +778,19 @@ class CoreWorker:
     # ----------------------------------------------- incoming (peer-to-peer)
     async def _handle_peer(self, method: str, data, conn):
         if method == "task.result":
+            shm_acks = []
             for item in data["results"]:
-                self._deliver(bytes(item["oid"]), item["env"])
+                oid = bytes(item["oid"])
+                self._deliver(oid, item["env"])
+                if isinstance(item["env"], dict) and item["env"].get("k") == "s":
+                    shm_acks.append(oid)
             if data.get("task_id"):
                 self._record_lineage(data["task_id"])
+            if shm_acks:
+                self._loop.create_task(conn.push("pins.ack", {"oids": shm_acks}))
+            return True
+        if method == "pins.ack":
+            self.release_handoff_pins([bytes(o) for o in data["oids"]])
             return True
         if method == "owner.resolve":
             return await self._serve_owner_resolve(data)
@@ -894,6 +923,12 @@ class CoreWorker:
                 cell = self._pending.pop(oid, None)
                 if cell is not None:
                     cell.env = env
+                    if cell.groups:
+                        for g in cell.groups:
+                            g.remaining -= 1
+                            if g.remaining <= 0:
+                                g.event.set()
+                        cell.groups = None
                     wake.append(cell)
         for oid, env in pin:
             self._pin_owned(oid, env)
@@ -929,10 +964,18 @@ class CoreWorker:
             self._store[oid] = env
             self._attach_ref_holds(oid, env)
             cell = self._pending.pop(oid, None)
+            if cell is not None:
+                cell.env = env
+                if cell.groups:
+                    # group countdown mutates under the store lock only
+                    for g in cell.groups:
+                        g.remaining -= 1
+                        if g.remaining <= 0:
+                            g.event.set()
+                    cell.groups = None
         if env.get("k") == "s" and oid in self._owned:
             self._pin_owned(oid, env)
         if cell is not None:
-            cell.env = env
             if cell.event is not None:
                 cell.event.set()
             for fut in cell.waiters:
@@ -1019,24 +1062,22 @@ class CoreWorker:
         producer threads append concurrently with gc-loop and
         pressure-path sweeps; an unlocked rebind drops or double-releases
         pins)."""
+        real_now = time.monotonic()
+        now = real_now + early_by
+        drop: List[Any] = []
         with self._store_lock:
             if not self._handoff_pins:
                 return
-            items, self._handoff_pins = self._handoff_pins, []
-        real_now = time.monotonic()
-        now = real_now + early_by
-        keep: List[Tuple[float, float, Any]] = []
-        for deadline, floor, buf in items:
-            # the floor is a hard minimum grace: pressure sweeps (early_by
-            # > 0) may not release a pin before the owner's delivery pin
-            # has had one reply round trip to land
-            if deadline <= now and floor <= real_now:
-                buf.release()
-            else:
-                keep.append((deadline, floor, buf))
-        if keep:
-            with self._store_lock:
-                self._handoff_pins.extend(keep)
+            for oid in list(self._handoff_pins):
+                deadline, floor, buf = self._handoff_pins[oid]
+                # the floor is a hard minimum grace: pressure sweeps
+                # (early_by > 0) may not release a pin before the owner's
+                # delivery pin has had one reply round trip to land
+                if deadline <= now and floor <= real_now:
+                    del self._handoff_pins[oid]
+                    drop.append(buf)
+        for buf in drop:
+            buf.release()
 
     def _create_with_gc(self, oid: bytes, total: int):
         from ray_tpu.exceptions import ObjectStoreFullError
@@ -1078,8 +1119,10 @@ class CoreWorker:
 
         self._loop.call_soon_threadsafe(lambda: self._loop.create_task(_send()))
 
-    def put_serialized_to_shm(self, oid: bytes, pickled, buffers) -> Dict[str, Any]:
-        """Write an already-serialized value into the node arena; returns env."""
+    def put_serialized_to_shm(self, oid: bytes, pickled, buffers, handoff: bool = True) -> Dict[str, Any]:
+        """Write an already-serialized value into the node arena; returns
+        env. `handoff=False` when the CALLER pins synchronously right
+        after (local promotions) — no cross-process handoff window."""
         total = serialization.serialized_size(pickled, buffers)
         try:
             buf = self._create_with_gc(oid, total)
@@ -1123,20 +1166,52 @@ class CoreWorker:
         serialization.write_to(buf, pickled, buffers)
         buf.release()  # view only; seal below drops the creator refcount
         self._shm.seal(oid)
-        # HANDOFF pin: take a REAL store ref for a short grace — between
-        # seal (which drops the creator refcount) and the owner pinning on
-        # delivery, the entry would be refcount-0 and an eviction burst in
-        # that window destroys a result nobody has seen yet. The gc loop
-        # releases expired handoffs (the owner's pin lands within a reply
-        # round trip — ms — so a short grace suffices; a long one would
-        # itself pin production-rate × grace worth of arena).
-        hbuf = self._shm.get(oid, timeout_ms=0)
-        if hbuf is not None:
-            _hnow = time.monotonic()
-            with self._store_lock:
-                self._handoff_pins.append((_hnow + 0.5, _hnow + 0.2, hbuf))
+        if handoff:
+            # HANDOFF pin: take a REAL store ref until the OWNER ACKS its
+            # pin ("pins.ack" push after delivery) — between seal (which
+            # drops the creator refcount) and the owner pinning, the entry
+            # is refcount-0 and an eviction burst destroys a result nobody
+            # has seen yet. A fixed grace is NOT enough: a slow batch's
+            # early-pushed results sat far longer than any reasonable
+            # grace on a loaded owner, and the loss surfaced as
+            # ObjectLostError with the producing task still in flight.
+            # The deadline is only a backstop for owners that died.
+            hbuf = self._shm.get(oid, timeout_ms=0)
+            if hbuf is not None:
+                _hnow = time.monotonic()
+                with self._store_lock:
+                    old = self._handoff_pins.pop(oid, None)
+                    self._handoff_pins[oid] = (_hnow + 60.0, _hnow + 0.2, hbuf)
+                if old is not None:
+                    old[2].release()
         self._call(self._gcs.request("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": total}))
         return _env_shm(self.node_id, total)
+
+    def _ack_shm_results(self, conn, oids, envs):
+        """Loop-side: tell the producer its shm results are pinned here so
+        it drops the handoff refs (fire-and-forget; the 60s backstop
+        covers a lost ack)."""
+        shm = [
+            bytes(o) for o, e in zip(oids, envs)
+            if isinstance(e, dict) and e.get("k") == "s"
+        ]
+        if shm:
+            self._loop.create_task(conn.push("pins.ack", {"oids": shm}))
+
+    def release_handoff_pins(self, oids):
+        """Owner acked its pin on these results: drop the producer-side
+        handoff refs (callable from any thread)."""
+        drop = []
+        with self._store_lock:
+            for oid in oids:
+                item = self._handoff_pins.pop(oid, None)
+                if item is not None:
+                    drop.append(item[2])
+        for buf in drop:
+            try:
+                buf.release()
+            except Exception:
+                pass
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         envs = self._call(self._aget_envs([r.binary() for r in refs], timeout))
@@ -1373,6 +1448,7 @@ class CoreWorker:
         oids = [r.binary() for r in refs]
         envs: List[Optional[Dict[str, Any]]] = [None] * len(oids)
         slow: List[int] = []
+        pending_cells: List[Tuple[int, bytes, _Cell]] = []
         deadline = None if timeout is None else time.monotonic() + timeout
         for i, oid in enumerate(oids):
             env = self._store.get(oid)
@@ -1381,6 +1457,14 @@ class CoreWorker:
                 continue
             cell = self._pending.get(oid)
             if cell is not None:
+                pending_cells.append((i, oid, cell))
+            else:
+                slow.append(i)
+        if pending_cells:
+            if len(pending_cells) == 1:
+                # single pending ref: the per-cell lazy event (the 1:1
+                # sync actor-call hot path)
+                i, oid, cell = pending_cells[0]
                 ev = self._cell_event(oid, cell)
                 if ev is not None:
                     remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
@@ -1388,7 +1472,27 @@ class CoreWorker:
                         raise exceptions.GetTimeoutError(f"get timed out on {oid.hex()}")
                 envs[i] = cell.env if cell.env is not None else self._store.get(oid)
             else:
-                slow.append(i)
+                # multi-ref get: ONE shared countdown event for the whole
+                # batch (vs a futex wake/wait round trip per ref)
+                grp = _GetGroup()
+                n_undone = 0
+                with self._store_lock:
+                    for i, oid, cell in pending_cells:
+                        if cell.env is not None or oid in self._store:
+                            continue  # delivered while we scanned
+                        if cell.groups is None:
+                            cell.groups = []
+                        cell.groups.append(grp)
+                        n_undone += 1
+                    grp.remaining = n_undone
+                if n_undone:
+                    remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                    if not grp.event.wait(remaining):
+                        raise exceptions.GetTimeoutError(
+                            f"get timed out with {grp.remaining} of {len(oids)} refs pending"
+                        )
+                for i, oid, cell in pending_cells:
+                    envs[i] = cell.env if cell.env is not None else self._store.get(oid)
         if slow:
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
             resolved = self._call(self._aget_envs([oids[i] for i in slow], remaining))
@@ -1527,6 +1631,8 @@ class CoreWorker:
         """Top-level ObjectRefs are passed by reference (resolved to values
         by the executor); everything else is serialized inline or via shm
         (reference: inline-small-args in dependency_resolver.cc)."""
+        if not args and not kwargs:
+            return {"a": [], "kw": {}}  # no-arg fan-out fast path
         nested: List[bytes] = []
         packed = []
         for a in args:
@@ -1559,17 +1665,20 @@ class CoreWorker:
         total = serialization.serialized_size(pickled, buffers)
         if total <= RayConfig.object_store_inline_max_bytes or self._shm is None:
             return {"v": serialization.to_wire(pickled, buffers)}
-        # large arg → promote to an owned shm object, pass by ref
+        # large arg → promote to an owned shm object, pass by ref. _owned
+        # BEFORE _deliver: _deliver's pin check is `oid in self._owned`,
+        # and with handoff=False that pin is the ONLY thing keeping the
+        # sealed entry alive.
         oid = new_id()
-        env = self.put_serialized_to_shm(oid, pickled, buffers)
-        self._deliver(oid, env)
         with self._store_lock:
             self._owned.add(oid)
-            self._gcs_registered.add(oid)  # add_location created the record
+            self._gcs_registered.add(oid)  # add_location creates the record
+        env = self.put_serialized_to_shm(oid, pickled, buffers, handoff=False)
+        self._deliver(oid, env)
         return {"r": oid}
 
-    def unpack_args(self, packed: Dict[str, Any]):
-        if not packed["a"] and not packed["kw"]:
+    def unpack_args(self, packed: Optional[Dict[str, Any]]):
+        if packed is None or (not packed["a"] and not packed["kw"]):
             return (), {}
         args = [self._unpack_one(p) for p in packed["a"]]
         kwargs = {k: self._unpack_one(p) for k, p in packed["kw"].items()}
@@ -1594,11 +1703,11 @@ class CoreWorker:
         max_retries: Optional[int] = None,
         scheduling: Optional[Dict[str, Any]] = None,
     ) -> List[ObjectRef]:
-        # one urandom read yields the task id and (single-return case) the
-        # return oid — syscalls are visible at fan-out submission rates
-        rnd = os.urandom(16 * (1 + num_returns))
-        task_id = hex_id(rnd[:16])
-        returns = [rnd[16 * (i + 1) : 16 * (i + 2)] for i in range(num_returns)]
+        # ids come from the THREAD-LOCAL urandom pool in ids.new_id():
+        # submit runs on arbitrary user threads concurrently, and an
+        # instance-level pool offset would race and hand out identical ids
+        task_id = hex_id(new_id())
+        returns = [new_id() for _ in range(num_returns)]
         spec = {
             "task_id": task_id,
             "fn_id": fn_id,
@@ -1616,8 +1725,10 @@ class CoreWorker:
         if tracing.should_trace():
             spec["trace"] = tracing.submission_context(name)
         self._register_returns(returns)
-        self._pin_args(task_id, spec["args"])
-        self._submitted[spec["task_id"]] = {"spec": spec, "retries_left": spec.get("max_retries", 0)}
+        packed = spec["args"]
+        if packed.get("hr") or packed.get("nr"):
+            self._pin_args(task_id, packed)
+        self._submitted[task_id] = {"spec": spec, "retries_left": spec["max_retries"]}
         if self._direct_eligible(spec):
             deps = (
                 [
@@ -1897,31 +2008,15 @@ class CoreWorker:
                     if not batch:
                         break
                     try:
-                        # slim wire copy: the executor only needs these keys
-                        # (resources/max_retries/owner_addr are owner-side
-                        # bookkeeping; the full spec stays in _submitted for
-                        # retries and the GCS fallback)
-                        wire = [
-                            {
-                                "task_id": s["task_id"],
-                                "fn_id": s["fn_id"],
-                                "name": s["name"],
-                                "args": s["args"],
-                                "returns": s["returns"],
-                                "job_id": s["job_id"],
-                                **(
-                                    {"runtime_env": s["runtime_env"]}
-                                    if s.get("runtime_env")
-                                    else {}
-                                ),
-                                **({"trace": s["trace"]} if s.get("trace") else {}),
-                            }
-                            for s in batch
-                        ]
+                        # specs go over the wire AS-IS: the executor ignores
+                        # the few owner-side keys (resources/max_retries/
+                        # owner_addr), and the ~100 extra msgpack bytes are
+                        # cheaper than rebuilding a slim dict per spec at
+                        # fan-out rates
                         if len(batch) == 1:
-                            fut = await conn.request_send("call.task", {"spec": wire[0]})
+                            fut = await conn.request_send("call.task", {"spec": batch[0]})
                         else:
-                            fut = await conn.request_send("call.tasks", {"specs": wire})
+                            fut = await conn.request_send("call.tasks", {"specs": batch})
                     except (protocol.ConnectionLost, OSError):
                         await _worker_died(batch)
                         return  # lease is dead (raylet reap credits the resources)
@@ -1950,6 +2045,7 @@ class CoreWorker:
                     self._direct_inflight.pop(spec["task_id"], None)
                     self._record_lineage(spec["task_id"])
                 self._deliver_batch(reply["o"], reply["e"])
+                self._ack_shm_results(conn, reply["o"], reply["e"])
                 # direct tasks never touch the GCS scheduler — report their
                 # events so the timeline / state API still sees them. Events
                 # are BUFFERED and flushed on a timer (reference:
@@ -2089,17 +2185,20 @@ class CoreWorker:
         # actor_id (the sender loop is per-actor), no caller/job_id (the
         # actor worker is bound to its job at creation; reference: direct
         # actor transport needs only method+args+seq)
-        spec = {
-            "method": method_name,
-            "args": self.pack_args(args, kwargs),
-            "returns": returns,
-        }
+        # empty args stay OFF the wire entirely (the no-arg ping is the
+        # fan-out hot shape; consumers treat a missing "args" as empty)
+        if args or kwargs:
+            packed = self.pack_args(args, kwargs)
+            spec = {"method": method_name, "args": packed, "returns": returns}
+            if packed.get("hr") or packed.get("nr"):
+                self._pin_args(returns[0], packed)
+        else:
+            spec = {"method": method_name, "returns": returns}
         from ray_tpu.util import tracing
 
         if tracing.should_trace():
             spec["trace"] = tracing.submission_context(method_name)
         self._register_returns(returns)
-        self._pin_args(returns[0], spec["args"])
         # fire-and-forget enqueue: the caller holds refs whose cells are
         # already waitable; the loop does the sending
         self._post(lambda: self._enqueue_actor_call(actor_id, spec, max_task_retries))
@@ -2181,12 +2280,13 @@ class CoreWorker:
             # deadlock. Such calls go out as singletons — their worker-side
             # resolve then overlaps with earlier in-flight replies.
             def _has_pending_dep(s):
-                if not s["args"].get("hr"):
+                a = s.get("args")
+                if a is None or not a.get("hr"):
                     return False  # ref-free call (the common case): no scan
                 with self._store_lock:
                     return any(
                         "r" in p and bytes(p["r"]) in self._pending and bytes(p["r"]) in self._owned
-                        for p in list(s["args"]["a"]) + list(s["args"]["kw"].values())
+                        for p in list(a["a"]) + list(a["kw"].values())
                     )
 
             batch = [q.popleft()]
@@ -2213,11 +2313,11 @@ class CoreWorker:
             # deliver on the reply callback; only failures spawn a task
             # (a Task per call costs more than the delivery itself)
             reply_fut.add_done_callback(
-                lambda fut, b=batch: self._on_actor_reply(actor_id, b, fut)
+                lambda fut, b=batch, c=conn: self._on_actor_reply(actor_id, b, fut, c)
             )
         self._actor_senders.pop(actor_id, None)
 
-    def _on_actor_reply(self, actor_id: str, batch, fut):
+    def _on_actor_reply(self, actor_id: str, batch, fut, conn=None):
         exc = fut.exception() if not fut.cancelled() else None
         if fut.cancelled() or exc is not None:
             loop = asyncio.get_running_loop()
@@ -2228,6 +2328,8 @@ class CoreWorker:
         for spec, _ in batch:
             self._unpin_args(spec["returns"][0])
         self._deliver_batch(r["o"], r["e"])
+        if conn is not None:
+            self._ack_shm_results(conn, r["o"], r["e"])
 
     async def _actor_reply_failed(self, actor_id: str, spec, retries_left: int, exc):
         if isinstance(exc, protocol.RpcError):

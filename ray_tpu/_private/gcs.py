@@ -1506,8 +1506,15 @@ class GcsServer:
         """Resource demand + node utilization for the autoscaler
         (reference: GcsAutoscalerStateManager feeding autoscaler v2 —
         gcs_autoscaler_state_manager.cc)."""
+        pending = [dict(s.get("resources") or {}) for s in self.pending_tasks]
+        # a PENDING placement group is gang demand: every unplaced bundle
+        # is a shape the autoscaler must provision for (reference:
+        # GcsAutoscalerStateManager reports placement-group demand too)
+        for rec in self.placement_groups.values():
+            if rec.get("state") == "PENDING":
+                pending.extend(dict(b) for b in rec["bundles"])
         return {
-            "pending_shapes": [dict(s.get("resources") or {}) for s in self.pending_tasks],
+            "pending_shapes": pending,
             "nodes": [
                 {
                     "node_id": n["node_id"],

@@ -29,6 +29,7 @@ from ray_tpu import exceptions
 from ray_tpu._private import protocol, serialization
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.core_worker import CoreWorker, _env_err, _env_inline
+from ray_tpu._private.runtime_env import ensure_job_env, env_overlay
 
 logger = logging.getLogger("ray_tpu.worker")
 
@@ -74,7 +75,7 @@ class Executor:
         # per-caller ordering state
         self._order: Dict[str, Dict[str, Any]] = {}
         self._current_task_id: Optional[str] = None
-        self._current_thread: Optional[threading.Thread] = None
+        self._current_thread_ident: Optional[int] = None
         self._cancelled: set = set()
         self._coro_cache: Dict[str, bool] = {}  # method/fn_id -> iscoroutinefunction
         self._exec_prof = None
@@ -102,7 +103,7 @@ class Executor:
 
                 job_env = ensure_job_env(self.core, self.core.session_dir, spec.get("job_id"))
                 cls = self.core.load_function(spec["fn_id"])
-                args, kwargs = self.core.unpack_args(spec["args"])
+                args, kwargs = self.core.unpack_args(spec.get("args"))
                 # an actor worker is bound to its job for life: its env
                 # may apply permanently (constructors often capture cwd)
                 env_overlay(
@@ -251,10 +252,10 @@ class Executor:
                     for oid, env in zip(spec["returns"], envs):
                         self.core._deliver(bytes(oid), env)
                         staged.append(bytes(oid))
-                    unsent.extend(
-                        {"oid": oid, "env": env}
-                        for oid, env in zip(spec["returns"], envs)
-                    )
+                    # (returns, envs) pairs, NOT per-result dicts — the
+                    # wire dicts are only built if a slow spec actually
+                    # triggers an early push (never on the fast path)
+                    unsent.append((spec["returns"], envs))
                     if conn is not None and i < last and t1 - t0 > 0.002:
                         # SLOW spec in a batch: stream EVERYTHING finished
                         # so far (this spec AND any fast predecessors still
@@ -265,7 +266,12 @@ class Executor:
                         # task's result. The batch reply re-delivers them
                         # later, an idempotent no-op. Fast bursts (the
                         # fan-out hot path) never hit this branch.
-                        results, unsent = unsent, []
+                        pending, unsent = unsent, []
+                        results = [
+                            {"oid": oid, "env": env}
+                            for rets, es in pending
+                            for oid, env in zip(rets, es)
+                        ]
                         loop.call_soon_threadsafe(
                             lambda r=results: loop.create_task(
                                 self._push_early(conn, r)
@@ -313,12 +319,11 @@ class Executor:
         try:
             # the task that owns the pool thread is the one cancel() can
             # interrupt, so both fields are set HERE, on that thread
-            self._current_thread = threading.current_thread()
+            self._current_thread_ident = threading.get_ident()
             self._current_task_id = tid
             try:
                 if tid in self._cancelled:
                     raise exceptions.TaskCancelledError(spec.get("name", ""))
-                from ray_tpu._private.runtime_env import ensure_job_env, env_overlay
 
                 # job runtime_env: packages materialize once (lazily at
                 # the job's first task — prestarted workers boot before
@@ -347,7 +352,7 @@ class Executor:
                         fn = getattr(self.actor_instance, spec["method"])
                 else:
                     fn = self.core.load_function(spec["fn_id"])
-                args, kwargs = self.core.unpack_args(spec["args"])
+                args, kwargs = self.core.unpack_args(spec.get("args"))
                 merged_env = {**job_env.get("env_vars", {}),
                               **((spec.get("runtime_env") or {}).get("env_vars") or {})}
 
@@ -383,7 +388,7 @@ class Executor:
                     return [self._bad_arity_env(spec, name)] * len(spec["returns"])
                 return [self._to_env_sync(oid, v) for oid, v in zip(spec["returns"], values)]
             finally:
-                self._current_thread = None
+                self._current_thread_ident = None
                 self._current_task_id = None
         except (Exception, KeyboardInterrupt) as e:
             # KeyboardInterrupt is how cancel() interrupts the user thread
@@ -425,7 +430,7 @@ class Executor:
                 import contextlib as _cl
 
                 span_cm = _cl.nullcontext()
-            args, kwargs = await loop.run_in_executor(self.pool, self.core.unpack_args, spec["args"])
+            args, kwargs = await loop.run_in_executor(self.pool, self.core.unpack_args, spec.get("args"))
             fn = getattr(self.actor_instance, spec["method"])
             cfut = asyncio.run_coroutine_threadsafe(
                 _traced_coro(span_cm, fn, args, kwargs), self._ensure_user_loop()
@@ -536,14 +541,12 @@ class Executor:
 
     def cancel(self, task_id: str, force: bool):
         self._cancelled.add(task_id)
-        if task_id == self._current_task_id and self._current_thread is not None:
+        if task_id == self._current_task_id and self._current_thread_ident is not None:
             # cooperative interrupt of the running user thread (reference:
             # ray cancels running normal tasks by raising KeyboardInterrupt)
-            tid = self._current_thread.ident
-            if tid is not None:
-                ctypes.pythonapi.PyThreadState_SetAsyncExc(
-                    ctypes.c_long(tid), ctypes.py_object(KeyboardInterrupt)
-                )
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(self._current_thread_ident), ctypes.py_object(KeyboardInterrupt)
+            )
 
 
 async def _amain():
@@ -672,8 +675,26 @@ def main():
         # cProfile may be active per process — RAY_TPU_PROFILE_WHAT picks
         # the thread (main | ioloop | exec).
         import cProfile
+        import threading as _th
 
         globals()["_worker_profile"] = prof = cProfile.Profile()
+
+        def _dump_loop():
+            # workers die by SIGKILL at cluster stop: dump on a timer
+            while True:
+                _time.sleep(3.0)
+                prof.disable()
+                try:
+                    prof.dump_stats(
+                        os.environ["RAY_TPU_PROFILE_DIR"] + f"/worker-{os.getpid()}.prof"
+                    )
+                except Exception:
+                    pass
+                prof.enable()
+
+        import time as _time
+
+        _th.Thread(target=_dump_loop, daemon=True).start()
         prof.enable()
     asyncio.run(_amain())
 

@@ -31,6 +31,12 @@ class NodeProvider:
     def non_terminated_nodes(self) -> List[str]:
         raise NotImplementedError
 
+    def cluster_node_ids(self, provider_node_id: str) -> List[str]:
+        """Cluster node ids backing one provider node. A TPU-slice
+        provider returns one id per slice HOST; single-host providers
+        return [provider_node_id]."""
+        return [provider_node_id]
+
 
 class LocalNodeProvider(NodeProvider):
     """Fake multi-node provider: "launching a node" boots another raylet
@@ -127,13 +133,17 @@ class StandardAutoscaler:
             if not placed:
                 unmet.append(shape)
         if unmet:
-            # nodes-to-add: pack unmet demand into copies of the worker type
-            per_node = dict(self.worker_node_config.get("resources", {}))
-            per_node.setdefault("CPU", float(self.worker_node_config.get("num_cpus", 2)))
-            # infeasible shapes (won't fit even an EMPTY worker node) must
+            # nodes-to-add: pack unmet demand into copies of the worker
+            # type. One provider node may be a SLICE of several hosts
+            # (hosts_per_node > 1): a gang of per-host bundles then packs
+            # onto the hosts one launch provides.
+            per_host = dict(self.worker_node_config.get("resources", {}))
+            per_host.setdefault("CPU", float(self.worker_node_config.get("num_cpus", 2)))
+            hosts_per_node = int(self.worker_node_config.get("hosts_per_node", 1))
+            # infeasible shapes (won't fit even an EMPTY worker host) must
             # not drive launches — the reference skips them too, or the
             # loop would churn useless nodes forever
-            unmet = [s for s in unmet if _fits(s, per_node)]
+            unmet = [s for s in unmet if _fits(s, per_host)]
             needed = 0
             cap: List[Dict[str, float]] = []
             for shape in unmet:
@@ -146,10 +156,10 @@ class StandardAutoscaler:
                         break
                 if not placed:
                     needed += 1
-                    fresh = dict(per_node)
+                    fresh = [dict(per_host) for _ in range(hosts_per_node)]
                     for k, v in shape.items():
-                        fresh[k] = fresh.get(k, 0.0) - v
-                    cap.append(fresh)
+                        fresh[0][k] = fresh[0].get(k, 0.0) - v
+                    cap.extend(fresh)
             for _ in range(needed):
                 if len(workers) >= self.max_workers:
                     break
@@ -158,13 +168,18 @@ class StandardAutoscaler:
                 launched += 1
 
         # -------- scale down: fully-idle provider nodes past the timeout
+        # (a slice is idle only when EVERY host is)
         now = time.monotonic()
         by_id = {n["node_id"]: n for n in load["nodes"]}
         for nid in list(workers):
-            n = by_id.get(nid)
-            if n is None:
+            hosts = [by_id.get(h) for h in self.provider.cluster_node_ids(nid)]
+            hosts = [h for h in hosts if h is not None]
+            if not hosts:
                 continue
-            idle = n["state"] == "ALIVE" and n["resources_available"] == n["resources_total"]
+            idle = all(
+                h["state"] == "ALIVE" and h["resources_available"] == h["resources_total"]
+                for h in hosts
+            )
             if idle and not load["pending_shapes"]:
                 since = self._idle_since.setdefault(nid, now)
                 if now - since >= self.idle_timeout_s and len(workers) > self.min_workers:

@@ -54,6 +54,9 @@ _NODE_TYPE_SCHEMA = {
     "resources": dict,
     "labels": dict,
     "object_store_memory": int,
+    # TPU-slice node groups: one provider "node" = a whole slice
+    "slice_type": str,
+    "hosts_per_node": int,
 }
 
 
@@ -71,6 +74,10 @@ def validate_config(config: Dict[str, Any]) -> Dict[str, Any]:
             raise ValueError(f"cluster config {key!r} must be {typ}")
     provider = config.get("provider") or {}
     ptype = provider.get("type", "local")
+    if ptype != "local" and ptype not in _PROVIDERS:
+        from ray_tpu.autoscaler import tpu_slices
+
+        tpu_slices.register_slice_providers()  # built-ins register lazily
     if ptype != "local" and ptype not in _PROVIDERS:
         raise ValueError(
             f"unknown provider type {ptype!r} (registered: local, "
@@ -115,9 +122,9 @@ class ClusterLauncher:
     autoscaler monitor then keeps node groups between min/max)."""
 
     def __init__(self, config: Dict[str, Any]):
-        from ray_tpu.autoscaler import LocalNodeProvider, StandardAutoscaler
-        from ray_tpu.cluster_utils import Cluster
+        from ray_tpu.autoscaler import tpu_slices
 
+        tpu_slices.register_slice_providers()  # make fake_slices resolvable
         self.config = validate_config(dict(config))
         self.cluster: Optional[Any] = None
         self.autoscalers: Dict[str, Any] = {}
@@ -148,7 +155,17 @@ class ClusterLauncher:
         for tname, tcfg in types.items():
             if tname == head_type:
                 continue
-            res = tcfg.get("resources", {})
+            res = dict(tcfg.get("resources", {}))
+            hosts_per_node = int(tcfg.get("hosts_per_node", 1))
+            if tcfg.get("slice_type"):
+                # slice node group: per-HOST resources + host count derive
+                # from the slice shape unless overridden
+                from ray_tpu.autoscaler.tpu_slices import slice_shape
+
+                info = slice_shape(tcfg["slice_type"])
+                hosts_per_node = int(tcfg.get("hosts_per_node", info["hosts"]))
+                res.setdefault("TPU", float(info["chips_per_host"]))
+                res.setdefault("CPU", 2.0)
             if self._provider_factory is not None:
                 provider = self._provider_factory(self.cluster, tname, tcfg)
             else:
@@ -167,7 +184,10 @@ class ClusterLauncher:
                 # the demand bin-packer must model what a NEW node of this
                 # group provides, or TPU/large-CPU demand is judged
                 # infeasible and scale-up never fires
-                worker_node_config={"resources": {k: float(v) for k, v in res.items()}},
+                worker_node_config={
+                    "resources": {k: float(v) for k, v in res.items()},
+                    "hosts_per_node": hosts_per_node,
+                },
             )
             asc.update()  # bring up min_workers now
             self.autoscalers[tname] = asc

@@ -6,6 +6,15 @@ in-flight blocks (reference: data/_internal/execution/streaming_executor.py).
 """
 from ray_tpu.data.dataset import DataIterator, Dataset  # noqa: F401
 from ray_tpu.data import preprocessors  # noqa: F401
+from ray_tpu.data.grouped import (  # noqa: F401
+    AggregateFn,
+    Count,
+    Max,
+    Mean,
+    Min,
+    Std,
+    Sum,
+)
 from ray_tpu.data.read_api import (  # noqa: F401
     from_arrow,
     from_items,

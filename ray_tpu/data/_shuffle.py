@@ -48,9 +48,40 @@ def _map_partition(blk, ops, mode: str, M: int, arg, seed: int):
         ends = np.arange(n) + start
         idx = np.minimum(ends // per, M - 1)
         parts = tuple(blk.take(np.nonzero(idx == j)[0]) for j in range(M))
+    elif mode == "hash":
+        # deterministic key hash (Python's str hash is seed-randomized
+        # PER PROCESS — using it would scatter one key across reducers)
+        key = arg
+        idx = _hash_partition_index(blk.column(key), M)
+        parts = tuple(blk.take(np.nonzero(idx == j)[0]) for j in range(M))
     else:
         raise ValueError(f"unknown partition mode {mode}")
     return parts
+
+
+def _hash_partition_index(col, M: int):
+    """Deterministic partition index per value — same value → same
+    partition in EVERY mapper process (groupby correctness depends on
+    it). Numeric columns hash arithmetically; strings/bytes via crc32."""
+    import numpy as np
+    import pyarrow as pa
+
+    if pa.types.is_integer(col.type):
+        return (np.asarray(col).astype(np.int64) % M + M) % M
+    if pa.types.is_floating(col.type):
+        v = np.asarray(col)
+        iv = v.view(np.int64) if v.dtype == np.float64 else v.astype(np.float64).view(np.int64)
+        return ((iv % M) + M) % M
+    import zlib
+
+    vals = col.to_pylist()
+    out = np.empty(len(vals), np.int64)
+    for i, v in enumerate(vals):
+        if isinstance(v, bytes):
+            out[i] = zlib.crc32(v)
+        else:
+            out[i] = zlib.crc32(str(v).encode())
+    return out % M
 
 
 @ray_tpu.remote

@@ -1,7 +1,18 @@
-"""GroupedData — groupby aggregations (reference: python/ray/data/grouped_data.py)."""
+"""GroupedData — distributed groupby aggregations.
+
+Reference surface: python/ray/data/grouped_data.py + aggregate.py
+(AggregateFn / Count / Sum / Min / Max / Mean / Std). Execution model is
+the reference's shuffle-based aggregation (reference:
+python/ray/data/_internal/planner/exchange/): blocks hash-partition by
+key through the existing 2-stage shuffle (ray_tpu/data/_shuffle.py), and
+each partition aggregates in its own task with pyarrow. Every key lands
+wholly in one partition, so there is no driver-side merge — the driver
+only ever holds refs, never row data (the previous implementation
+ray_tpu.get() the whole dataset onto the driver).
+"""
 from __future__ import annotations
 
-from typing import Callable, Dict, List
+from typing import Any, Callable, Dict, List, Optional
 
 import pyarrow as pa
 
@@ -9,50 +20,181 @@ import ray_tpu
 from ray_tpu.data import block as B
 
 
+class AggregateFn:
+    """Composable aggregation (reference: python/ray/data/aggregate.py).
+
+    init(key) -> accumulator; accumulate_row(acc, row) -> acc;
+    merge(acc1, acc2) -> acc; finalize(acc) -> value. Rows are plain
+    dicts. Built-ins (Count/Sum/...) instead set `arrow_agg` and run on
+    pyarrow's native group_by kernels — orders of magnitude faster."""
+
+    arrow_agg: Optional[tuple] = None  # (column, pyarrow agg name)
+
+    def __init__(
+        self,
+        init: Callable[[Any], Any],
+        accumulate_row: Callable[[Any, Dict], Any],
+        merge: Callable[[Any, Any], Any],
+        finalize: Optional[Callable[[Any], Any]] = None,
+        name: str = "agg",
+    ):
+        self.init = init
+        self.accumulate_row = accumulate_row
+        self.merge = merge
+        self.finalize = finalize or (lambda a: a)
+        self.name = name
+
+
+def _arrow_builtin(agg: str, suffix: Optional[str] = None):
+    class _Builtin(AggregateFn):
+        def __init__(self, on: Optional[str] = None):
+            self.on = on
+            self.arrow_agg = (on, agg)
+            self.name = f"{agg}({on})" if on else agg
+
+    _Builtin.__name__ = (suffix or agg).capitalize()
+    return _Builtin
+
+
+Count = _arrow_builtin("count")
+Sum = _arrow_builtin("sum")
+Min = _arrow_builtin("min")
+Max = _arrow_builtin("max")
+Mean = _arrow_builtin("mean")
+Std = _arrow_builtin("stddev", "std")
+
+
+@ray_tpu.remote
+def _agg_partition(key: str, aggs, *parts) -> pa.Table:
+    """One hash partition: concat its parts and aggregate with pyarrow
+    (builtins) and/or a python fold (custom AggregateFn)."""
+    live = [p for p in parts if p is not None and p.num_rows]
+    if not live:
+        return B.to_block([])
+    tbl = B.concat_blocks(live)
+    arrow_specs = []
+    custom: List[AggregateFn] = []
+    for a in aggs:
+        if a.arrow_agg is not None:
+            col, op = a.arrow_agg
+            arrow_specs.append((col or key, op))
+        else:
+            custom.append(a)
+    out = tbl.group_by(key).aggregate(arrow_specs) if arrow_specs else None
+    if custom:
+        import pyarrow.compute as pc
+
+        keys = tbl.column(key).unique()
+        rows: List[Dict] = []
+        for k in keys.to_pylist():
+            sub = tbl.filter(pc.equal(tbl.column(key), pa.scalar(k, tbl.column(key).type)))
+            row = {key: k}
+            for a in custom:
+                acc = a.init(k)
+                for r in sub.to_pylist():
+                    acc = a.accumulate_row(acc, r)
+                row[a.name] = a.finalize(acc)
+            rows.append(row)
+        custom_tbl = B.to_block(rows)
+        if out is None:
+            out = custom_tbl
+        else:
+            # join builtin + custom results on the key (both carry every
+            # key in this partition exactly once)
+            out = out.join(custom_tbl, keys=key)
+    return out
+
+
+@ray_tpu.remote
+def _map_groups_partition(key: str, fn, *parts):
+    """One hash partition of map_groups: run fn per key group, in a task."""
+    import pyarrow.compute as pc
+
+    live = [p for p in parts if p is not None and p.num_rows]
+    rows: List[Dict] = []
+    if not live:
+        return B.to_block(rows)
+    tbl = B.concat_blocks(live)
+    for k in tbl.column(key).unique().to_pylist():
+        sub = tbl.filter(pc.equal(tbl.column(key), pa.scalar(k, tbl.column(key).type)))
+        result = fn(sub.to_pylist())
+        rows.extend(result if isinstance(result, list) else [result])
+    return B.to_block(rows)
+
+
 class GroupedData:
     def __init__(self, ds, key: str):
         self._ds = ds
         self._key = key
 
-    def _table(self) -> pa.Table:
-        return B.concat_blocks(ray_tpu.get(self._ds._execute_refs()))
+    def _partitions(self) -> List[List[Any]]:
+        """Hash-partition the dataset's blocks by key: returns M lists of
+        part refs (partition j = part j of every mapper). All movement is
+        worker-to-worker through the object store."""
+        from ray_tpu.data._shuffle import _map_partition, _reduce_merge
 
-    def _agg(self, agg: str, on: str):
+        refs = self._ds._execute_refs()
+        M = max(1, min(len(refs), 64))
+        parts = []
+        for i, ref in enumerate(refs):
+            out = _map_partition.options(num_returns=M).remote(
+                ref, None, "hash", M, self._key, i
+            )
+            parts.append(out if isinstance(out, list) else [out])
+        # hierarchical fan-in (same shape as shuffle_exchange) so one
+        # aggregate task never takes more than 64 inputs
+        _GROUP = 64
+        while len(parts) > _GROUP:
+            grouped = []
+            for g in range(0, len(parts), _GROUP):
+                chunk = parts[g : g + _GROUP]
+                grouped.append([
+                    _reduce_merge.remote(None, None, 0, *(p[j] for p in chunk))
+                    for j in range(M)
+                ])
+            parts = grouped
+        return [[p[j] for p in parts] for j in range(M)]
+
+    def aggregate(self, *aggs: AggregateFn):
+        """Composable distributed aggregation: one task per hash
+        partition; the result Dataset holds one block ref per partition."""
         from ray_tpu.data.dataset import Dataset
 
-        tbl = self._table()
-        out = tbl.group_by(self._key).aggregate([(on, agg)])
-        return Dataset([ray_tpu.put(out)])
+        out = [
+            _agg_partition.remote(self._key, list(aggs), *partition)
+            for partition in self._partitions()
+        ]
+        return Dataset(out)
+
+    def _builtin(self, ctor, on: Optional[str] = None):
+        return self.aggregate(ctor(on) if on else ctor())
 
     def count(self):
-        from ray_tpu.data.dataset import Dataset
-
-        tbl = self._table()
-        out = tbl.group_by(self._key).aggregate([(self._key, "count")])
-        return Dataset([ray_tpu.put(out)])
+        return self._builtin(Count, self._key)
 
     def sum(self, on: str):
-        return self._agg("sum", on)
+        return self._builtin(Sum, on)
 
     def mean(self, on: str):
-        return self._agg("mean", on)
+        return self._builtin(Mean, on)
 
     def min(self, on: str):
-        return self._agg("min", on)
+        return self._builtin(Min, on)
 
     def max(self, on: str):
-        return self._agg("max", on)
+        return self._builtin(Max, on)
+
+    def std(self, on: str):
+        return self._builtin(Std, on)
 
     def map_groups(self, fn: Callable):
+        """fn(list-of-row-dicts) -> row dict or list of row dicts, run as
+        one task per hash partition (each key's rows are colocated)."""
         from ray_tpu.data.dataset import Dataset
 
-        tbl = self._table()
-        keys = tbl.column(self._key).unique().to_pylist()
-        rows: List[Dict] = []
-        import pyarrow.compute as pc
-
-        for k in keys:
-            sub = tbl.filter(pc.equal(tbl.column(self._key), k))
-            result = fn(sub.to_pylist())
-            rows.extend(result if isinstance(result, list) else [result])
-        return Dataset([ray_tpu.put(B.to_block(rows))])
+        fn_ref = ray_tpu.put(fn)
+        out = [
+            _map_groups_partition.remote(self._key, fn_ref, *partition)
+            for partition in self._partitions()
+        ]
+        return Dataset(out)

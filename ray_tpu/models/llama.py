@@ -42,6 +42,14 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"  # auto | flash | blockwise | ring
     remat: bool = True
+    # MoE: >0 replaces each layer's SwiGLU with moe_experts experts
+    # (top-1 gated, capacity-bounded; experts shard on the `ep` mesh axis)
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    # pipeline parallelism: microbatches for the GPipe schedule when the
+    # mesh has a pp axis and the strategy maps the layer stack onto it
+    pp_microbatches: int = 4
 
     @property
     def head_dim(self) -> int:
@@ -94,18 +102,26 @@ def init_params(key, cfg: LlamaConfig) -> Dict[str, Any]:
     layer_keys = jax.random.split(k_layers, cfg.n_layers)
 
     def make_layer(k):
-        ks = jax.random.split(k, 7)
-        return {
+        ks = jax.random.split(k, 8)
+        out = {
             "attn_norm": jnp.ones((d,), cfg.dtype),
             "wq": dense(ks[0], (d, h * hd), d),
             "wk": dense(ks[1], (d, kvh * hd), d),
             "wv": dense(ks[2], (d, kvh * hd), d),
             "wo": dense(ks[3], (h * hd, d), h * hd),
             "mlp_norm": jnp.ones((d,), cfg.dtype),
-            "w_gate": dense(ks[4], (d, f), d),
-            "w_up": dense(ks[5], (d, f), d),
-            "w_down": dense(ks[6], (f, d), f),
         }
+        if cfg.moe_experts:
+            E = cfg.moe_experts
+            out["gate_w"] = dense(ks[7], (d, E), d)
+            out["moe_gate"] = dense(ks[4], (E, d, f), d)
+            out["moe_up"] = dense(ks[5], (E, d, f), d)
+            out["moe_down"] = dense(ks[6], (E, f, d), f)
+        else:
+            out["w_gate"] = dense(ks[4], (d, f), d)
+            out["w_up"] = dense(ks[5], (d, f), d)
+            out["w_down"] = dense(ks[6], (f, d), f)
+        return out
 
     # stacked layers: one leading layer axis → lax.scan over layers keeps
     # compile time O(1) in depth (XLA-friendly; no Python layer loop)
@@ -119,26 +135,39 @@ def init_params(key, cfg: LlamaConfig) -> Dict[str, Any]:
 
 
 def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
-    """Twin tree of logical axis names (layer axis is None — stacked)."""
+    """Twin tree of logical axis names. The stacked-layer axis is
+    "layer" — unsharded by default, mapped to `pp` under pipeline
+    parallelism so each stage holds its own slice."""
+    layers: Dict[str, Any] = {
+        "attn_norm": ("layer", "embed"),
+        "wq": ("layer", "embed", "heads"),
+        "wk": ("layer", "embed", "kv"),
+        "wv": ("layer", "embed", "kv"),
+        "wo": ("layer", "heads", "embed"),
+        "mlp_norm": ("layer", "embed"),
+    }
+    if cfg.moe_experts:
+        layers.update({
+            "gate_w": ("layer", "embed", None),
+            "moe_gate": ("layer", "expert", "embed", "mlp"),
+            "moe_up": ("layer", "expert", "embed", "mlp"),
+            "moe_down": ("layer", "expert", "mlp", "embed"),
+        })
+    else:
+        layers.update({
+            "w_gate": ("layer", "embed", "mlp"),
+            "w_up": ("layer", "embed", "mlp"),
+            "w_down": ("layer", "mlp", "embed"),
+        })
     return {
         "embed": ("vocab", "embed"),
-        "layers": {
-            "attn_norm": (None, "embed"),
-            "wq": (None, "embed", "heads"),
-            "wk": (None, "embed", "kv"),
-            "wv": (None, "embed", "kv"),
-            "wo": (None, "heads", "embed"),
-            "mlp_norm": (None, "embed"),
-            "w_gate": (None, "embed", "mlp"),
-            "w_up": (None, "embed", "mlp"),
-            "w_down": (None, "mlp", "embed"),
-        },
+        "layers": layers,
         "final_norm": ("embed",),
         "lm_head": ("embed", "vocab"),
     }
 
 
-def _attention(q, k, v, cfg: LlamaConfig, mesh=None):
+def _attention(q, k, v, cfg: LlamaConfig, mesh=None, rules=None):
     impl = cfg.attn_impl
     if impl == "auto":
         # TPU default is the pallas flash kernel whenever the shapes
@@ -155,12 +184,34 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh=None):
 
         return flash_attention(q, k, v, True)
     if impl == "ring":
-        from ray_tpu.parallel.ring_attention import ring_attention
+        sp_axes = rules.rules.get("seq") if rules is not None else None
+        if mesh is not None and sp_axes and all(mesh.shape[a] > 1 for a in sp_axes):
+            # REAL sequence parallelism inside the jitted program: the
+            # shard_map inlines, KV shards rotate over the sp ring via
+            # ppermute while each device attends its local Q shard
+            import functools as _ft
 
-        # inside jit with sp-sharded activations this must be called via
-        # shard_map by the caller; plain path falls back to blockwise
+            from jax import shard_map
+            from ray_tpu.parallel.ring_attention import ring_attention
+
+            qspec = rules.spec(("batch", "seq", "act_heads", None))
+            kvspec = rules.spec(("batch", "seq", None, None))
+            fn = _ft.partial(ring_attention, axis_name=sp_axes[0], causal=True,
+                             block_size=min(512, q.shape[1]))
+            mapped = shard_map(
+                fn, mesh=mesh, in_specs=(qspec, kvspec, kvspec),
+                out_specs=qspec, check_vma=False,
+            )
+            return mapped(q, k, v)
+        # no sp axis on the mesh: same math, one device
         return blockwise_attention(q, k, v, True, 512)
     return blockwise_attention(q, k, v, True, min(512, q.shape[1]))
+
+
+def _moe_expert_fn(pe, t):
+    """One expert's SwiGLU on its token queue [C, D]."""
+    gate = jax.nn.silu((t @ pe["w_gate"]).astype(jnp.float32)).astype(t.dtype)
+    return (gate * (t @ pe["w_up"])) @ pe["w_down"]
 
 
 def _layer_fn(layer, x, cos_sin, cfg: LlamaConfig, mesh=None, rules=None):
@@ -184,20 +235,40 @@ def _layer_fn(layer, x, cos_sin, cfg: LlamaConfig, mesh=None, rules=None):
     k = cstr(k, ("batch", "seq", None, None))
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    o = _attention(q, k, v, cfg, mesh)
+    o = _attention(q, k, v, cfg, mesh, rules)
     o = o.reshape(B, T, h * hd) @ layer["wo"]
     x = x + cstr(o, ("batch", "seq", "act_embed"))
 
-    # mlp block (SwiGLU)
+    # mlp block: SwiGLU, or top-1-gated MoE when cfg.moe_experts
     m = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
-    gate = jax.nn.silu((m @ layer["w_gate"]).astype(jnp.float32)).astype(cfg.dtype)
-    up = m @ layer["w_up"]
-    down = (gate * up) @ layer["w_down"]
-    return x + cstr(down, ("batch", "seq", "act_embed"))
+    if cfg.moe_experts:
+        from ray_tpu.parallel.moe import expert_parallel_moe_inline, moe_layer_dense
+
+        moe_params = {
+            "w_gate": layer["moe_gate"], "w_up": layer["moe_up"], "w_down": layer["moe_down"],
+        }
+        ep_axes = rules.rules.get("expert") if rules is not None else None
+        if mesh is not None and ep_axes and all(mesh.shape[a] > 1 for a in ep_axes):
+            down, aux = expert_parallel_moe_inline(
+                mesh, m, layer["gate_w"], _moe_expert_fn, moe_params,
+                capacity_factor=cfg.moe_capacity_factor, axis_name=ep_axes[0],
+                x_spec=rules.spec(("batch", "seq", "act_embed")),
+            )
+        else:
+            down, aux = moe_layer_dense(
+                m, layer["gate_w"], _moe_expert_fn, moe_params,
+                capacity_factor=cfg.moe_capacity_factor,
+            )
+    else:
+        gate = jax.nn.silu((m @ layer["w_gate"]).astype(jnp.float32)).astype(cfg.dtype)
+        up = m @ layer["w_up"]
+        down = (gate * up) @ layer["w_down"]
+        aux = jnp.zeros((), jnp.float32)
+    return x + cstr(down, ("batch", "seq", "act_embed")), aux
 
 
-def forward(params, tokens, cfg: LlamaConfig, mesh=None, rules=None):
-    """tokens: [B, T] int32 → logits [B, T, vocab] (fp32)."""
+def forward_with_aux(params, tokens, cfg: LlamaConfig, mesh=None, rules=None):
+    """tokens: [B, T] int32 → (logits [B, T, vocab] fp32, moe aux loss)."""
     B, T = tokens.shape
     embed = params["embed"]
     if mesh is not None and rules is not None:
@@ -215,17 +286,64 @@ def forward(params, tokens, cfg: LlamaConfig, mesh=None, rules=None):
         x = constraint(x, mesh, ("batch", "seq", "act_embed"), rules)
     cos, sin = rope_frequencies(cfg.head_dim, T, cfg.rope_theta)
 
-    layer_fn = functools.partial(_layer_fn, cfg=cfg, mesh=mesh, rules=rules)
-    if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+    pp_axes = rules.rules.get("layer") if rules is not None else None
+    if mesh is not None and pp_axes and all(mesh.shape[a] > 1 for a in pp_axes):
+        # pipeline parallelism: the stacked layer axis is sharded on pp;
+        # the GPipe microbatch schedule runs as one collective program
+        # (ray_tpu/parallel/pipeline.py). The stage fn sees mesh=None —
+        # inside shard_map the activations are already local shards.
+        if cfg.moe_experts:
+            raise NotImplementedError("pp+ep in one llama is not supported yet")
+        from jax.sharding import PartitionSpec as P
+        from ray_tpu.parallel.pipeline import pipelined
 
-    def scan_body(x, layer):
-        return layer_fn(layer, x, (cos, sin)), None
+        pp = 1
+        for a in pp_axes:
+            pp *= mesh.shape[a]
+        assert cfg.n_layers % pp == 0, f"{cfg.n_layers} layers not divisible by pp={pp}"
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        def stage_fn(stage_layers, xm):
+            lf = functools.partial(_layer_fn, cfg=cfg)
+            if cfg.remat:
+                lf = jax.checkpoint(lf)
+
+            def body(x, layer):
+                x2, _aux = lf(layer, x, (cos, sin))
+                return x2, None
+
+            out, _ = jax.lax.scan(body, xm, stage_layers)
+            return out
+
+        layers_pp = jax.tree.map(
+            lambda p: p.reshape(pp, cfg.n_layers // pp, *p.shape[1:]), params["layers"]
+        )
+        batch_entry = rules.spec(("batch",))[0]
+        x = pipelined(
+            mesh, stage_fn, layers_pp, x, cfg.pp_microbatches, axis_name=pp_axes[0],
+            data_spec=P(None, batch_entry),
+        )
+        aux = jnp.zeros((), jnp.float32)
+    else:
+        layer_fn = functools.partial(_layer_fn, cfg=cfg, mesh=mesh, rules=rules)
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn)
+
+        def scan_body(carry, layer):
+            x, aux = carry
+            x, aux_l = layer_fn(layer, x, (cos, sin))
+            return (x, aux + aux_l), None
+
+        (x, aux), _ = jax.lax.scan(
+            scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+        )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = (x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32))
-    return logits
+    return logits, aux
+
+
+def forward(params, tokens, cfg: LlamaConfig, mesh=None, rules=None):
+    """tokens: [B, T] int32 → logits [B, T, vocab] (fp32)."""
+    return forward_with_aux(params, tokens, cfg, mesh, rules)[0]
 
 
 def loss_fn(params, batch, cfg: LlamaConfig, mesh=None, rules=None):
@@ -236,13 +354,17 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh=None, rules=None):
         targets = batch["tokens"][:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
-    logits = forward(params, inputs, cfg, mesh, rules)
+    logits, aux = forward_with_aux(params, inputs, cfg, mesh, rules)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
     if mask is not None:
-        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
-    return nll.mean()
+        ce = (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    else:
+        ce = nll.mean()
+    if cfg.moe_experts:
+        return ce + cfg.moe_aux_weight * aux
+    return ce
 
 
 def num_params(cfg: LlamaConfig) -> int:

@@ -78,22 +78,86 @@ def moe_layer(
 
     # dispatch: [T, E, C] x [T, D] -> [E, C, D]
     dispatched = jnp.einsum("tec,td->ecd", gate.dispatch_mask.astype(x.dtype), tokens)
-    # all_to_all over experts: [E, C, D] -> [ep, e_local, C, D] -> gather
-    dispatched = dispatched.reshape(ep, e_local, capacity, D)
-    # [ep, e_local, C, D] -> [e_local, ep, C, D]: device axis swapped for
-    # the per-source axis
-    received = jax.lax.all_to_all(dispatched, axis_name, split_axis=0, concat_axis=1, tiled=False)
-    received = received.reshape(e_local, ep * capacity, D)
+    # tiled all_to_all over experts (its transpose is the reverse tiled
+    # all_to_all, so autodiff is clean — the untiled form has a cotangent
+    # layout mismatch): [E, C, D] -> [e_local, ep*C, D], block j along the
+    # token axis holding device j's queue for each local expert
+    received = jax.lax.all_to_all(dispatched, axis_name, split_axis=0, concat_axis=1, tiled=True)
 
     # apply local experts (vmapped over the expert dim)
     outputs = jax.vmap(expert_fn)(expert_params, received)   # [e_local, ep*C, D]
 
-    outputs = outputs.reshape(e_local, ep, capacity, D)
-    returned = jax.lax.all_to_all(outputs, axis_name, split_axis=1, concat_axis=0, tiled=False)
-    returned = returned.reshape(E, capacity, D)
+    # reverse exchange: [e_local, ep*C, D] -> [E, C, D] in global expert order
+    returned = jax.lax.all_to_all(outputs, axis_name, split_axis=1, concat_axis=0, tiled=True)
 
     combined = jnp.einsum("tec,ecd->td", gate.combine_weights.astype(x.dtype), returned)
     return combined.reshape(orig_shape), gate.aux_loss
+
+
+def moe_layer_dense(x, gate_w, expert_fn, expert_params, capacity_factor: float = 1.25):
+    """Single-device MoE: IDENTICAL gating/dispatch math to moe_layer with
+    ep=1 and no collectives — the fallback when no `ep` mesh axis exists
+    (and the numerics reference for the expert-parallel path)."""
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    tokens = x.reshape(-1, D)
+    T = tokens.shape[0]
+    E = jax.tree.leaves(expert_params)[0].shape[0]
+    capacity = max(1, int(capacity_factor * T / E))
+
+    logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    gate = top1_gate(logits, capacity)
+    dispatched = jnp.einsum("tec,td->ecd", gate.dispatch_mask.astype(x.dtype), tokens)
+    outputs = jax.vmap(expert_fn)(expert_params, dispatched)       # [E, C, D]
+    combined = jnp.einsum("tec,ecd->td", gate.combine_weights.astype(x.dtype), outputs)
+    return combined.reshape(orig_shape), gate.aux_loss
+
+
+def expert_parallel_moe_inline(
+    mesh,
+    x,
+    gate_w,
+    expert_fn,
+    expert_params,
+    capacity_factor: float = 1.25,
+    axis_name: str = "ep",
+    x_spec=None,
+):
+    """EP MoE callable from INSIDE a jitted program (no inner jit): the
+    shard_map inlines into the surrounding GSPMD computation, so a model's
+    forward can drop this into its layer stack (llama MoE layers use it).
+
+    `x_spec` is the activations' PartitionSpec on the mesh (e.g.
+    P(('dp','fsdp'), None, None)); expert params ride sharded on
+    `axis_name` along their leading expert dim. The aux loss is pmeant
+    over every axis x is sharded on, so it leaves the shard_map truly
+    replicated."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    if x_spec is None:
+        x_spec = P()
+    batch_axes = tuple(
+        a for entry in x_spec if entry is not None
+        for a in ((entry,) if isinstance(entry, str) else tuple(entry))
+    )
+
+    def fn(x, gw, ps):
+        out, aux = moe_layer(
+            x, gw, expert_fn, ps, axis_name=axis_name, capacity_factor=capacity_factor
+        )
+        if batch_axes:
+            aux = jax.lax.pmean(aux, axis_name=batch_axes)
+        return out, aux
+
+    mapped = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(x_spec, P(), P(axis_name)),
+        out_specs=(x_spec, P()),
+        check_vma=False,
+    )
+    return mapped(x, gate_w, expert_params)
 
 
 def expert_parallel_moe(mesh, x, gate_w, expert_fn, expert_params, capacity_factor=1.25, axis_name="ep"):

@@ -69,15 +69,30 @@ def pipeline_apply(
     return outputs
 
 
-def pipelined(mesh, stage_fn, all_stage_params, x, num_microbatches: int, axis_name: str = "pp"):
+def pipelined(
+    mesh,
+    stage_fn,
+    all_stage_params,
+    x,
+    num_microbatches: int,
+    axis_name: str = "pp",
+    data_spec=None,
+):
     """shard_map wrapper. all_stage_params: pytree with leading dim P
-    (one slice per stage, sharded on `pp`). x: [B, ...] global batch."""
+    (one slice per stage, sharded on `pp`). x: [B, ...] global batch.
+
+    `data_spec` optionally shards the microbatched input [M, mb, ...] on
+    OTHER mesh axes (e.g. P(None, 'dp', ...) for pp+dp) — the pipeline
+    then runs per data-parallel slice. Callable from inside jit (the
+    shard_map inlines into the surrounding program)."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
     B = x.shape[0]
     assert B % num_microbatches == 0
     xm = x.reshape(num_microbatches, B // num_microbatches, *x.shape[1:])
+    if data_spec is None:
+        data_spec = P()
 
     def inner(params_stage, xm):
         # params arrive with leading dim 1 (this stage's slice)
@@ -87,9 +102,11 @@ def pipelined(mesh, stage_fn, all_stage_params, x, num_microbatches: int, axis_n
     mapped = shard_map(
         inner,
         mesh=mesh,
-        in_specs=(P(axis_name), P()),
-        out_specs=P(),
+        in_specs=(P(axis_name), data_spec),
+        out_specs=data_spec,
         check_vma=False,
     )
+    # jit so the remat'd stage fn lowers even when called eagerly; under
+    # an outer jit this inlines into the surrounding program
     out = jax.jit(mapped)(all_stage_params, xm)
     return out.reshape(B, *out.shape[2:])

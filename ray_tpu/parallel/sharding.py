@@ -67,6 +67,7 @@ class LogicalAxisRules:
             "heads": None,
             "kv": None,
             "expert": None,
+            "layer": None,         # the stacked-layer axis; sharded under pp
         }
         s = set(strategy.split("+")) if strategy else set()
         if not s or s == {"dp"}:
@@ -82,6 +83,8 @@ class LogicalAxisRules:
             base["seq"] = ("sp",)
         if "ep" in s:
             base["expert"] = ("ep",)
+        if "pp" in s:
+            base["layer"] = ("pp",)
         unknown = s - {"dp", "fsdp", "tp", "sp", "ep", "pp"}
         if unknown:
             raise ValueError(f"unknown strategy components {unknown}")

@@ -85,3 +85,54 @@ def build_sharded_train_step(
         return jax.tree.map(lambda x: jax.device_put(x, batch_sharding), batch)
 
     return init_fn, step_fn, shard_batch, rules
+
+
+def default_mesh_for_strategy(strategy: str, n_devices: int) -> MeshSpec:
+    """Lay a strategy string onto n devices: each model-parallel axis
+    named in the strategy (tp/sp/ep/pp) gets degree 2; the data axis
+    (fsdp if named, else dp) absorbs the remainder. Pass an explicit
+    MeshSpec (ScalingConfig.mesh) for non-default degrees."""
+    parts = set(strategy.split("+")) if strategy else set()
+    degrees = {}
+    for ax in ("tp", "sp", "ep", "pp"):
+        if ax in parts:
+            degrees[ax] = 2
+    data_axis = "fsdp" if "fsdp" in parts else "dp"
+    degrees[data_axis] = -1  # absorb
+    return MeshSpec(**degrees).resolve(n_devices)
+
+
+def setup_sharded_training(
+    cfg,
+    strategy: Optional[str] = None,
+    mesh_spec=None,
+    devices=None,
+    model=None,
+    **step_kwargs,
+):
+    """Worker-loop entry: resolve the parallelism strategy (argument >
+    the trainer's ScalingConfig.strategy, which JaxTrainer exports as
+    RAY_TPU_TRAIN_STRATEGY > "fsdp"), build the mesh over this worker's
+    visible devices, and return (mesh, init_fn, step_fn, shard_batch,
+    rules).
+
+    Usage inside a JaxTrainer train loop::
+
+        mesh, init_fn, step_fn, shard_batch, _ = setup_sharded_training(cfg)
+        state = init_fn(jax.random.PRNGKey(0))
+        state, metrics = step_fn(state, shard_batch(batch))
+    """
+    import os
+
+    strategy = strategy or os.environ.get("RAY_TPU_TRAIN_STRATEGY") or "fsdp"
+    if devices is None:
+        devices = jax.devices()
+    if mesh_spec is None:
+        mesh_spec = default_mesh_for_strategy(strategy, len(devices))
+    elif isinstance(mesh_spec, dict):
+        mesh_spec = MeshSpec(**mesh_spec)
+    mesh = build_mesh(mesh_spec, devices)
+    init_fn, step_fn, shard_batch, rules = build_sharded_train_step(
+        cfg, mesh, strategy=strategy, model=model, **step_kwargs
+    )
+    return mesh, init_fn, step_fn, shard_batch, rules

@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu._private.ids import hex_id, new_id
 
-_enabled = False
+_enabled = os.environ.get("RAY_TPU_TRACING") == "1"
 _current_span: contextvars.ContextVar = contextvars.ContextVar("ray_tpu_span", default=None)
 
 
@@ -43,7 +43,9 @@ def disable() -> None:
 
 
 def is_enabled() -> bool:
-    return _enabled or os.environ.get("RAY_TPU_TRACING") == "1"
+    # the env var is captured at import: an os.environ read here sat on
+    # the per-submit hot path (visible at fan-out rates)
+    return _enabled
 
 
 def should_trace() -> bool:

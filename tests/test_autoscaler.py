@@ -51,3 +51,116 @@ def test_scale_up_on_demand_then_down_when_idle(small_cluster):
         time.sleep(1)
     assert terminated >= 1, "idle node never terminated"
     assert not provider.non_terminated_nodes()
+
+
+def test_tpu_slice_scale_up_gang_then_down(small_cluster):
+    """A pending v5e-8 gang (PG of 2 x {TPU:4} bundles) must launch ONE
+    fake slice (2 hosts with slice labels); after the gang finishes and
+    the slice idles out, the whole slice terminates together."""
+    from ray_tpu.autoscaler.tpu_slices import FakeSliceProvider
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    provider = FakeSliceProvider(small_cluster, slice_type="v5e-8", cpus_per_host=2)
+    autoscaler = StandardAutoscaler(
+        provider, min_workers=0, max_workers=2, idle_timeout_s=3.0,
+        worker_node_config={"resources": {"CPU": 2.0, "TPU": 4.0}, "hosts_per_node": 2},
+    )
+
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="SLICE_PACK")
+    assert not pg.wait(2), "gang should be infeasible before scale-up"
+    report = autoscaler.update()
+    assert report["launched"] == 1, f"expected exactly one slice launch, got {report}"
+    assert len(provider.non_terminated_nodes()) == 1
+    assert len(provider.cluster_node_ids(provider.non_terminated_nodes()[0])) == 2
+
+    assert pg.wait(60), "gang not placed on the new slice"
+    # the slice hosts carry slice labels the scheduler gangs on
+    from ray_tpu.util.state import list_nodes
+
+    labeled = [n for n in list_nodes() if (n.get("labels") or {}).get("tpu_slice_type") == "v5e-8"]
+    assert len(labeled) == 2
+
+    remove_placement_group(pg)
+    deadline = time.monotonic() + 60
+    terminated = 0
+    while time.monotonic() < deadline:
+        terminated += autoscaler.update()["terminated"]
+        if terminated >= 1 and not provider.non_terminated_nodes():
+            break
+        time.sleep(1)
+    assert terminated >= 1, "idle slice never terminated"
+    assert not provider.non_terminated_nodes()
+
+
+def test_gce_slice_provider_control_flow():
+    """GCE provider drives the injected API transport correctly (the
+    cloud path without a cloud): create -> endpoints bootstrapped with
+    slice labels, list reflects state, delete tears down."""
+    from ray_tpu.autoscaler.tpu_slices import GCETPUSliceProvider
+
+    calls = []
+
+    class FakeAPI:
+        def __init__(self):
+            self.nodes = {}
+
+        def create_tpu_node(self, name, accelerator_type, runtime_version, zone, project, metadata):
+            calls.append(("create", name, accelerator_type, zone))
+            self.nodes[name] = {"name": name, "state": "READY"}
+            return {"endpoints": [f"10.0.0.{i}" for i in range(2)]}
+
+        def delete_tpu_node(self, name, zone, project):
+            calls.append(("delete", name))
+            self.nodes.pop(name, None)
+
+        def list_tpu_nodes(self, zone, project):
+            return list(self.nodes.values())
+
+    booted = []
+
+    def bootstrap(endpoint, labels):
+        booted.append((endpoint, labels))
+        return f"node-{endpoint}"
+
+    api = FakeAPI()
+    p = GCETPUSliceProvider("v5e-8", project="proj", zone="us-central2-b", api=api, bootstrap=bootstrap)
+    name = p.create_node({})
+    assert calls[0][2] == "v5e-8"
+    assert len(booted) == 2
+    assert booted[0][1]["tpu_slice_type"] == "v5e-8"
+    assert booted[0][1]["tpu_worker_id"] == "0"
+    assert p.non_terminated_nodes() == [name]
+    assert p.cluster_node_ids(name) == ["node-10.0.0.0", "node-10.0.0.1"]
+    p.terminate_node(name)
+    assert p.non_terminated_nodes() == []
+
+
+def test_cluster_launcher_yaml_fake_slices(tmp_path):
+    """`ray_tpu up` YAML with a fake_slices provider: validates, builds
+    the slice autoscaler with per-host packing capacity."""
+    from ray_tpu.autoscaler.config import ClusterLauncher, load_config
+
+    cfg = load_config("""
+cluster_name: slice-test
+max_workers: 4
+idle_timeout_minutes: 1
+provider:
+  type: fake_slices
+available_node_types:
+  head:
+    resources: {CPU: 1}
+  v5e_slices:
+    min_workers: 0
+    max_workers: 2
+    slice_type: v5e-8
+head_node_type: head
+""")
+    assert cfg["available_node_types"]["v5e_slices"]["slice_type"] == "v5e-8"
+    launcher = ClusterLauncher(cfg)
+    try:
+        launcher.up()
+        asc = launcher.autoscalers["v5e_slices"]
+        assert asc.worker_node_config["hosts_per_node"] == 2
+        assert asc.worker_node_config["resources"]["TPU"] == 4.0
+    finally:
+        launcher.down()

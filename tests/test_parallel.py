@@ -153,3 +153,105 @@ def test_host_collective_group_in_actors(ray_start_regular):
     assert out == [6.0, 6.0, 6.0]
     for a in actors:
         ray_tpu.kill(a)
+
+
+# ---- llama-integrated parallelism: the sp/pp/ep axes exercised through
+# the REAL model + train-step path (not just the standalone kernels) ----
+
+def _tiny_batch():
+    import jax
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 65), 0, 512)
+    return {"tokens": tokens}
+
+
+def test_llama_ring_attention_sp_loss_matches_single_device():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig, init_params, loss_fn
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.parallel.sharding import LogicalAxisRules
+
+    batch = _tiny_batch()
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ref = float(loss_fn(params, batch, cfg))
+
+    cfg_sp = LlamaConfig.tiny(dtype=jnp.float32, attn_impl="ring")
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, sp=2), jax.devices()[:8])
+    rules = LogicalAxisRules.for_strategy("fsdp+sp")
+    got = float(jax.jit(lambda p, b: loss_fn(p, b, cfg_sp, mesh, rules))(params, batch))
+    assert abs(got - ref) < 1e-4
+
+
+def test_llama_pipeline_pp_loss_and_grads_match():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig, init_params, loss_fn
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.parallel.sharding import LogicalAxisRules
+
+    batch = _tiny_batch()
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, pp_microbatches=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ref = float(loss_fn(params, batch, cfg))
+
+    mesh = build_mesh(MeshSpec(pp=2, dp=4), jax.devices()[:8])
+    rules = LogicalAxisRules.for_strategy("pp+dp")
+    got = float(jax.jit(lambda p, b: loss_fn(p, b, cfg, mesh, rules))(params, batch))
+    assert abs(got - ref) < 1e-4
+
+    g_pp = jax.grad(lambda p: loss_fn(p, batch, cfg, mesh, rules))(params)
+    g_ref = jax.grad(lambda p: loss_fn(p, batch, cfg))(params)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_llama_moe_ep_matches_dense_dispatch():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig, init_params, loss_fn
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.parallel.sharding import LogicalAxisRules
+
+    batch = _tiny_batch()
+    tokens = batch["tokens"]
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, moe_experts=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    mesh = build_mesh(MeshSpec(ep=2, tp=2, dp=2), jax.devices()[:8])
+    rules = LogicalAxisRules.for_strategy("dp+tp+ep")
+    got = float(jax.jit(lambda p, b: loss_fn(p, b, cfg, mesh, rules))(params, batch))
+
+    # dense reference with the SAME per-dp-slice capacity: dp=2 splits the
+    # batch in half, so average the dense loss over the two halves
+    ref = float(np.mean([
+        float(loss_fn(params, {"tokens": tokens[:4]}, cfg)),
+        float(loss_fn(params, {"tokens": tokens[4:]}, cfg)),
+    ]))
+    assert abs(got - ref) < 1e-5
+
+    # grads flow through the all_to_all dispatch
+    g = jax.grad(lambda p: loss_fn(p, batch, cfg, mesh, rules))(params)
+    assert all(bool(jnp.all(jnp.isfinite(x))) for x in jax.tree.leaves(g))
+
+
+def test_setup_sharded_training_strategy_env(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.train import setup_sharded_training
+
+    monkeypatch.setenv("RAY_TPU_TRAIN_STRATEGY", "fsdp+sp")
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attn_impl="ring")
+    mesh, init_fn, step_fn, shard_batch, _ = setup_sharded_training(cfg)
+    assert dict(mesh.shape)["sp"] == 2 and dict(mesh.shape)["fsdp"] == 4
+    state = init_fn(jax.random.PRNGKey(0))
+    state, metrics = step_fn(state, shard_batch(_tiny_batch()))
+    assert float(metrics["loss"]) > 0
