@@ -139,6 +139,33 @@ def bench_runtime(extra):
     extra["multi_client_put_gib_per_s"] = round(mc_gib, 2)
     log(f"[bench] multi-client put bandwidth (2 clients): {mc_gib:.2f} GiB/s")
 
+    # device-array object path: jax.Array put+get through the arena
+    # (out-of-band host staging, device_put on decode) vs the host-numpy
+    # bandwidth above. cpu-device arrays: the tunneled TPU would measure
+    # the tunnel, not the object path.
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        cpu0 = jax.devices("cpu")[0]
+        n = 128 * 1024 * 1024 // 4
+        xa = jax.device_put(np.arange(n, dtype=np.float32), cpu0)
+        jax.block_until_ready(xa)
+        t0 = time.perf_counter()
+        jref = ray_tpu.put(xa)
+        dt_jput = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jback = ray_tpu.get(jref)
+        jax.block_until_ready(jback)
+        dt_jget = time.perf_counter() - t0
+        extra["jax_put_gib_per_s"] = round(0.125 / dt_jput, 2)
+        extra["jax_get_gib_per_s"] = round(0.125 / dt_jget, 2)
+        log(f"[bench] jax-array put/get (128 MiB): {0.125/dt_jput:.2f} / "
+            f"{0.125/dt_jget:.2f} GiB/s")
+        del xa, jback
+    except Exception as e:
+        log(f"[bench] jax-array object bench skipped: {e}")
+
     def best_of(k, fn, settle=1.0):
         best = 0.0
         for _ in range(k):

@@ -145,10 +145,10 @@ class CoreWorker:
         self._pin_registered: set = set()
         self._dir_free_pending: List[bytes] = []
         self._owned_flush_scheduled = False
-        # producer-side handoff pins: oid -> (deadline, floor, buf),
-        # released when the owner ACKS its pin ("pins.ack"); the deadline
-        # is a dead-owner backstop (see put_serialized_to_shm)
-        self._handoff_pins: Dict[bytes, Tuple[float, float, Any]] = {}
+        # producer-side handoff pins: oid -> (deadline, buf), released
+        # when the owner ACKS its pin ("pins.ack"); the deadline is a
+        # dead-owner backstop (see put_serialized_to_shm)
+        self._handoff_pins: Dict[bytes, Tuple[float, Any]] = {}
         # task-event buffer: direct-path task transitions accumulate here
         # and flush to the GCS on a timer (reference: TaskEventBuffer,
         # src/ray/core_worker/task_event_buffer.h:206)
@@ -1037,10 +1037,10 @@ class CoreWorker:
         and failing a put while dozens of release-eligible pins are queued
         would be a spurious ObjectStoreFullError."""
         self._drain_ref_events()
-        # under allocation pressure, shave the handoff grace down to its
-        # 0.2s floor — the owner's pin is normally in place within a
-        # reply round trip
-        self._sweep_handoff_pins(early_by=0.4)
+        # handoff pins are NOT shaved under pressure: an unacked result
+        # destroyed here is data loss (ObjectLostError with the producing
+        # task still in flight) — pressure relief is spilling's job
+        self._sweep_handoff_pins()
         self._sweep_release_retry()
 
     def _sweep_release_retry(self):
@@ -1057,23 +1057,18 @@ class CoreWorker:
             with self._store_lock:
                 self._release_retry.extend(survivors)
 
-    def _sweep_handoff_pins(self, early_by: float = 0.0):
-        """Swap-out under the store lock (same race as _sweep_release_retry:
-        producer threads append concurrently with gc-loop and
-        pressure-path sweeps; an unlocked rebind drops or double-releases
-        pins)."""
-        real_now = time.monotonic()
-        now = real_now + early_by
+    def _sweep_handoff_pins(self):
+        """Release pins whose dead-owner backstop deadline passed (the
+        normal release is the owner's pins.ack). Mutation under the store
+        lock: producer threads append concurrently with the gc loop."""
+        now = time.monotonic()
         drop: List[Any] = []
         with self._store_lock:
             if not self._handoff_pins:
                 return
             for oid in list(self._handoff_pins):
-                deadline, floor, buf = self._handoff_pins[oid]
-                # the floor is a hard minimum grace: pressure sweeps
-                # (early_by > 0) may not release a pin before the owner's
-                # delivery pin has had one reply round trip to land
-                if deadline <= now and floor <= real_now:
+                deadline, buf = self._handoff_pins[oid]
+                if deadline <= now:
                     del self._handoff_pins[oid]
                     drop.append(buf)
         for buf in drop:
@@ -1181,9 +1176,9 @@ class CoreWorker:
                 _hnow = time.monotonic()
                 with self._store_lock:
                     old = self._handoff_pins.pop(oid, None)
-                    self._handoff_pins[oid] = (_hnow + 60.0, _hnow + 0.2, hbuf)
+                    self._handoff_pins[oid] = (_hnow + 60.0, hbuf)
                 if old is not None:
-                    old[2].release()
+                    old[1].release()
         self._call(self._gcs.request("obj.add_location", {"oid": oid, "node_id": self.node_id, "size": total}))
         return _env_shm(self.node_id, total)
 
@@ -1206,7 +1201,7 @@ class CoreWorker:
             for oid in oids:
                 item = self._handoff_pins.pop(oid, None)
                 if item is not None:
-                    drop.append(item[2])
+                    drop.append(item[1])
         for buf in drop:
             try:
                 buf.release()

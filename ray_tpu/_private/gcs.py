@@ -1073,8 +1073,13 @@ class GcsServer:
         if rec["locations"]:
             if requester_node in rec["locations"]:
                 return {"status": "local", "size": rec["size"]}
-            # orchestrate a raylet-to-raylet transfer into the requester node
-            src = next((n for n in rec["locations"] if self.nodes.get(n, {}).get("state") == "ALIVE"), None)
+            # orchestrate a raylet-to-raylet transfer into the requester
+            # node; source chosen at random among replicas so an N-node
+            # broadcast fans out as a tree (late pullers hit fresh copies,
+            # not all the origin — reference: ObjectManager pull location
+            # selection, object_manager.h:130)
+            alive_srcs = [n for n in rec["locations"] if self.nodes.get(n, {}).get("state") == "ALIVE"]
+            src = random.choice(alive_srcs) if alive_srcs else None
             if src is None:
                 rec["locations"].clear()
             else:

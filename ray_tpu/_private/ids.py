@@ -17,6 +17,20 @@ ID_LENGTH = 16  # bytes
 _tls = threading.local()
 
 
+def _reset_pool_after_fork():
+    # a forked child inherits the parent's pool and offset and would mint
+    # IDENTICAL id streams (silent object aliasing); os.urandom re-seeds
+    # per process, so dropping the pool restores fork safety
+    try:
+        del _tls.pool
+        del _tls.off
+    except AttributeError:
+        pass
+
+
+os.register_at_fork(after_in_child=_reset_pool_after_fork)
+
+
 def new_id() -> bytes:
     # pooled urandom: slices of one 4 KiB read are as random as separate
     # reads, and every TRUNCATION of the id (socket names, log prefixes

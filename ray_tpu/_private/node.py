@@ -175,6 +175,18 @@ class NodeProcesses:
                 proc.wait(timeout=5)
             except Exception:
                 pass
+        # reap the arenas of raylets that died UNCLEANLY (SIGKILL, chaos,
+        # OOM): a raylet only unlinks its /dev/shm file in its own
+        # graceful path, so a session teardown must sweep its children's
+        # arenas or kill-tested runs leak host shm until the next init's
+        # stale-arena GC
+        for proc in self.procs:
+            for name in list(os.listdir("/dev/shm")):
+                if name.startswith(f"ray_tpu_{proc.pid}_"):
+                    try:
+                        os.unlink(os.path.join("/dev/shm", name))
+                    except OSError:
+                        pass
         self.procs.clear()
 
 

@@ -28,6 +28,42 @@ _BUF_HDR = struct.Struct("<Q")
 _ALIGN = 8
 
 
+def _rebuild_jax_array(buf, dtype: str, shape):
+    """Decode side of the device-array path: the host bytes are a
+    zero-copy view of the arena; device_put DMAs straight from it onto
+    the consumer's target sharding (ray_tpu.util.device_arrays sets one)
+    or the default device."""
+    import jax
+    import numpy as np
+
+    arr = np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape)
+    from ray_tpu.util import device_arrays
+
+    target = device_arrays.current_target_sharding()
+    if target is not None:
+        return jax.device_put(arr, target)
+    return jax.device_put(arr)
+
+
+def _reduce_jax_array(x):
+    """Serialize side: ONE device→host staging copy (PJRT transfer; a
+    no-copy view on the cpu backend) carried out-of-band — the host
+    bytes then write straight into the arena with no pickle-stream copy.
+    The previous path let jax's own __reduce__ run inside cloudpickle,
+    which byte-copied the array through the pickle stream. SURVEY §2.4
+    bulk-transfer row: HBM-aware object path."""
+    import numpy as np
+
+    host = np.asarray(x)
+    if not host.flags.c_contiguous:
+        host = np.ascontiguousarray(host)
+    return _rebuild_jax_array, (
+        pickle.PickleBuffer(host),
+        host.dtype.str,
+        host.shape,
+    )
+
+
 class _Pickler(cloudpickle.Pickler):
     """Tracks contained ObjectRefs (for dependency/refcount bookkeeping)."""
 
@@ -40,6 +76,22 @@ class _Pickler(cloudpickle.Pickler):
             self.contained_refs.append(obj)
             return ("objectref", obj.binary())
         return None
+
+    def reducer_override(self, obj):
+        import sys
+
+        if "jax" in sys.modules:
+            import jax
+
+            if isinstance(obj, jax.Array):
+                try:
+                    if obj.is_fully_addressable:
+                        return _reduce_jax_array(obj)
+                except Exception:
+                    pass
+        # DELEGATE to cloudpickle's override (it pickles local functions
+        # and lambdas by value there — swallowing it breaks task export)
+        return super().reducer_override(obj)
 
 
 class _Unpickler(pickle.Unpickler):

@@ -675,14 +675,19 @@ def main():
         # cProfile may be active per process — RAY_TPU_PROFILE_WHAT picks
         # the thread (main | ioloop | exec).
         import cProfile
-        import threading as _th
 
         globals()["_worker_profile"] = prof = cProfile.Profile()
+        prof.enable()
 
-        def _dump_loop():
-            # workers die by SIGKILL at cluster stop: dump on a timer
-            while True:
-                _time.sleep(3.0)
+        async def _amain_with_dumps():
+            # workers die by SIGKILL at cluster stop: dump on a timer.
+            # The dump callback runs ON the profiled (main/loop) thread —
+            # cProfile's disable/enable are per-thread, so a separate
+            # dump thread would both race the C-level stats and re-install
+            # the profiler on itself instead of the profiled thread.
+            loop = asyncio.get_running_loop()
+
+            def _dump():
                 prof.disable()
                 try:
                     prof.dump_stats(
@@ -691,11 +696,13 @@ def main():
                 except Exception:
                     pass
                 prof.enable()
+                loop.call_later(3.0, _dump)
 
-        import time as _time
+            loop.call_later(3.0, _dump)
+            await _amain()
 
-        _th.Thread(target=_dump_loop, daemon=True).start()
-        prof.enable()
+        asyncio.run(_amain_with_dumps())
+        return
     asyncio.run(_amain())
 
 

@@ -136,6 +136,13 @@ class GCETPUSliceProvider(NodeProvider):
                 "googleapiclient flow needs GCP credentials + network; "
                 "inject a fake for tests)"
             )
+        if bootstrap is None:
+            raise ValueError(
+                "GCETPUSliceProvider needs a `bootstrap` callable: without it "
+                "created slices would never join the cluster (and never "
+                "satisfy demand), so the autoscaler would launch billable "
+                "slices on every tick up to max_workers"
+            )
         self.slice_type = slice_type
         self.info = slice_shape(slice_type)
         self.project = project

@@ -17,10 +17,12 @@ from ray_tpu.data.grouped import (  # noqa: F401
 )
 from ray_tpu.data.read_api import (  # noqa: F401
     from_arrow,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
     range,
+    read_tfrecords,
     read_csv,
     read_json,
     read_parquet,

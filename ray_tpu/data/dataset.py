@@ -79,6 +79,16 @@ def _slice_rows(blk, start, stop):
 
 
 @ray_tpu.remote
+def _write_tfrecords_block(blk, path: str):
+    from ray_tpu.data import block as B
+    from ray_tpu.data.tfrecords import encode_example, write_records
+
+    with open(path, "wb") as f:
+        write_records(f, (encode_example(row) for row in B.block_rows(blk)))
+    return path
+
+
+@ray_tpu.remote
 def _zip_blocks(left, *right_parts):
     right = B.concat_blocks(list(right_parts))
     for name in right.column_names:
@@ -493,6 +503,19 @@ class Dataset:
         os.makedirs(path, exist_ok=True)
         for i, ref in enumerate(self._execute_refs()):
             pcsv.write_csv(ray_tpu.get(ref), os.path.join(path, f"part-{i:05d}.csv"))
+
+    def write_tfrecords(self, path: str):
+        """One .tfrecord file of tf.train.Example records per block —
+        written IN TASKS (block data never lands on the driver;
+        reference: Dataset.write_tfrecords)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        refs = self._execute_refs()
+        ray_tpu.get([
+            _write_tfrecords_block.remote(ref, os.path.join(path, f"part-{i:05d}.tfrecord"))
+            for i, ref in enumerate(refs)
+        ])
 
     def __repr__(self):
         return f"Dataset(num_blocks={len(self._block_refs)}, ops={len(self._ops)})"

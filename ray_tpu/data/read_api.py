@@ -44,11 +44,30 @@ def from_numpy(arr) -> Dataset:
 
 
 def _expand(paths) -> List[str]:
+    """Expand dirs/globs into file paths. Remote URLs (s3://, gs://,
+    memory://, ... — anything fsspec routes) expand through the scheme's
+    filesystem, so every read_* streams from cloud storage (reference:
+    _resolve_paths_and_filesystem in datasource/path_util.py)."""
     if isinstance(paths, str):
         paths = [paths]
     out: List[str] = []
     for p in paths:
-        if os.path.isdir(p):
+        if "://" in p:
+            import fsspec
+
+            fs, _, roots = fsspec.get_fs_token_paths(p)  # globs pre-expanded
+            scheme = p.split("://", 1)[0]
+            root = roots[0] if roots else p.split("://", 1)[1]
+            if any(c in p for c in "*?["):
+                out.extend(f"{scheme}://{m}" for m in sorted(roots))
+            elif fs.isdir(root):
+                out.extend(
+                    f"{scheme}://{m}" for m in sorted(fs.ls(root, detail=False))
+                    if not fs.isdir(m)
+                )
+            else:
+                out.append(p)
+        elif os.path.isdir(p):
             out.extend(sorted(globlib.glob(os.path.join(p, "*"))))
         elif any(c in p for c in "*?["):
             out.extend(sorted(globlib.glob(p)))
@@ -57,31 +76,43 @@ def _expand(paths) -> List[str]:
     return out
 
 
+def _open(path: str, mode: str = "rb"):
+    """Open local or fsspec-remote paths uniformly."""
+    if "://" in path:
+        import fsspec
+
+        return fsspec.open(path, mode).open()
+    return open(path, mode)
+
+
 @ray_tpu.remote
 def _read_parquet(path):
     import pyarrow.parquet as pq
 
-    return pq.read_table(path)
+    with _open(path) as f:
+        return pq.read_table(f)
 
 
 @ray_tpu.remote
 def _read_csv(path):
     import pyarrow.csv as pcsv
 
-    return pcsv.read_csv(path)
+    with _open(path) as f:
+        return pcsv.read_csv(f)
 
 
 @ray_tpu.remote
 def _read_json(path):
     import pyarrow.json as pjson
 
-    return pjson.read_json(path)
+    with _open(path) as f:
+        return pjson.read_json(f)
 
 
 @ray_tpu.remote
 def _read_text(path):
-    with open(path) as f:
-        lines = [l.rstrip("\n") for l in f]
+    with _open(path) as f:
+        lines = [l.rstrip("\n") for l in f.read().decode().splitlines()]
     return B.to_block([{"text": l} for l in lines])
 
 
@@ -90,15 +121,25 @@ def _read_numpy(path):
     import numpy as np
     import pyarrow as pa
 
-    arr = np.load(path)
+    with _open(path) as f:
+        arr = np.load(f)
     return pa.table({"data": list(arr)})
 
 
 @ray_tpu.remote
 def _read_binary(path):
-    with open(path, "rb") as f:
+    with _open(path) as f:
         data = f.read()
     return B.to_block([{"bytes": data, "path": path}])
+
+
+@ray_tpu.remote
+def _read_tfrecords(path, verify: bool):
+    from ray_tpu.data.tfrecords import decode_example, read_records
+
+    with _open(path) as f:
+        rows = [decode_example(rec) for rec in read_records(f, verify=verify)]
+    return B.to_block(rows)
 
 
 def read_parquet(paths, **kw) -> Dataset:
@@ -123,6 +164,32 @@ def read_numpy(paths, **kw) -> Dataset:
 
 def read_binary_files(paths, **kw) -> Dataset:
     return Dataset([LazyBlock(lambda p=p: _read_binary.remote(p)) for p in _expand(paths)])
+
+
+def read_tfrecords(paths, *, verify_crc: bool = False, **kw) -> Dataset:
+    """TFRecord files of tf.train.Example records → rows (reference:
+    data/datasource/tfrecords_datasource.py). One task per file; no
+    tensorflow import (ray_tpu/data/tfrecords.py implements the format)."""
+    return Dataset([
+        LazyBlock(lambda p=p: _read_tfrecords.remote(p, verify_crc)) for p in _expand(paths)
+    ])
+
+
+def from_huggingface(hf_dataset, parallelism: int = 8) -> Dataset:
+    """A huggingface `datasets.Dataset` (or dict split) → Dataset, via its
+    underlying arrow table — zero row-wise conversion (reference:
+    read_api.from_huggingface)."""
+    if hasattr(hf_dataset, "items") and not hasattr(hf_dataset, "data"):
+        raise ValueError(
+            "from_huggingface takes a single split (e.g. ds['train']), got a DatasetDict"
+        )
+    table = hf_dataset.data.table if hasattr(hf_dataset.data, "table") else hf_dataset.data
+    table = table.combine_chunks()
+    n = table.num_rows
+    k = max(1, min(parallelism, n or 1))
+    per = (n + k - 1) // k
+    blocks = [table.slice(i * per, per) for i in builtins.range(k) if i * per < n]
+    return Dataset([ray_tpu.put(b) for b in blocks or [table]])
 
 
 _IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp", ".tiff")

@@ -367,11 +367,22 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh=None, rules=None):
     return ce
 
 
-def num_params(cfg: LlamaConfig) -> int:
+def num_params(cfg: LlamaConfig, active_only: bool = False) -> int:
+    """Total parameter count. `active_only=True` counts the params a
+    TOKEN actually touches — for MoE (top-1 gate) that is ONE expert's
+    MLP plus the gate, which is what FLOPs/MFU accounting needs; for
+    dense configs the two are identical."""
     d, h, kvh, hd, f, L, V = (
         cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers, cfg.vocab_size,
     )
-    per_layer = d * h * hd + 2 * d * kvh * hd + h * hd * d + 3 * d * f + 2 * d
+    attn = d * h * hd + 2 * d * kvh * hd + h * hd * d
+    if cfg.moe_experts and not active_only:
+        mlp = cfg.moe_experts * 3 * d * f + d * cfg.moe_experts
+    elif cfg.moe_experts:
+        mlp = 3 * d * f + d * cfg.moe_experts  # one routed expert + gate
+    else:
+        mlp = 3 * d * f
+    per_layer = attn + mlp + 2 * d
     return V * d + L * per_layer + d + d * V
 
 
@@ -386,4 +397,5 @@ def flops_per_token(cfg: LlamaConfig, seq_len: int, causal_computed: bool = Fals
     attn = 12 * cfg.n_layers * cfg.d_model * seq_len  # qk^T + pv fwd+bwd
     if causal_computed:
         attn /= 2
-    return 6 * num_params(cfg) + attn
+    # MoE: a token's FLOPs touch one routed expert, not every expert
+    return 6 * num_params(cfg, active_only=True) + attn

@@ -1,0 +1,112 @@
+"""CQL — conservative Q-learning (offline RL on the SAC machinery).
+
+Equivalent of the reference's CQL
+(reference: rllib/algorithms/cql/cql.py — SAC whose critic loss adds the
+conservative logsumexp penalty, trained from an offline dataset instead
+of env rollouts). The penalty itself lives in SACLearner behind
+`conservative_weight` (sac.py); this module supplies the offline
+training loop: minibatches sampled from a fixed transition dataset, no
+env runners.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.sac.sac import SACConfig, SACLearner
+
+
+class CQLLearner(SACLearner):
+    pass  # the conservative penalty is SACLearner's conservative_weight path
+
+
+class CQLConfig(SACConfig):
+    learner_class = CQLLearner
+
+    def __init__(self):
+        super().__init__()
+        self.conservative_weight = 5.0
+        self.cql_n_actions = 10
+        self.offline_data: Dict[str, Any] = {}
+        self.updates_per_iteration = 200
+
+    def offline(self, data=None):
+        """data: {"obs", "actions", "next_obs", "rewards", "terminateds"}
+        transition arrays, or a ray_tpu.data Dataset with those columns."""
+        if data is not None:
+            self.offline_data = data
+        return self
+
+    def copy(self) -> "CQLConfig":
+        data, self.offline_data = self.offline_data, {}
+        try:
+            out = super().copy()
+        finally:
+            self.offline_data = data
+        out.offline_data = data
+        return out
+
+
+_COLS = ("obs", "actions", "next_obs", "rewards", "terminateds")
+
+
+class CQL(Algorithm):
+    config_class = CQLConfig
+
+    def __init__(self, config):
+        from ray_tpu.rllib.core.learner.learner_group import LearnerGroup
+        from ray_tpu.rllib.utils.env import env_spaces
+
+        data = config.offline_data
+        if hasattr(data, "iter_batches"):  # a ray_tpu.data Dataset
+            parts: Dict[str, list] = {c: [] for c in _COLS}
+            for b in data.iter_batches(batch_size=4096, batch_format="numpy"):
+                for c in _COLS:
+                    parts[c].append(np.asarray(b[c]))
+            data = {c: np.concatenate(parts[c]) for c in _COLS}
+        missing = [c for c in _COLS if c not in data]
+        if missing:
+            raise ValueError(
+                f"CQL offline data needs transition columns {_COLS}; missing {missing}. "
+                "Use CQLConfig().offline({...}) or a ray_tpu.data Dataset."
+            )
+        self.config = config
+        self.env_runner_group = None
+        self._spaces = env_spaces(config)
+        self.learner_group = LearnerGroup(config, *self._spaces)
+        self._iteration = 0
+        self._weights_seq = 0
+        self._env_steps_lifetime = 0
+        self._recent_returns: list = []
+        self._data = {
+            "obs": np.asarray(data["obs"], np.float32),
+            "actions": np.asarray(data["actions"], np.float32),
+            "next_obs": np.asarray(data["next_obs"], np.float32),
+            "rewards": np.asarray(data["rewards"], np.float32),
+            "terminateds": np.asarray(data["terminateds"], np.float32),
+        }
+        self._rng = np.random.default_rng(config.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n = len(self._data["actions"])
+        acc: Dict[str, list] = {}
+        for _ in range(cfg.updates_per_iteration):
+            idx = self._rng.integers(0, n, size=min(cfg.train_batch_size, n))
+            batch = {k: v[idx] for k, v in self._data.items()}
+            for k, v in self.learner_group.update_once(batch).items():
+                acc.setdefault(k, []).append(v)
+        self._weights_seq += 1
+        return {
+            "learner": {k: float(np.mean(v)) for k, v in acc.items()},
+            "episode_return_mean": float("nan"),
+            "num_offline_samples": n,
+        }
+
+    def stop(self) -> None:
+        pass
+
+
+CQLConfig.algo_class = CQL

@@ -73,9 +73,15 @@ class SACLearner(Learner):
         module, cfg = self.module, config
         target_entropy = -float(module.act_dim)
 
-        def _step(params, target_params, opt_state, log_alpha, alpha_opt_state, batch, rng):
+        conservative_w = float(getattr(config, "conservative_weight", 0.0) or 0.0)
+        cql_n_actions = int(getattr(config, "cql_n_actions", 10))
+
+        def _grads(params, target_params, log_alpha, batch, rng):
+            """Gradient phase: every component's grads from one batch —
+            separable so lockstep multi-learner averaging can sit between
+            this and _apply (the fused local step composes the two)."""
             alpha = jnp.exp(log_alpha)
-            k1, k2 = jax.random.split(rng)
+            k1, k2, k3 = jax.random.split(rng, 3)
 
             # critic loss: soft Bellman target from the target critics
             next_a, next_logp = module.sample_action(params, batch["next_obs"], k1)
@@ -86,14 +92,41 @@ class SACLearner(Learner):
 
             def critic_loss(p):
                 q1, q2 = module.q_values(p, batch["obs"], batch["actions"])
-                return 0.5 * jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2), (q1 - target)
+                loss = 0.5 * jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2)
+                gap = jnp.zeros(())
+                if conservative_w > 0.0:
+                    # CQL conservative penalty: push down Q on sampled
+                    # actions (uniform + policy) vs up on dataset actions
+                    B = batch["obs"].shape[0]
+                    ka, kb = jax.random.split(k3)
+                    rand_a = jax.random.uniform(
+                        ka, (cql_n_actions, B, module.act_dim), minval=-1.0, maxval=1.0
+                    )
+                    pol_a, pol_logp = jax.vmap(
+                        lambda k: module.sample_action(jax.lax.stop_gradient(p), batch["obs"], k)
+                    )(jax.random.split(kb, cql_n_actions))
+                    def q_of(actions):
+                        q1s, q2s = jax.vmap(lambda a: module.q_values(p, batch["obs"], a))(actions)
+                        return q1s, q2s
+                    rq1, rq2 = q_of(rand_a)
+                    pq1, pq2 = q_of(pol_a)
+                    # importance-corrected logsumexp (CQL(H); uniform
+                    # density = 0.5^d, policy density = exp(logp))
+                    log_u = module.act_dim * jnp.log(0.5)
+                    cat1 = jnp.concatenate([rq1 - log_u, pq1 - pol_logp], axis=0)
+                    cat2 = jnp.concatenate([rq2 - log_u, pq2 - pol_logp], axis=0)
+                    lse1 = jax.nn.logsumexp(cat1, axis=0) - jnp.log(2 * cql_n_actions)
+                    lse2 = jax.nn.logsumexp(cat2, axis=0) - jnp.log(2 * cql_n_actions)
+                    gap = jnp.mean(lse1 - q1) + jnp.mean(lse2 - q2)
+                    loss = loss + conservative_w * gap
+                return loss, ((q1 - target), gap)
 
             def actor_loss(p):
                 a, logp = module.sample_action(p, batch["obs"], k2)
                 q1, q2 = module.q_values(jax.lax.stop_gradient(p), batch["obs"], a)
                 return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
 
-            (closs, td), cgrads = jax.value_and_grad(critic_loss, has_aux=True)(params)
+            (closs, (td, cql_gap)), cgrads = jax.value_and_grad(critic_loss, has_aux=True)(params)
             (aloss, logp), agrads = jax.value_and_grad(actor_loss, has_aux=True)(params)
             # critics learn from the critic loss, the actor from the actor
             # loss: mask each gradient tree to its component
@@ -102,20 +135,11 @@ class SACLearner(Learner):
                 "q1": cgrads["q1"],
                 "q2": cgrads["q2"],
             }
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
 
             def alpha_loss(la):
                 return -jnp.mean(jnp.exp(la) * jax.lax.stop_gradient(logp + target_entropy))
 
-            aguard, agrad = jax.value_and_grad(alpha_loss)(log_alpha)
-            aupd, alpha_opt_state = self._alpha_opt.update(agrad, alpha_opt_state, log_alpha)
-            log_alpha = optax.apply_updates(log_alpha, aupd)
-
-            # polyak target update rides in the same compiled step
-            target_params = jax.tree.map(
-                lambda t, p: (1.0 - cfg.tau) * t + cfg.tau * p, target_params, params
-            )
+            _, agrad = jax.value_and_grad(alpha_loss)(log_alpha)
             stats = {
                 "critic_loss": closs,
                 "actor_loss": aloss,
@@ -123,9 +147,33 @@ class SACLearner(Learner):
                 "mean_q_target": jnp.mean(target),
                 "entropy": -jnp.mean(logp),
             }
+            if conservative_w > 0.0:
+                stats["cql_gap"] = cql_gap
+            return grads, agrad, stats, td
+
+        def _apply(params, target_params, opt_state, log_alpha, alpha_opt_state, grads, agrad):
+            """Apply phase: deterministic given grads — identical on every
+            lockstep learner, so target nets and alpha never diverge."""
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aupd, alpha_opt_state = self._alpha_opt.update(agrad, alpha_opt_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, aupd)
+            # polyak target update rides in the same compiled step
+            target_params = jax.tree.map(
+                lambda t, p: (1.0 - cfg.tau) * t + cfg.tau * p, target_params, params
+            )
+            return params, target_params, opt_state, log_alpha, alpha_opt_state
+
+        def _step(params, target_params, opt_state, log_alpha, alpha_opt_state, batch, rng):
+            grads, agrad, stats, td = _grads(params, target_params, log_alpha, batch, rng)
+            params, target_params, opt_state, log_alpha, alpha_opt_state = _apply(
+                params, target_params, opt_state, log_alpha, alpha_opt_state, grads, agrad
+            )
             return params, target_params, opt_state, log_alpha, alpha_opt_state, stats, td
 
         self._sac_step = jax.jit(_step)
+        self._sac_grads = jax.jit(_grads)
+        self._sac_apply = jax.jit(_apply)
         self._rng = jax.random.PRNGKey(config.seed + 31)
 
     def update_once(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
@@ -147,6 +195,36 @@ class SACLearner(Learner):
         self.td_errors = np.asarray(td)
         self._updates += 1
         return {k: float(np.asarray(v)) for k, v in stats.items()}
+
+    # -- lockstep multi-learner path: grads (incl. the temperature grad,
+    # packed under "_alpha") are averaged across learners; _apply is
+    # deterministic so target nets and alpha stay bit-identical
+    def compute_grads(self, batch):
+        import jax
+
+        self._rng, key = jax.random.split(self._rng)
+        grads, agrad, stats, td = self._sac_grads(
+            self.params, self.target_params, self.log_alpha, batch, key
+        )
+        self.td_errors = np.asarray(td)
+        out = self._jax.tree.map(np.asarray, grads)
+        out["_alpha"] = np.asarray(agrad)
+        return out, {k: float(np.asarray(v)) for k, v in stats.items()}
+
+    def apply_grads(self, grads) -> None:
+        grads = dict(grads)
+        agrad = grads.pop("_alpha")
+        (
+            self.params,
+            self.target_params,
+            self.opt_state,
+            self.log_alpha,
+            self._alpha_opt_state,
+        ) = self._sac_apply(
+            self.params, self.target_params, self.opt_state,
+            self.log_alpha, self._alpha_opt_state, grads, agrad,
+        )
+        self._updates += 1
 
     def get_state(self):
         state = super().get_state()
@@ -188,26 +266,19 @@ class SACConfig(DQNConfig):
         self.num_envs_per_env_runner = 4
         self.prioritized_replay = False
         self.grad_clip = None
+        # CQL hooks (0 = plain SAC; CQLConfig turns them on)
+        self.conservative_weight = 0.0
+        self.cql_n_actions = 10
 
 
 class SAC(DQN):
     """training_step is DQN's (sample → replay → update_once at
-    intensity); only the learner and runner differ."""
+    intensity); only the learner and runner differ. num_learners > 0 runs
+    lockstep: replay batches shard across learner actors, grads (incl.
+    the temperature grad) average, and the deterministic apply phase
+    keeps target nets and alpha identical on every learner."""
 
     config_class = SACConfig
-
-    def __init__(self, config):
-        if config.num_learners > 0:
-            # validate BEFORE super().__init__ spawns runner/learner actors:
-            # the lockstep path calls the base Learner.compute_grads (which
-            # has no SAC loss) and would skip SACLearner's target-net polyak
-            # and alpha updates even if it did not raise
-            raise ValueError(
-                "SAC requires the local learner (num_learners=0): target-net "
-                "polyak and alpha updates happen only inside SACLearner; a "
-                "distributed SAC step is not implemented yet"
-            )
-        super().__init__(config)
 
 
 SACConfig.algo_class = SAC

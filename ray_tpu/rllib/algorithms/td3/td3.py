@@ -1,0 +1,215 @@
+"""TD3 — twin-delayed deterministic policy gradients.
+
+Equivalent of the reference's TD3
+(reference: rllib/algorithms/td3/td3.py — DDPG with clipped double-Q,
+target policy smoothing and delayed actor updates). Jax-native like the
+SAC learner: critic TD + (every `policy_delay` steps) actor update +
+polyak ride in compiled steps; target nets are pytree arguments.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.sac.sac import ContinuousOffPolicyEnvRunner
+from ray_tpu.rllib.core.learner.learner import Learner
+from ray_tpu.rllib.core.rl_module import ContinuousMLPModule
+
+
+class DeterministicContinuousModule(ContinuousMLPModule):
+    """Deterministic tanh actor + the twin critics of the continuous
+    module (reference analogue: DDPG/TD3 deterministic policy nets)."""
+
+    def init_params(self, rng):
+        sizes = (self.obs_dim,) + self.hidden
+        k_pi, k_q1, k_q2 = jax.random.split(rng, 3)
+        q_sizes = (self.obs_dim + self.act_dim,) + self.hidden
+        return {
+            "pi": self._mlp_init(k_pi, sizes, self.act_dim),
+            "q1": self._mlp_init(k_q1, q_sizes, 1, out_scale=1.0),
+            "q2": self._mlp_init(k_q2, q_sizes, 1, out_scale=1.0),
+        }
+
+    def forward(self, params, obs):
+        a = jnp.tanh(self._mlp_apply(params["pi"], obs))
+        return {"mean": a, "log_std": jnp.full_like(a, -jnp.inf), "vf": jnp.zeros(obs.shape[:-1])}
+
+    def act(self, params, obs):
+        return jnp.tanh(self._mlp_apply(params["pi"], obs))
+
+    def sample_action(self, params, obs, rng):
+        # deterministic policy: exploration noise is the RUNNER's job
+        a = self.act(params, obs)
+        return a, jnp.zeros(a.shape[:-1])
+
+
+class TD3EnvRunner(ContinuousOffPolicyEnvRunner):
+    """Deterministic actions + Gaussian exploration noise (reference:
+    TD3's exploration config — no entropy term to explore with)."""
+
+    def _select_actions(self, obs):
+        self._rng, key = self._jax.random.split(self._rng)
+        if self._warmup:
+            action = np.asarray(
+                self._jax.random.uniform(
+                    key, (self.num_envs, self.module.act_dim), minval=-1.0, maxval=1.0
+                ),
+                np.float32,
+            )
+        else:
+            a, _ = self._sample_fn(self.params, obs.astype(np.float32), key)
+            noise = np.random.default_rng(int(self._global_step)).normal(
+                0.0, self.config.exploration_noise, size=np.asarray(a).shape
+            )
+            action = np.clip(np.asarray(a, np.float32) + noise.astype(np.float32), -1.0, 1.0)
+        low, high = self.module.action_low, self.module.action_high
+        return action, low + (action + 1.0) * 0.5 * (high - low)
+
+
+class TD3Learner(Learner):
+    """Clipped double-Q TD with target policy smoothing; actor + polyak
+    every `policy_delay` updates (two jitted steps — critic-only and
+    critic+actor — selected by the Python-side update counter)."""
+
+    def __init__(self, config, obs_space=None, action_space=None, mesh=None):
+        super().__init__(config, obs_space, action_space, mesh)
+        import optax
+
+        self.target_params = jax.tree.map(jnp.asarray, self.params)
+        self._updates = 0
+        self.td_errors = None
+        module, cfg = self.module, config
+
+        def _grads(params, target_params, batch, rng, with_actor: bool):
+            # target policy smoothing: clipped noise on the target action
+            noise = jnp.clip(
+                cfg.target_noise * jax.random.normal(rng, batch["actions"].shape),
+                -cfg.target_noise_clip, cfg.target_noise_clip,
+            )
+            next_a = jnp.clip(module.act(target_params, batch["next_obs"]) + noise, -1.0, 1.0)
+            tq1, tq2 = module.q_values(target_params, batch["next_obs"], next_a)
+            target = batch["rewards"] + cfg.gamma * (
+                1.0 - batch["terminateds"].astype(jnp.float32)
+            ) * jnp.minimum(tq1, tq2)
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(p):
+                q1, q2 = module.q_values(p, batch["obs"], batch["actions"])
+                return 0.5 * jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2), (q1 - target)
+
+            (closs, td), cgrads = jax.value_and_grad(critic_loss, has_aux=True)(params)
+            stats = {"critic_loss": closs, "mean_q_target": jnp.mean(target)}
+            if with_actor:
+                def actor_loss(p):
+                    a = module.act(p, batch["obs"])
+                    q1, _ = module.q_values(jax.lax.stop_gradient(p), batch["obs"], a)
+                    return -jnp.mean(q1)
+
+                aloss, agrads = jax.value_and_grad(actor_loss)(params)
+                pi_g = agrads["pi"]
+                stats["actor_loss"] = aloss
+            else:
+                pi_g = jax.tree.map(jnp.zeros_like, params["pi"])
+                stats["actor_loss"] = jnp.zeros(())
+            grads = {"pi": pi_g, "q1": cgrads["q1"], "q2": cgrads["q2"]}
+            return grads, stats, td
+
+        def _apply(params, target_params, opt_state, grads, do_polyak: bool):
+            import optax as _optax
+
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = _optax.apply_updates(params, updates)
+            if do_polyak:
+                target_params = jax.tree.map(
+                    lambda t, p: (1.0 - cfg.tau) * t + cfg.tau * p, target_params, params
+                )
+            return params, target_params, opt_state
+
+        import functools
+
+        self._td3_grads = jax.jit(_grads, static_argnames="with_actor")
+        self._td3_apply = jax.jit(_apply, static_argnames="do_polyak")
+        self._rng = jax.random.PRNGKey(config.seed + 47)
+
+    def _with_actor(self) -> bool:
+        return (self._updates + 1) % self.config.policy_delay == 0
+
+    def update_once(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        self._rng, key = jax.random.split(self._rng)
+        wa = self._with_actor()
+        grads, stats, td = self._td3_grads(self.params, self.target_params, batch, key, with_actor=wa)
+        self.params, self.target_params, self.opt_state = self._td3_apply(
+            self.params, self.target_params, self.opt_state, grads, do_polyak=wa
+        )
+        self.td_errors = np.asarray(td)
+        self._updates += 1
+        return {k: float(np.asarray(v)) for k, v in stats.items()}
+
+    # lockstep multi-learner path: the actor-update parity is driven by
+    # the shared update counter, so every learner takes the same branch
+    def compute_grads(self, batch):
+        self._rng, key = jax.random.split(self._rng)
+        grads, stats, td = self._td3_grads(
+            self.params, self.target_params, batch, key, with_actor=self._with_actor()
+        )
+        self.td_errors = np.asarray(td)
+        return self._jax.tree.map(np.asarray, grads), {
+            k: float(np.asarray(v)) for k, v in stats.items()
+        }
+
+    def apply_grads(self, grads) -> None:
+        wa = self._with_actor()
+        self.params, self.target_params, self.opt_state = self._td3_apply(
+            self.params, self.target_params, self.opt_state, grads, do_polyak=wa
+        )
+        self._updates += 1
+
+    def get_state(self):
+        state = super().get_state()
+        state["target_params"] = self._jax.tree.map(np.asarray, self.target_params)
+        state["updates"] = self._updates
+        return state
+
+    def set_state(self, state) -> None:
+        super().set_state(state)
+        self.target_params = self._jax.tree.map(np.asarray, state["target_params"])
+        self._updates = state.get("updates", 0)
+
+
+class TD3Config(DQNConfig):
+    learner_class = TD3Learner
+
+    def __init__(self):
+        super().__init__()
+        self.env_runner_cls = TD3EnvRunner
+        self.module_class = DeterministicContinuousModule
+        self.model_config = {"hidden": (256, 256)}
+        self.lr = 1e-3
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.policy_delay = 2
+        self.target_noise = 0.2
+        self.target_noise_clip = 0.5
+        self.exploration_noise = 0.1
+        self.train_batch_size = 256
+        self.training_intensity = 1.0
+        self.num_steps_sampled_before_learning_starts = 1500
+        self.rollout_fragment_length = 8
+        self.num_envs_per_env_runner = 4
+        self.prioritized_replay = False
+        self.grad_clip = None
+
+
+class TD3(DQN):
+    """training_step is DQN's (sample → replay → TD updates at
+    intensity); the learner brings smoothing/delay/twin-min."""
+
+    config_class = TD3Config
+
+
+TD3Config.algo_class = TD3

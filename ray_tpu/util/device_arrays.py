@@ -1,0 +1,51 @@
+"""Device-array object plane helpers.
+
+SURVEY §2.4 bulk-transfer row: `put()` of a jax.Array stages HBM→host
+directly into the arena (one PJRT transfer, no pickle-stream copy —
+see serialization._reduce_jax_array); `get()` rebuilds by DMA-ing the
+arena-mapped host bytes onto a device. This module controls WHERE that
+decode lands: wrap a get in `target_sharding(...)` to place results
+onto a specific sharding (weight broadcast onto a mesh, serve model
+swap onto the serving devices) instead of the default device.
+
+    with device_arrays.target_sharding(NamedSharding(mesh, P("fsdp"))):
+        params = ray_tpu.get(params_ref)
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Any, Optional
+
+_target: contextvars.ContextVar = contextvars.ContextVar("ray_tpu_device_target", default=None)
+
+
+def current_target_sharding() -> Optional[Any]:
+    return _target.get()
+
+
+@contextlib.contextmanager
+def target_sharding(sharding: Any):
+    """Within this context, decoded jax.Arrays land on `sharding`
+    (a jax.sharding.Sharding or a Device)."""
+    tok = _target.set(sharding)
+    try:
+        yield
+    finally:
+        _target.reset(tok)
+
+
+def put_array(core_or_none, value):
+    """Convenience: ray_tpu.put for a jax array / pytree of arrays."""
+    import ray_tpu
+
+    return ray_tpu.put(value)
+
+
+def get_on(ref, sharding: Any):
+    """get() with decode placed onto `sharding` (one host→device DMA per
+    array straight from the arena mapping)."""
+    import ray_tpu
+
+    with target_sharding(sharding):
+        return ray_tpu.get(ref)

@@ -1,0 +1,122 @@
+"""Chaos tier: random process kills under a sustained workload
+(reference: python/ray/tests/chaos/ + _private/test_utils.py
+ResourceKillerActor — kill-loops that assert the cluster keeps making
+progress). Workers are SIGKILLed every couple of seconds and one
+non-head raylet dies mid-run; retries and actor restarts must carry the
+workload to completion, and the session must shut down without leaked
+arenas."""
+import os
+import random
+import signal
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def _worker_pids(session_dir: str):
+    """Executor worker processes of THIS session (cmdline + env match)."""
+    pids = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline") as f:
+                cmd = f.read()
+            if "worker_proc" not in cmd:
+                continue
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                env = f.read().decode(errors="replace")
+            if session_dir in env:
+                pids.append(int(pid))
+        except (OSError, PermissionError):
+            continue
+    return pids
+
+
+def test_kill_loop_under_sustained_load():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    extra = c.add_node(num_cpus=2, resources={"extra": 1.0})
+    c.connect()
+    c.wait_for_nodes()
+    session_dir = c.procs.session_dir
+
+    @ray_tpu.remote(max_retries=20)
+    def work(x):
+        time.sleep(0.02)
+        return x * 3
+
+    @ray_tpu.remote(max_restarts=50, max_task_retries=50)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, v):
+            self.n += v
+            return v
+
+    counter = Counter.remote()
+    ray_tpu.get(counter.add.remote(0))
+
+    stop = threading.Event()
+    killed = {"workers": 0, "raylet": 0}
+
+    def killer():
+        rng = random.Random(0)
+        rounds = 0
+        while not stop.is_set():
+            time.sleep(2.5)
+            rounds += 1
+            if rounds == 8 and extra.proc.poll() is None:
+                # one raylet dies mid-run (never the head)
+                extra.proc.kill()
+                killed["raylet"] += 1
+                continue
+            pids = _worker_pids(session_dir)
+            if pids:
+                victim = rng.choice(pids)
+                try:
+                    os.kill(victim, signal.SIGKILL)
+                    killed["workers"] += 1
+                except ProcessLookupError:
+                    pass
+
+    kt = threading.Thread(target=killer, daemon=True)
+    kt.start()
+
+    deadline = time.monotonic() + 60
+    completed = 0
+    expected_counter = 0
+    batch_id = 0
+    try:
+        while time.monotonic() < deadline:
+            batch_id += 1
+            refs = [work.remote(batch_id * 100 + i) for i in range(20)]
+            acalls = [counter.add.remote(1) for _ in range(5)]
+            out = ray_tpu.get(refs, timeout=120)
+            assert out == [(batch_id * 100 + i) * 3 for i in range(20)]
+            ray_tpu.get(acalls, timeout=120)
+            expected_counter += 5
+            completed += 20
+    finally:
+        stop.set()
+        kt.join(timeout=5)
+
+    assert completed >= 200, f"only {completed} tasks completed in 60s under chaos"
+    assert killed["workers"] >= 5, f"kill loop barely ran: {killed}"
+    assert killed["raylet"] == 1
+    # the actor either survived or restarted; in either case it still serves
+    final = ray_tpu.get(counter.add.remote(0), timeout=60)
+    assert final == 0
+
+    # no leaked arenas after shutdown: every /dev/shm arena of this
+    # session's raylets disappears (the killed raylet's too)
+    arenas_before = [p for p in os.listdir("/dev/shm") if p.startswith("ray_tpu_")]
+    c.shutdown()
+    time.sleep(1)
+    arenas_after = [p for p in os.listdir("/dev/shm") if p.startswith("ray_tpu_")]
+    leaked = set(arenas_after) & set(arenas_before)
+    assert not leaked, f"leaked arenas: {leaked}"

@@ -1,0 +1,95 @@
+"""Datasource breadth: tfrecords (self-contained codec), huggingface
+adapter, and fsspec remote paths through every reader (reference:
+python/ray/data/datasource/tfrecords_datasource.py, read_api)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+
+
+def test_tfrecords_roundtrip(ray_start_regular, tmp_path):
+    ds = rd.from_items(
+        [{"i": i, "w": float(i) * 0.5, "name": f"r{i}".encode()} for i in range(50)],
+        parallelism=4,
+    )
+    path = str(tmp_path / "tfr")
+    ds.write_tfrecords(path)
+    back = rd.read_tfrecords(path, verify_crc=True)
+    rows = sorted(back.take_all(), key=lambda r: r["i"])
+    assert len(rows) == 50
+    assert rows[7]["i"] == 7 and rows[7]["w"] == 3.5 and rows[7]["name"] == b"r7"
+
+
+def test_tfrecords_tensorflow_compat(ray_start_regular, tmp_path):
+    """Files we write parse with tensorflow; files tensorflow writes
+    parse with us — byte-level format compatibility, not just roundtrip."""
+    tf = pytest.importorskip("tensorflow")
+
+    ds = rd.from_items([{"x": i} for i in range(10)], parallelism=1)
+    ours = str(tmp_path / "ours")
+    ds.write_tfrecords(ours)
+    import glob
+
+    recs = list(tf.data.TFRecordDataset(sorted(glob.glob(ours + "/*"))).as_numpy_iterator())
+    assert len(recs) == 10
+    ex = tf.train.Example()
+    ex.ParseFromString(recs[0])
+    assert ex.features.feature["x"].int64_list.value[0] == 0
+
+    theirs = str(tmp_path / "theirs.tfrecord")
+    with tf.io.TFRecordWriter(theirs) as w:
+        for i in range(5):
+            e = tf.train.Example()
+            e.features.feature["y"].float_list.value.append(i * 1.5)
+            w.write(e.SerializeToString())
+    rows = rd.read_tfrecords(theirs, verify_crc=True).take_all()
+    assert [r["y"] for r in rows] == [0.0, 1.5, 3.0, 4.5, 6.0]
+
+
+def test_fsspec_remote_paths_end_to_end(ray_start_regular, tmp_path):
+    """read → preprocess → iter_batches through fsspec URL paths: the
+    driver expands the scheme'd directory, worker tasks stream each file
+    via fsspec.open (file:// here — cross-process-visible; s3://gs://
+    route through the identical machinery)."""
+    import fsspec
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    fs = fsspec.filesystem("file")
+    root = str(tmp_path / "bucket" / "data")
+    fs.makedirs(root, exist_ok=True)
+    for i in range(3):
+        with fs.open(f"{root}/part-{i}.parquet", "wb") as buf:
+            pq.write_table(pa.table({"v": list(range(i * 10, (i + 1) * 10))}), buf)
+
+    ds = rd.read_parquet(f"file://{root}")
+    assert ds.count() == 30
+    out = ds.map_batches(lambda b: {"v2": b["v"] * 2})
+    total = 0
+    for batch in out.iter_batches(batch_size=16, batch_format="numpy"):
+        total += int(batch["v2"].sum())
+    assert total == 2 * sum(range(30))
+
+    # csv + glob through the same path machinery
+    with fs.open(f"{root}/../t.csv", "wb") as f:
+        f.write(b"a,b\n1,x\n2,y\n")
+    rows = rd.read_csv(f"file://{tmp_path}/bucket/t.csv").take_all()
+    assert rows == [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
+    assert rd.read_parquet(f"file://{root}/part-*.parquet").count() == 30
+
+
+def test_from_huggingface(ray_start_regular):
+    datasets = pytest.importorskip("datasets")
+
+    hf = datasets.Dataset.from_dict({"text": [f"doc {i}" for i in range(40)], "label": list(range(40))})
+    ds = rd.from_huggingface(hf, parallelism=4)
+    assert ds.num_blocks() == 4
+    assert ds.count() == 40
+    rows = ds.take_all()
+    assert rows[5] == {"text": "doc 5", "label": 5}
+    # pipeline composition works on the adapted table
+    agg = {r["r"]: r["label_sum"] for r in
+           ds.map_batches(lambda b: {"label": b["label"], "r": b["label"] % 2})
+             .groupby("r").sum("label").take_all()}
+    assert agg[0] == sum(i for i in range(40) if i % 2 == 0)

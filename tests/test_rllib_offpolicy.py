@@ -1,0 +1,165 @@
+"""Off-policy breadth: TD3 (continuous control), CQL (offline), and the
+distributed lockstep path for SAC/DQN (reference: rllib/algorithms/td3,
+rllib/algorithms/cql, and the multi-learner Learner stack)."""
+import numpy as np
+import pytest
+
+
+def test_td3_pendulum_improves():
+    """TD3 improves Pendulum well past random (~-1200 avg return)."""
+    from ray_tpu.rllib import TD3Config
+
+    config = (
+        TD3Config()
+        .environment("Pendulum-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=4, rollout_fragment_length=8)
+        .training(training_intensity=256.0)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    best = -1e9
+    for _ in range(450):
+        r = algo.train()
+        m = r["episode_return_mean"]
+        if m == m:
+            best = max(best, m)
+        if best > -600.0:
+            break
+    algo.stop()
+    assert best > -600.0, f"TD3 failed to improve on Pendulum (best {best})"
+
+
+def _bandit_dataset(n=4096, seed=0):
+    """Synthetic continuous-control transitions shaped like Pendulum
+    (obs 3-dim, act 1-dim): reward = -(a - 0.5)^2, one-step episodes.
+    The dataset only contains GOOD actions near +0.5 and BAD ones near
+    -0.5 — an offline learner must prefer 0.5 without ever exploring."""
+    rng = np.random.default_rng(seed)
+    obs = rng.normal(size=(n, 3)).astype(np.float32)
+    good = rng.integers(0, 2, size=n).astype(bool)
+    a = np.where(good, 0.5, -0.5) + rng.normal(0, 0.05, size=n)
+    a = a.clip(-1, 1).astype(np.float32)[:, None]
+    rew = -((a[:, 0] - 0.5) ** 2)
+    return {
+        "obs": obs,
+        "actions": a,
+        "next_obs": rng.normal(size=(n, 3)).astype(np.float32),
+        "rewards": rew.astype(np.float32),
+        "terminateds": np.ones(n, np.float32),  # bandit: one-step episodes
+    }
+
+
+def test_cql_learns_offline_and_stays_conservative():
+    from ray_tpu.rllib import CQLConfig
+
+    config = (
+        CQLConfig()
+        .environment("Pendulum-v1")  # spaces only; no env stepping
+        .debugging(seed=0)
+    )
+    config.offline(_bandit_dataset())
+    config.conservative_weight = 1.0
+    config.updates_per_iteration = 150
+    config.train_batch_size = 256
+    algo = config.build()
+    stats = None
+    for _ in range(3):
+        stats = algo.train()["learner"]
+    # learned policy prefers the good dataset action
+    import jax
+    import jax.numpy as jnp
+
+    learner = algo.learner_group._local
+    obs = jnp.asarray(np.random.default_rng(1).normal(size=(64, 3)), jnp.float32)
+    a, _ = learner.module.sample_action(learner.params, obs, jax.random.PRNGKey(0))
+    mean_a = float(jnp.mean(a))
+    assert mean_a > 0.1, f"CQL policy did not move toward the good action (mean {mean_a})"
+    # the conservative gap is being optimized (finite, reported)
+    assert "cql_gap" in stats and np.isfinite(stats["cql_gap"])
+    assert np.isfinite(stats["critic_loss"])
+
+
+def _replay_batch(rng, n=64, obs_dim=3, act_dim=1):
+    return {
+        "obs": rng.normal(size=(n, obs_dim)).astype(np.float32),
+        "actions": rng.uniform(-1, 1, size=(n, act_dim)).astype(np.float32),
+        "next_obs": rng.normal(size=(n, obs_dim)).astype(np.float32),
+        "rewards": rng.normal(size=n).astype(np.float32),
+        "terminateds": np.zeros(n, np.float32),
+    }
+
+
+def test_sac_two_learner_lockstep_weights_equal(ray_start_regular):
+    """2 remote SAC learners: shards → averaged grads (incl. alpha) →
+    deterministic apply. After several updates BOTH learners hold
+    identical params, target params and alpha."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib import SACConfig
+    from ray_tpu.rllib.core.learner.learner_group import LearnerGroup
+
+    config = SACConfig().environment("Pendulum-v1").debugging(seed=0)
+    config.num_learners = 2
+    env = gym.make("Pendulum-v1")
+    group = LearnerGroup(config, env.observation_space, env.action_space)
+    rng = np.random.default_rng(0)
+    for _ in range(4):
+        stats = group.update_once(_replay_batch(rng, n=64))
+    assert np.isfinite(stats["critic_loss"])
+
+    import ray_tpu
+
+    states = ray_tpu.get([w.get_state.remote() for w in group._workers])
+    s0, s1 = states
+    assert abs(s0["log_alpha"] - s1["log_alpha"]) < 1e-12
+    for key in ("params", "target_params"):
+        for a, b in zip(
+            [np.asarray(x) for x in _leaves(s0[key])],
+            [np.asarray(x) for x in _leaves(s1[key])],
+        ):
+            np.testing.assert_array_equal(a, b)
+    # and the weights actually moved
+    moved = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(_leaves(s0["params"]), _leaves(s0["target_params"]))
+    )
+    assert moved
+
+
+def test_dqn_two_learner_lockstep(ray_start_regular):
+    """2 remote DQN learners stay weight-identical through lockstep TD
+    updates with target-net syncs."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib import DQNConfig
+    from ray_tpu.rllib.core.learner.learner_group import LearnerGroup
+
+    config = DQNConfig().environment("CartPole-v1").debugging(seed=0)
+    config.num_learners = 2
+    config.target_network_update_freq = 2
+    env = gym.make("CartPole-v1")
+    group = LearnerGroup(config, env.observation_space, env.action_space)
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        batch = {
+            "obs": rng.normal(size=(64, 4)).astype(np.float32),
+            "actions": rng.integers(0, 2, size=64),
+            "next_obs": rng.normal(size=(64, 4)).astype(np.float32),
+            "rewards": rng.normal(size=64).astype(np.float32),
+            "terminateds": np.zeros(64, np.float32),
+        }
+        stats = group.update_once(batch)
+    assert np.isfinite(stats["loss"])
+
+    import ray_tpu
+
+    states = ray_tpu.get([w.get_state.remote() for w in group._workers])
+    for key in ("params", "target_params"):
+        for a, b in zip(_leaves(states[0][key]), _leaves(states[1][key])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)

@@ -1,0 +1,92 @@
+"""Sanitizer tier for the native core (reference: the TSAN/ASAN CI lane
+over src/ray). The shm arena + allocator are rebuilt with
+-fsanitize=address in a subprocess (ASAN runtime preloaded) and driven
+through create/seal/get/delete/evict churn including multi-threaded
+readers — any heap overflow / UAF in the boundary-tag allocator or the
+entry table aborts the subprocess with an ASAN report."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_WORKOUT = r"""
+import ctypes, os, threading
+from ray_tpu._private.native_build import build_native_library
+from ray_tpu._private import shm_store as S
+
+lib_path = build_native_library(
+    S._SRC, "shm_store_asan", extra_flags=("-lpthread", "-fsanitize=address")
+)
+S.build_library = lambda force=False: lib_path
+S._lib = None
+
+path = f"/dev/shm/ray_tpu_asan_{os.getpid()}"
+try:
+    S.ShmStore.create(path, 8 * 1024 * 1024)
+    store = S.ShmStore(path)
+    # allocation churn: fill, delete odd, refill (exercises split/coalesce)
+    oids = [os.urandom(16) for _ in range(64)]
+    for i, oid in enumerate(oids):
+        store.put_bytes(oid, bytes([i % 251]) * (1024 * (1 + i % 7)))
+    for oid in oids[::2]:
+        store.delete(oid)
+    for i in range(32):
+        store.put_bytes(os.urandom(16), b"y" * 4096)
+
+    # concurrent readers while the writer churns
+    stop = threading.Event()
+    def reader():
+        while not stop.is_set():
+            for oid in oids[1::2]:
+                buf = store.get(oid, timeout_ms=0)
+                if buf is not None:
+                    _ = bytes(buf.view[:16])
+                    buf.release()
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    [t.start() for t in threads]
+    for i in range(200):
+        oid = os.urandom(16)
+        store.put_bytes(oid, b"z" * (512 * (1 + i % 16)))
+        if i % 3 == 0:
+            store.delete(oid)
+    stop.set()
+    [t.join() for t in threads]
+
+    # eviction pressure: allocate past capacity so the LRU evicts
+    big = []
+    for i in range(40):
+        try:
+            store.put_bytes(os.urandom(16), b"b" * (512 * 1024))
+        except Exception:
+            break
+    u = store.usage()
+    assert u["used_bytes"] <= u["capacity_bytes"]
+    store.close()
+    print("ASAN_WORKOUT_OK")
+finally:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+"""
+
+
+def test_shm_store_under_asan():
+    out = subprocess.run(
+        ["g++", "-print-file-name=libasan.so"], capture_output=True, text=True
+    )
+    libasan = out.stdout.strip()
+    if not libasan or not os.path.exists(libasan):
+        pytest.skip("libasan not available")
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = libasan
+    env["ASAN_OPTIONS"] = "detect_leaks=0:abort_on_error=1"  # ctypes/python leak noise
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", _WORKOUT], env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, f"ASAN workout failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "ASAN_WORKOUT_OK" in proc.stdout
+    assert "ERROR: AddressSanitizer" not in proc.stderr
