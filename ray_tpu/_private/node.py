@@ -175,18 +175,22 @@ class NodeProcesses:
                 proc.wait(timeout=5)
             except Exception:
                 pass
-        # reap the arenas of raylets that died UNCLEANLY (SIGKILL, chaos,
-        # OOM): a raylet only unlinks its /dev/shm file in its own
-        # graceful path, so a session teardown must sweep its children's
-        # arenas or kill-tested runs leak host shm until the next init's
-        # stale-arena GC
-        for proc in self.procs:
-            for name in list(os.listdir("/dev/shm")):
-                if name.startswith(f"ray_tpu_{proc.pid}_"):
-                    try:
-                        os.unlink(os.path.join("/dev/shm", name))
-                    except OSError:
-                        pass
+        # reap the arenas AND compiled-DAG channel files of processes that
+        # died UNCLEANLY (SIGKILL, chaos, OOM): a raylet only unlinks its
+        # /dev/shm files in its own graceful path, so session teardown
+        # must sweep its children's or kill-tested runs leak host shm
+        # until the next init's stale-arena GC. Names embed the creator
+        # pid (ray_tpu_<pid>_* / ray_tpu_chan_<pid>_*).
+        import re
+
+        pids = {str(proc.pid) for proc in self.procs}
+        for name in os.listdir("/dev/shm"):
+            m = re.match(r"ray_tpu_(?:chan_)?(\d+)_", name)
+            if m and m.group(1) in pids:
+                try:
+                    os.unlink(os.path.join("/dev/shm", name))
+                except OSError:
+                    pass
         self.procs.clear()
 
 

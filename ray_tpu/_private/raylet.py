@@ -850,9 +850,11 @@ class Raylet:
             store = self._host_peer_stores.get(src_path)
             if store is None:
                 # bounded cache: mapping a peer arena costs address space
-                # and pins its pages — keep at most 8, dropping the oldest
+                # and pins its pages — keep at most 8, dropping the OLDEST
+                # insertion (dict.popitem() would drop the newest)
                 while len(self._host_peer_stores) >= 8:
-                    _, old = self._host_peer_stores.popitem()
+                    oldest = next(iter(self._host_peer_stores))
+                    old = self._host_peer_stores.pop(oldest)
                     try:
                         old.close()
                     except Exception:
@@ -883,17 +885,25 @@ class Raylet:
         window = 4
         futs = collections.deque()
         off = 0
-        recv_off = 0
-        while recv_off < size:
+        received = 0
+        while received < size:
             while off < size and len(futs) < window:
                 n = min(CHUNK, size - off)
-                futs.append((off, await conn.request_send(
+                futs.append((off, n, await conn.request_send(
                     "fetch.read", {"oid": oid, "off": off, "len": n})))
                 off += n
-            coff, fut = futs.popleft()
+            coff, n, fut = futs.popleft()
             chunk = await fut
+            if not chunk:
+                raise OSError(f"empty fetch.read reply for {oid.hex()} at {coff}")
             buf[coff : coff + len(chunk)] = chunk
-            recv_off = coff + len(chunk)
+            received += len(chunk)
+            if len(chunk) < n:
+                # short reply: refetch the remainder at the corrected
+                # offset (defensive — the server sends full slices today,
+                # but sealing with an unwritten hole is silent corruption)
+                futs.appendleft((coff + len(chunk), n - len(chunk), await conn.request_send(
+                    "fetch.read", {"oid": oid, "off": coff + len(chunk), "len": n - len(chunk)})))
 
 
 async def _amain(args):

@@ -28,6 +28,18 @@ _BUF_HDR = struct.Struct("<Q")
 _ALIGN = 8
 
 
+def _resolve_dtype(name: str):
+    """np.dtype(name), with ml_dtypes registering bfloat16/fp8 names."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def _rebuild_jax_array(buf, dtype: str, shape):
     """Decode side of the device-array path: the host bytes are a
     zero-copy view of the arena; device_put DMAs straight from it onto
@@ -36,7 +48,7 @@ def _rebuild_jax_array(buf, dtype: str, shape):
     import jax
     import numpy as np
 
-    arr = np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape)
+    arr = np.frombuffer(buf, dtype=np.uint8).view(_resolve_dtype(dtype)).reshape(shape)
     from ray_tpu.util import device_arrays
 
     target = device_arrays.current_target_sharding()
@@ -50,16 +62,18 @@ def _reduce_jax_array(x):
     no-copy view on the cpu backend) carried out-of-band — the host
     bytes then write straight into the arena with no pickle-stream copy.
     The previous path let jax's own __reduce__ run inside cloudpickle,
-    which byte-copied the array through the pickle stream. SURVEY §2.4
-    bulk-transfer row: HBM-aware object path."""
+    which byte-copied the array through the pickle stream. The buffer
+    rides as a uint8 VIEW: PickleBuffer rejects extension dtypes
+    (bfloat16/fp8 — the dominant TPU dtypes), so the real dtype travels
+    by name. SURVEY §2.4 bulk-transfer row: HBM-aware object path."""
     import numpy as np
 
     host = np.asarray(x)
     if not host.flags.c_contiguous:
         host = np.ascontiguousarray(host)
     return _rebuild_jax_array, (
-        pickle.PickleBuffer(host),
-        host.dtype.str,
+        pickle.PickleBuffer(host.reshape(-1).view(np.uint8)),
+        host.dtype.name,
         host.shape,
     )
 

@@ -179,11 +179,14 @@ def from_huggingface(hf_dataset, parallelism: int = 8) -> Dataset:
     """A huggingface `datasets.Dataset` (or dict split) → Dataset, via its
     underlying arrow table — zero row-wise conversion (reference:
     read_api.from_huggingface)."""
-    if hasattr(hf_dataset, "items") and not hasattr(hf_dataset, "data"):
+    data = getattr(hf_dataset, "data", None)
+    if data is None or isinstance(data, dict):
+        # DatasetDict.data is a {split: table} dict — single splits only
         raise ValueError(
-            "from_huggingface takes a single split (e.g. ds['train']), got a DatasetDict"
+            "from_huggingface takes a single split (e.g. ds['train']), got "
+            f"{type(hf_dataset).__name__}"
         )
-    table = hf_dataset.data.table if hasattr(hf_dataset.data, "table") else hf_dataset.data
+    table = data.table if hasattr(data, "table") else data
     table = table.combine_chunks()
     n = table.num_rows
     k = max(1, min(parallelism, n or 1))

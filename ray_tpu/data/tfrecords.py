@@ -18,19 +18,29 @@ import numpy as np
 
 # ---------------------------------------------------------------- crc32c
 # Castagnoli CRC (the TFRecord checksum), table-driven.
-_CRC_TABLE = np.zeros(256, dtype=np.uint32)
+_CRC_TABLE = [0] * 256
 for _i in range(256):
     _c = _i
     for _ in range(8):
         _c = (0x82F63B78 ^ (_c >> 1)) if (_c & 1) else (_c >> 1)
     _CRC_TABLE[_i] = _c
 
+try:  # optional C accelerator when the image ships one
+    from crc32c import crc32c as _crc32c_accel  # type: ignore
+except ImportError:
+    _crc32c_accel = None
+
 
 def _crc32c(data: bytes) -> int:
+    if _crc32c_accel is not None:
+        return _crc32c_accel(data)
+    # pure-python fallback: plain bytes iteration over a list table
+    # (~10x the numpy-per-element version; still the write-path
+    # bottleneck for multi-GB datasets — ship crc32c for those)
     crc = 0xFFFFFFFF
     table = _CRC_TABLE
-    for b in np.frombuffer(data, dtype=np.uint8):
-        crc = int(table[(crc ^ int(b)) & 0xFF]) ^ (crc >> 8)
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
     return crc ^ 0xFFFFFFFF
 
 

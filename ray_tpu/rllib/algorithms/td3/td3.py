@@ -84,6 +84,16 @@ class TD3Learner(Learner):
         self._updates = 0
         self.td_errors = None
         module, cfg = self.module, config
+        # SEPARATE optimizers: on delay steps the actor's params AND its
+        # Adam state must hold still — a zero-grad step through one shared
+        # optimizer still moves the actor via first-moment momentum and
+        # advances its bias correction, defeating policy_delay
+        self._critic_opt = optax.adam(cfg.lr)
+        self._critic_opt_state = self._critic_opt.init(
+            {"q1": self.params["q1"], "q2": self.params["q2"]}
+        )
+        self._pi_opt = optax.adam(cfg.lr)
+        self._pi_opt_state = self._pi_opt.init(self.params["pi"])
 
         def _grads(params, target_params, batch, rng, with_actor: bool):
             # target policy smoothing: clipped noise on the target action
@@ -119,21 +129,29 @@ class TD3Learner(Learner):
             grads = {"pi": pi_g, "q1": cgrads["q1"], "q2": cgrads["q2"]}
             return grads, stats, td
 
-        def _apply(params, target_params, opt_state, grads, do_polyak: bool):
+        def _apply(params, target_params, c_state, p_state, grads, with_actor: bool):
             import optax as _optax
 
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = _optax.apply_updates(params, updates)
-            if do_polyak:
+            cupd, c_state = self._critic_opt.update(
+                {"q1": grads["q1"], "q2": grads["q2"]}, c_state,
+                {"q1": params["q1"], "q2": params["q2"]},
+            )
+            params = dict(
+                params,
+                q1=_optax.apply_updates(params["q1"], cupd["q1"]),
+                q2=_optax.apply_updates(params["q2"], cupd["q2"]),
+            )
+            if with_actor:
+                pupd, p_state = self._pi_opt.update(grads["pi"], p_state, params["pi"])
+                params = dict(params, pi=_optax.apply_updates(params["pi"], pupd))
+                # polyak rides with the (delayed) actor update, per TD3
                 target_params = jax.tree.map(
                     lambda t, p: (1.0 - cfg.tau) * t + cfg.tau * p, target_params, params
                 )
-            return params, target_params, opt_state
-
-        import functools
+            return params, target_params, c_state, p_state
 
         self._td3_grads = jax.jit(_grads, static_argnames="with_actor")
-        self._td3_apply = jax.jit(_apply, static_argnames="do_polyak")
+        self._td3_apply = jax.jit(_apply, static_argnames="with_actor")
         self._rng = jax.random.PRNGKey(config.seed + 47)
 
     def _with_actor(self) -> bool:
@@ -143,8 +161,11 @@ class TD3Learner(Learner):
         self._rng, key = jax.random.split(self._rng)
         wa = self._with_actor()
         grads, stats, td = self._td3_grads(self.params, self.target_params, batch, key, with_actor=wa)
-        self.params, self.target_params, self.opt_state = self._td3_apply(
-            self.params, self.target_params, self.opt_state, grads, do_polyak=wa
+        self.params, self.target_params, self._critic_opt_state, self._pi_opt_state = (
+            self._td3_apply(
+                self.params, self.target_params, self._critic_opt_state,
+                self._pi_opt_state, grads, with_actor=wa,
+            )
         )
         self.td_errors = np.asarray(td)
         self._updates += 1
@@ -164,8 +185,11 @@ class TD3Learner(Learner):
 
     def apply_grads(self, grads) -> None:
         wa = self._with_actor()
-        self.params, self.target_params, self.opt_state = self._td3_apply(
-            self.params, self.target_params, self.opt_state, grads, do_polyak=wa
+        self.params, self.target_params, self._critic_opt_state, self._pi_opt_state = (
+            self._td3_apply(
+                self.params, self.target_params, self._critic_opt_state,
+                self._pi_opt_state, grads, with_actor=wa,
+            )
         )
         self._updates += 1
 
@@ -173,12 +197,17 @@ class TD3Learner(Learner):
         state = super().get_state()
         state["target_params"] = self._jax.tree.map(np.asarray, self.target_params)
         state["updates"] = self._updates
+        state["critic_opt_state"] = self._jax.tree.map(np.asarray, self._critic_opt_state)
+        state["pi_opt_state"] = self._jax.tree.map(np.asarray, self._pi_opt_state)
         return state
 
     def set_state(self, state) -> None:
         super().set_state(state)
         self.target_params = self._jax.tree.map(np.asarray, state["target_params"])
         self._updates = state.get("updates", 0)
+        if "critic_opt_state" in state:
+            self._critic_opt_state = self._jax.tree.map(np.asarray, state["critic_opt_state"])
+            self._pi_opt_state = self._jax.tree.map(np.asarray, state["pi_opt_state"])
 
 
 class TD3Config(DQNConfig):

@@ -19,3 +19,11 @@ from ray_tpu.tune.search import (  # noqa: F401
 )
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner  # noqa: F401
 from ray_tpu.tune import schedulers  # noqa: F401
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    AsyncHyperBandScheduler,
+    HyperBandForBOHB,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PB2,
+    PopulationBasedTraining,
+)

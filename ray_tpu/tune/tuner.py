@@ -330,7 +330,9 @@ class Tuner:
             t.metrics = metrics
             if t.status in ("STOPPED", "TERMINATED", "ERROR"):
                 return
-            decision = scheduler.on_result(tid, metrics)
+            # model-based schedulers (PB2) need the trial's CONFIG with
+            # each observation; ride it on a copy so results stay clean
+            decision = scheduler.on_result(tid, {**metrics, "config": dict(t.config)})
             if decision == STOP:
                 t.status = "STOPPED"
                 entry = running.pop(tid, None)

@@ -282,3 +282,80 @@ def test_hyperband_brackets(ray_start_regular):
     results = tuner.fit()
     best = results.get_best_result()
     assert best.config["q"] == 1.0
+
+
+def test_pb2_model_based_explore(ray_start_regular, tmp_path):
+    """PB2: the bandit's explore step proposes configs from the fitted
+    reward model. On a problem where score accrues at rate -(lr-0.6)^2,
+    the exploited trial's new lr must come from the model (inside
+    bounds), and the population improves past its cold start."""
+    import os
+
+    from ray_tpu.air import Checkpoint, RunConfig
+    from ray_tpu.air.session import get_checkpoint
+    from ray_tpu.tune.schedulers import PB2
+
+    def train_fn(config):
+        import tempfile
+        import time as _t
+
+        start = 0.0
+        ckpt = get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "state.txt")) as f:
+                start = float(f.read())
+        value = start
+        for i in range(14):
+            value += 1.0 - (config["lr"] - 0.6) ** 2
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "state.txt"), "w") as f:
+                f.write(str(value))
+            tune.report({"score": value}, checkpoint=Checkpoint(d))
+            _t.sleep(0.6)
+
+    pb2 = PB2(
+        metric="score", mode="max", perturbation_interval=2,
+        hyperparam_bounds={"lr": (0.0, 1.0)}, seed=0,
+    )
+    tuner = Tuner(
+        train_fn,
+        param_space={"lr": tune.grid_search([0.05, 0.95])},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=1,
+                               scheduler=pb2, max_concurrent_trials=2),
+        run_config=RunConfig(storage_path=str(tmp_path), name="pb2"),
+    )
+    grid = tuner.fit()
+    # the model saw observations and every mutated lr stayed in bounds
+    assert len(pb2._obs_y) > 0, "PB2 recorded no (config, delta) observations"
+    for t in grid:
+        assert 0.0 <= t.config["lr"] <= 1.0
+    best = max(t.metrics.get("score", 0) for t in grid)
+    assert best > 9.0, f"PB2 population failed to improve: {best}"
+
+
+def test_bohb_pairing(ray_start_regular, tmp_path):
+    """HyperBandForBOHB + TPESearcher: model-based suggestions under
+    bracketed early stopping; bad trials stop early, the best survives."""
+    from ray_tpu.air import RunConfig
+    from ray_tpu.tune.schedulers import HyperBandForBOHB
+    from ray_tpu.tune.search import TPESearcher
+
+    def train_fn(config):
+        for i in range(9):
+            tune.report({"loss": (config["x"] - 0.3) ** 2 + 0.01 * i})
+
+    space = {"x": tune.uniform(0.0, 1.0)}
+    tuner = Tuner(
+        train_fn,
+        param_space=space,
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=10,
+            search_alg=TPESearcher(space, metric="loss", mode="min", seed=0),
+            scheduler=HyperBandForBOHB(metric="loss", mode="min", max_t=9),
+            max_concurrent_trials=2,
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path), name="bohb"),
+    )
+    grid = tuner.fit()
+    best = min(t.metrics["loss"] for t in grid if "loss" in t.metrics)
+    assert best < 0.3, f"BOHB run found nothing good: {best}"
