@@ -154,8 +154,14 @@ def bench_runtime(extra):
         t0 = time.perf_counter()
         jref = ray_tpu.put(xa)
         dt_jput = time.perf_counter() - t0
+        # decode onto the cpu device explicitly: the default device here
+        # is the TUNNELED TPU, and a 128 MiB host->tunnel DMA measures
+        # the tunnel, not the object path
+        from ray_tpu.util import device_arrays
+
         t0 = time.perf_counter()
-        jback = ray_tpu.get(jref)
+        with device_arrays.target_sharding(cpu0):
+            jback = ray_tpu.get(jref)
         jax.block_until_ready(jback)
         dt_jget = time.perf_counter() - t0
         extra["jax_put_gib_per_s"] = round(0.125 / dt_jput, 2)
@@ -248,6 +254,14 @@ def bench_runtime(extra):
     extra["actor_calls_async_nn"] = round(r, 1)
     log(f"[bench] n:n async actor calls: {r:.0f}/s (baseline {BASELINES['actor_calls_async_nn']:.0f})")
 
+    # retire every actor from the earlier sections before the task
+    # fan-out: ~10 idle actor processes' wakeup loops time-share the ONE
+    # core with the measurement (callers kill their nested echoes on exit)
+    for actor in [a, *pool, *putters, *callers]:
+        try:
+            ray_tpu.kill(actor)
+        except Exception:
+            pass
     _settle()
 
     @ray_tpu.remote

@@ -36,8 +36,8 @@ _DEFS: Dict[str, Any] = {
     # (on a 1-core box a SECOND leased worker is pure context-switch
     # overhead: measured 17.0k vs 10.0k noop tasks/s with 1 vs 2 leases)
     "max_leases_per_shape": max(1, os.cpu_count() or 4),
-    "actor_call_batch_max": 64,  # pipelined actor calls coalesced per wire message
-    "direct_task_batch_max": 64,  # direct-path tasks coalesced per wire message
+    "actor_call_batch_max": 128,  # pipelined actor calls coalesced per wire message
+    "direct_task_batch_max": 128,  # direct-path tasks coalesced per wire message
     "worker_pool_prestart": 2,
     "worker_pool_max_idle": 8,
     "scheduler_spread_threshold": 0.5,

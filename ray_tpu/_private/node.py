@@ -186,7 +186,23 @@ class NodeProcesses:
         pids = {str(proc.pid) for proc in self.procs}
         for name in os.listdir("/dev/shm"):
             m = re.match(r"ray_tpu_(?:chan_)?(\d+)_", name)
-            if m and m.group(1) in pids:
+            if not m:
+                continue
+            pid_s = m.group(1)
+            if pid_s in pids:
+                dead = True  # our child, already reaped above
+            else:
+                # chan files embed their CREATOR's pid (often a worker or
+                # the driver, never in self.procs): sweep them only once
+                # that process is actually gone
+                try:
+                    os.kill(int(pid_s), 0)
+                    dead = False
+                except ProcessLookupError:
+                    dead = True
+                except (PermissionError, OverflowError, ValueError):
+                    dead = False
+            if dead:
                 try:
                     os.unlink(os.path.join("/dev/shm", name))
                 except OSError:

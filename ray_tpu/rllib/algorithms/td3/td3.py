@@ -195,6 +195,9 @@ class TD3Learner(Learner):
 
     def get_state(self):
         state = super().get_state()
+        # the base Learner's shared optimizer is unused here: dropping its
+        # (never-updated) Adam state halves checkpoint size
+        state.pop("opt_state", None)
         state["target_params"] = self._jax.tree.map(np.asarray, self.target_params)
         state["updates"] = self._updates
         state["critic_opt_state"] = self._jax.tree.map(np.asarray, self._critic_opt_state)

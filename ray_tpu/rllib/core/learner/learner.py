@@ -149,6 +149,8 @@ class Learner:
 
     def set_state(self, state) -> None:
         self.set_weights(state["params"])
-        self.opt_state = self._jax.tree.map(np.asarray, state["opt_state"])
+        if state.get("opt_state") is not None:
+            # learners with their OWN optimizers (TD3) drop this key
+            self.opt_state = self._jax.tree.map(np.asarray, state["opt_state"])
 
 

@@ -305,7 +305,13 @@ class PB2(PopulationBasedTraining):
         if value is not None:
             cfg = result.get("config") or {}
             self.observe(cfg, trial_id, float(value))
-        return super().on_result(trial_id, result)
+        decision = super().on_result(trial_id, result)
+        if isinstance(decision, tuple) and decision[0] == EXPLOIT:
+            # the trial restarts from the WINNER's checkpoint: its next
+            # report jumps by the checkpoint difference, which must not be
+            # recorded as this (new) config's reward delta
+            self._last_score.pop(trial_id, None)
+        return decision
 
 
 class HyperBandForBOHB(HyperBandScheduler):
