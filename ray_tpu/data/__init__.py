@@ -22,6 +22,7 @@ from ray_tpu.data.read_api import (  # noqa: F401
     from_numpy,
     from_pandas,
     range,
+    read_sql,
     read_tfrecords,
     read_csv,
     read_json,

@@ -166,6 +166,59 @@ def read_binary_files(paths, **kw) -> Dataset:
     return Dataset([LazyBlock(lambda p=p: _read_binary.remote(p)) for p in _expand(paths)])
 
 
+@ray_tpu.remote
+def _read_sql_shard(connection_factory, sql: str, shard: Optional[int], num_shards: int):
+    conn = connection_factory()
+    try:
+        cur = conn.cursor()
+        cur.execute(sql)
+        cols = [d[0] for d in cur.description]
+        if shard is None:
+            rows = [dict(zip(cols, r)) for r in cur.fetchall()]
+        else:
+            # stream with fetchmany and keep only this shard's stride —
+            # the full result never materializes in the task
+            rows = []
+            i = 0
+            while True:
+                chunk = cur.fetchmany(4096)
+                if not chunk:
+                    break
+                for r in chunk:
+                    if i % num_shards == shard:
+                        rows.append(dict(zip(cols, r)))
+                    i += 1
+    finally:
+        conn.close()
+    return B.to_block(rows)
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = 1) -> Dataset:
+    """Rows of a SQL query → Dataset (reference:
+    python/ray/data/read_api.py read_sql — same shape: a picklable
+    zero-arg `connection_factory` makes a DB-API connection inside each
+    task, so credentials/drivers live with the task, not the driver).
+
+    parallelism > 1 runs the query once PER SHARD and row-strides the
+    results, so it requires a deterministic result order (an ORDER BY) —
+    without one, engines may return different orderings per execution and
+    stride-sharding would duplicate/drop rows. It divides decode work and
+    per-task memory (results stream via fetchmany), NOT database work."""
+    n = max(1, parallelism)
+    if n == 1:
+        return Dataset([LazyBlock(lambda: _read_sql_shard.remote(connection_factory, sql, None, 1))])
+    if "order by" not in sql.lower():
+        raise ValueError(
+            "read_sql with parallelism > 1 needs an ORDER BY in the query: "
+            "each shard re-executes it and strides the rows, which is only "
+            "correct when the result order is deterministic"
+        )
+    return Dataset([
+        LazyBlock(lambda i=i: _read_sql_shard.remote(connection_factory, sql, i, n))
+        for i in builtins.range(n)
+    ])
+
+
 def read_tfrecords(paths, *, verify_crc: bool = False, **kw) -> Dataset:
     """TFRecord files of tf.train.Example records → rows (reference:
     data/datasource/tfrecords_datasource.py). One task per file; no

@@ -10,8 +10,15 @@ lockstep pytree averaging across learner actors on separate hosts).
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.bandits import (  # noqa: F401
+    LinTS,
+    LinTSConfig,
+    LinUCB,
+    LinUCBConfig,
+)
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.es import ES, ESConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.td3 import TD3, TD3Config  # noqa: F401
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig  # noqa: F401

@@ -1,0 +1,6 @@
+from ray_tpu.rllib.algorithms.bandits.bandits import (  # noqa: F401
+    LinTS,
+    LinTSConfig,
+    LinUCB,
+    LinUCBConfig,
+)

@@ -1,0 +1,120 @@
+"""Contextual bandits: LinUCB and linear Thompson sampling.
+
+Equivalent of the reference's bandit algorithms
+(reference: rllib/algorithms/bandit/bandit.py — BanditLinUCB /
+BanditLinTS over per-arm linear models). Closed-form ridge posteriors
+per arm; no env runners or replay — train() consumes batches of
+(context, arm, reward) either from an attached offline dataset or from
+an interactive `learn_one` loop.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class _LinearArm:
+    """Ridge posterior for one arm: A = X'X + lam*I, b = X'y."""
+
+    def __init__(self, dim: int, lam: float = 1.0):
+        self.A = np.eye(dim) * lam
+        self.b = np.zeros(dim)
+        self._dirty = True
+        self._Ainv = np.linalg.inv(self.A)
+
+    def update(self, x: np.ndarray, reward: float):
+        self.A += np.outer(x, x)
+        self.b += reward * x
+        self._dirty = True
+
+    @property
+    def Ainv(self) -> np.ndarray:
+        if self._dirty:
+            self._Ainv = np.linalg.inv(self.A)
+            self._dirty = False
+        return self._Ainv
+
+    @property
+    def theta(self) -> np.ndarray:
+        return self.Ainv @ self.b
+
+
+class _BanditBase:
+    def __init__(self, num_arms: int, context_dim: int, lam: float = 1.0,
+                 seed: Optional[int] = None):
+        self.num_arms = num_arms
+        self.context_dim = context_dim
+        self.arms = [_LinearArm(context_dim, lam) for _ in range(num_arms)]
+        self._rng = np.random.default_rng(seed)
+        self._steps = 0
+        self._cum_reward = 0.0
+
+    def learn_one(self, context, arm: int, reward: float) -> None:
+        self.arms[arm].update(np.asarray(context, np.float64), float(reward))
+        self._steps += 1
+        self._cum_reward += float(reward)
+
+    def train_batch(self, batch: Dict[str, Any]) -> Dict[str, float]:
+        ctx = np.asarray(batch["context"], np.float64)
+        arms = np.asarray(batch["arm"], np.int64)
+        rew = np.asarray(batch["reward"], np.float64)
+        for x, a, r in zip(ctx, arms, rew):
+            self.learn_one(x, int(a), float(r))
+        return {"steps": float(self._steps), "mean_reward": self._cum_reward / max(1, self._steps)}
+
+    def stats(self) -> Dict[str, float]:
+        return {"steps": float(self._steps),
+                "mean_reward": self._cum_reward / max(1, self._steps)}
+
+
+class LinUCBConfig:
+    def __init__(self, num_arms: int, context_dim: int, alpha: float = 1.0,
+                 lam: float = 1.0, seed: Optional[int] = None):
+        self.num_arms, self.context_dim = num_arms, context_dim
+        self.alpha, self.lam, self.seed = alpha, lam, seed
+
+    def build(self) -> "LinUCB":
+        return LinUCB(self)
+
+
+class LinUCB(_BanditBase):
+    """Deterministic optimism: pick argmax theta'x + alpha*sqrt(x'Ainv x)."""
+
+    def __init__(self, config: LinUCBConfig):
+        super().__init__(config.num_arms, config.context_dim, config.lam, config.seed)
+        self.alpha = config.alpha
+
+    def select_arm(self, context) -> int:
+        x = np.asarray(context, np.float64)
+        scores = [
+            float(arm.theta @ x + self.alpha * np.sqrt(max(x @ arm.Ainv @ x, 0.0)))
+            for arm in self.arms
+        ]
+        return int(np.argmax(scores))
+
+
+class LinTSConfig:
+    def __init__(self, num_arms: int, context_dim: int, v: float = 0.5,
+                 lam: float = 1.0, seed: Optional[int] = None):
+        self.num_arms, self.context_dim = num_arms, context_dim
+        self.v, self.lam, self.seed = v, lam, seed
+
+    def build(self) -> "LinTS":
+        return LinTS(self)
+
+
+class LinTS(_BanditBase):
+    """Thompson sampling: draw theta ~ N(theta_hat, v^2 Ainv) per arm."""
+
+    def __init__(self, config: LinTSConfig):
+        super().__init__(config.num_arms, config.context_dim, config.lam, config.seed)
+        self.v = config.v
+
+    def select_arm(self, context) -> int:
+        x = np.asarray(context, np.float64)
+        scores = []
+        for arm in self.arms:
+            sample = self._rng.multivariate_normal(arm.theta, self.v**2 * arm.Ainv)
+            scores.append(float(sample @ x))
+        return int(np.argmax(scores))

@@ -1,0 +1,176 @@
+"""ES — OpenAI-style evolution strategies.
+
+Equivalent of the reference's ES (reference: rllib/algorithms/es/es.py —
+perturb the policy with antithetic Gaussian noise, evaluate episodes on
+parallel workers, recombine by rank-weighted noise average). A natural
+fit for the task fan-out: each perturbation evaluates as ONE task; the
+driver holds the flat parameter vector and the mirrored-sampling
+recombination is a couple of numpy lines. No backprop anywhere.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+from ray_tpu.rllib.utils.env import env_spaces
+
+import ray_tpu
+
+
+def _flatten(params) -> np.ndarray:
+    import jax
+
+    leaves = jax.tree.leaves(params)
+    return np.concatenate([np.asarray(l, np.float32).ravel() for l in leaves])
+
+
+def _unflatten(flat: np.ndarray, params):
+    import jax
+
+    leaves, treedef = jax.tree.flatten(params)
+    out, off = [], 0
+    for l in leaves:
+        n = int(np.prod(l.shape)) if l.shape else 1
+        out.append(flat[off : off + n].reshape(l.shape).astype(np.float32))
+        off += n
+    return jax.tree.unflatten(treedef, out)
+
+
+@ray_tpu.remote
+def _es_rollout(module_blob, flat_params, env_name, env_config, seed: int, episodes: int):
+    """Evaluate one perturbed policy: greedy episodes; returns
+    (mean return, env steps taken)."""
+    import gymnasium as gym
+    import jax.numpy as jnp
+    import numpy as _np
+    import pickle
+
+    module, template = pickle.loads(module_blob)
+    params = _unflatten(_np.asarray(flat_params, _np.float32), template)
+    env = gym.make(env_name, **(env_config or {}))
+    total = 0.0
+    steps = 0
+    for ep in range(episodes):
+        obs, _ = env.reset(seed=seed + ep)
+        done = False
+        while not done:
+            logits = module.forward(params, jnp.asarray(obs, jnp.float32)[None])["logits"]
+            action = int(jnp.argmax(logits, axis=-1)[0])
+            obs, r, term, trunc, _ = env.step(action)
+            total += float(r)
+            steps += 1
+            done = term or trunc
+    env.close()
+    return total / episodes, steps
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.module_class = DiscreteMLPModule
+        self.model_config = {"hidden": (32, 32)}
+        self.population = 32         # perturbation PAIRS (antithetic)
+        self.noise_std = 0.05
+        self.es_lr = 0.03
+        self.episodes_per_eval = 1
+        self.l2_coeff = 0.005
+
+
+class ES(Algorithm):
+    config_class = ESConfig
+
+    def __init__(self, config):
+        self.config = config
+        self.env_runner_group = None
+        self._spaces = env_spaces(config)
+        self.learner_group = None
+        self._iteration = 0
+        self._weights_seq = 0
+        self._env_steps_lifetime = 0
+        self._recent_returns: list = []
+        import jax
+        import pickle
+
+        self.module = config.build_module(*self._spaces)
+        self._template = self.module.init_params(jax.random.PRNGKey(config.seed))
+        self.theta = _flatten(self._template)
+        self._module_blob = ray_tpu.put(pickle.dumps((self.module, self._template)))
+        self._rng = np.random.default_rng(config.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n, std = cfg.population, cfg.noise_std
+        eps = self._rng.standard_normal((n, len(self.theta))).astype(np.float32)
+        refs = []
+        for i in range(n):  # antithetic pairs: +eps and -eps
+            for sign in (1.0, -1.0):
+                refs.append(_es_rollout.remote(
+                    self._module_blob, self.theta + sign * std * eps[i],
+                    cfg.env, cfg.env_config,
+                    seed=int(self._rng.integers(1 << 30)),
+                    episodes=cfg.episodes_per_eval,
+                ))
+        results = ray_tpu.get(refs)
+        returns = np.asarray([r for r, _ in results], np.float32).reshape(n, 2)
+        env_steps = int(sum(s for _, s in results))
+        # rank-shaped mirrored-sampling gradient estimate (reference:
+        # es.py utils — centered ranks tame outlier episodes)
+        diffs = returns[:, 0] - returns[:, 1]
+        ranks = np.argsort(np.argsort(diffs)).astype(np.float32)
+        shaped = ranks / max(1, n - 1) - 0.5
+        grad = (shaped[:, None] * eps).mean(axis=0) / std
+        self.theta = (1.0 - cfg.l2_coeff * cfg.es_lr) * self.theta + cfg.es_lr * grad
+        best = float(returns.max())
+        mean = float(returns.mean())
+        # NOTE: Algorithm.train() owns the _iteration increment
+        self._env_steps_lifetime += env_steps
+        return {
+            "episode_return_mean": mean,
+            "episode_return_best": best,
+            "num_evaluations": int(returns.size),
+            "num_env_steps": env_steps,
+        }
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax.numpy as jnp
+
+        params = _unflatten(self.theta, self._template)
+        logits = self.module.forward(params, jnp.asarray(obs, jnp.float32)[None])["logits"]
+        return int(jnp.argmax(logits, axis=-1)[0])
+
+    def save_to_path(self, path: str) -> str:
+        import os
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        state = {
+            "config": self.config,
+            "theta": np.asarray(self.theta),
+            "iteration": self._iteration,
+            "env_steps_lifetime": self._env_steps_lifetime,
+        }
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return path
+
+    @classmethod
+    def from_checkpoint(cls, path: str) -> "ES":
+        import os
+        import pickle
+
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        algo = state["config"].algo_class(state["config"])
+        algo.theta = np.asarray(state["theta"])
+        algo._iteration = state["iteration"]
+        algo._env_steps_lifetime = state["env_steps_lifetime"]
+        return algo
+
+    def stop(self) -> None:
+        pass
+
+
+ESConfig.algo_class = ES

@@ -93,3 +93,40 @@ def test_from_huggingface(ray_start_regular):
            ds.map_batches(lambda b: {"label": b["label"], "r": b["label"] % 2})
              .groupby("r").sum("label").take_all()}
     assert agg[0] == sum(i for i in range(40) if i % 2 == 0)
+
+
+def test_read_sql_sqlite(ray_start_regular, tmp_path):
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE items (id INTEGER, name TEXT, v REAL)")
+    conn.executemany(
+        "INSERT INTO items VALUES (?, ?, ?)",
+        [(i, f"n{i}", i * 0.5) for i in range(40)],
+    )
+    conn.commit()
+    conn.close()
+
+    def factory(db=db):
+        import sqlite3 as s
+
+        return s.connect(db)
+
+    ds = rd.read_sql("SELECT * FROM items", factory)
+    assert ds.count() == 40
+    rows = sorted(ds.take_all(), key=lambda r: r["id"])
+    assert rows[7] == {"id": 7, "name": "n7", "v": 3.5}
+
+    # parallelism requires a deterministic order
+    import pytest as _pt
+
+    with _pt.raises(ValueError, match="ORDER BY"):
+        rd.read_sql("SELECT id FROM items", factory, parallelism=3)
+    sharded = rd.read_sql(
+        "SELECT id, v FROM items WHERE id < 20 ORDER BY id", factory, parallelism=3
+    )
+    assert sharded.num_blocks() == 3
+    assert sorted(r["id"] for r in sharded.take_all()) == list(range(20))
+    total = {r["id"]: r["v_sum"] for r in sharded.groupby("id").sum("v").take_all()}
+    assert total[3] == 1.5
