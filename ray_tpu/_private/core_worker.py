@@ -1351,7 +1351,7 @@ class CoreWorker:
                         # owner keeps its primary-copy pin until its refs
                         # die (or a spill notice releases it)
                         self._pinned[oid] = buf
-                        return serialization.from_buffer(buf.view, zero_copy=True)
+                        return serialization.from_buffer(buf.view, zero_copy=True, owner=buf)
                     # BORROWED object (task arg in a worker): no ObjectRef
                     # tracks this access — tie the pin to the VALUE instead:
                     # deserialize first (views now export the buffer), then
@@ -1360,11 +1360,11 @@ class CoreWorker:
                     # refcount the moment the value dies. Without this,
                     # every block a worker ever read stayed pinned for the
                     # worker's lifetime (the consumed-block arena leak).
-                    value = serialization.from_buffer(buf.view, zero_copy=True)
+                    value = serialization.from_buffer(buf.view, zero_copy=True, owner=buf)
                     with self._store_lock:
                         self._release_retry.append(buf)
                     return value
-                return serialization.from_buffer(buf.view, zero_copy=True)
+                return serialization.from_buffer(buf.view, zero_copy=True, owner=buf)
             # no local arena (remote driver) — chunk-fetch from the raylet
             # that has it (reference: object_manager Pull into a client
             # without a local store)

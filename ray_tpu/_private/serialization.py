@@ -200,9 +200,15 @@ def to_bytes(value: Any) -> Tuple[bytes, List[ObjectRef]]:
     return bytes(out[:n]), refs
 
 
-def from_buffer(buf: memoryview, zero_copy: bool = True) -> Any:
+def from_buffer(buf: memoryview, zero_copy: bool = True, owner=None) -> Any:
     """Deserialize the wire format. With zero_copy=True the returned numpy
-    arrays alias `buf` (valid while the underlying mapping is pinned)."""
+    arrays alias `buf` (valid while the underlying mapping is pinned).
+
+    `owner` is the pinning ShmBuffer when `buf` is an arena mapping:
+    out-of-band views are then registered slices wrapped in PickleBuffer,
+    so consumers' buffer exports land where owner.try_release can SEE
+    them. Without this, numpy re-exports from the ctypes base and the pin
+    releases under live readers (arena slot reuse → torn/aliased data)."""
     import io
 
     n_buffers, pickle_len = _HDR.unpack_from(buf, 0)
@@ -221,8 +227,12 @@ def from_buffer(buf: memoryview, zero_copy: bool = True) -> Any:
         off = _aligned(off)
         (blen,) = _BUF_HDR.unpack_from(buf, off)
         off += _BUF_HDR.size
-        view = buf[off : off + blen]
-        oob.append(view if zero_copy else bytearray(view))
+        if not zero_copy:
+            oob.append(bytearray(buf[off : off + blen]))
+        elif owner is not None:
+            oob.append(pickle.PickleBuffer(owner.consumer_slice(off, off + blen)))
+        else:
+            oob.append(buf[off : off + blen])
         off += blen
     return _Unpickler(io.BytesIO(pickled), oob).load()
 

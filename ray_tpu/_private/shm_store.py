@@ -17,7 +17,17 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 from typing import Optional
+
+
+def _release_quietly(mv) -> bool:
+    """True if the memoryview released (no live exports)."""
+    try:
+        mv.release()
+        return True
+    except BufferError:
+        return False
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "src", "shm_store.cc")
 
@@ -103,6 +113,32 @@ class ShmBuffer:
         self._raw = (ctypes.c_char * size).from_address(address)
         self.view = memoryview(self._raw).cast("B")
         self.size = size
+        # every slice HANDED to zero-copy consumers (serialization
+        # records them via consumer_slice) — the liveness signal lives on
+        # these, NOT on self.view: consumers of a ctypes-backed
+        # memoryview re-export from the ctypes object, so releasing
+        # self.view never raises BufferError even with live numpy/arrow
+        # readers (the root cause of slot-reuse-under-reader corruption).
+        # All _handed access is under _lock: reader threads append while
+        # gc/spill paths sweep — an unlocked list rebind would drop a
+        # registration and resurrect the very corruption this fixes.
+        self._handed: list = []
+        self._lock = threading.Lock()
+
+    def consumer_slice(self, start: int, stop: int):
+        """A sub-view for a zero-copy consumer, registered so
+        try_release can see the consumer's export (wrap it in a
+        PickleBuffer before handing to numpy — np.frombuffer on a bare
+        memoryview re-exports from the BASE object and bypasses the
+        slice's export count)."""
+        s = self.view[start:stop]
+        with self._lock:
+            if len(self._handed) >= 16:
+                # opportunistic prune: repeated decodes of a long-pinned
+                # buffer would otherwise accumulate dead slices forever
+                self._handed = [h for h in self._handed if not _release_quietly(h)]
+            self._handed.append(s)
+        return s
 
     def release(self):
         if not self._released:
@@ -111,16 +147,21 @@ class ShmBuffer:
             self._store.release(self._object_id)
 
     def try_release(self) -> bool:
-        """Release unless zero-copy consumers (numpy views) still export
-        the buffer — memoryview.release() raises BufferError then, which
-        is exactly the liveness signal we need."""
+        """Release unless zero-copy consumers still export one of the
+        handed slices — their release() raises BufferError then, which
+        is the liveness signal."""
         if self._released:
             return True
-        try:
-            self.view.release()
-        except BufferError:
-            return False
-        self._released = True
+        with self._lock:
+            alive = [s for s in self._handed if not _release_quietly(s)]
+            self._handed = alive
+            if alive:
+                return False
+            try:
+                self.view.release()
+            except BufferError:
+                return False
+            self._released = True
         self._store.release(self._object_id)
         return True
 

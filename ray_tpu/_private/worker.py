@@ -88,7 +88,13 @@ class Worker:
                 self.session_dir = session_dir
                 node_info = self._discover_local_node(session_dir)
             else:
-                # tcp address "host:port" of a remote GCS
+                # remote cluster: "host:port" / "tcp:host:port" /
+                # "ray://host:port" (the reference's Ray Client URI — no
+                # separate proxy server here: a driver is ALREADY a socket
+                # client of the GCS, so client mode is just a driver with
+                # no local arena; objects chunk-fetch through the raylets)
+                if address.startswith("ray://"):
+                    address = address[len("ray://"):]
                 gcs_addr = address if address.startswith("tcp:") else f"tcp:{address}"
                 self.session_dir = node_mod.new_session_dir()
                 node_info = None

@@ -207,7 +207,9 @@ def read_sql(sql: str, connection_factory, *, parallelism: int = 1) -> Dataset:
     n = max(1, parallelism)
     if n == 1:
         return Dataset([LazyBlock(lambda: _read_sql_shard.remote(connection_factory, sql, None, 1))])
-    if "order by" not in sql.lower():
+    import re
+
+    if not re.search(r"order\s+by", sql, re.IGNORECASE):
         raise ValueError(
             "read_sql with parallelism > 1 needs an ORDER BY in the query: "
             "each shard re-executes it and strides the rows, which is only "

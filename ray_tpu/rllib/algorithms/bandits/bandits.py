@@ -20,8 +20,8 @@ class _LinearArm:
     def __init__(self, dim: int, lam: float = 1.0):
         self.A = np.eye(dim) * lam
         self.b = np.zeros(dim)
-        self._dirty = True
-        self._Ainv = np.linalg.inv(self.A)
+        self._dirty = False
+        self._Ainv = np.eye(dim) / lam  # (lam*I)^-1 in closed form
 
     def update(self, x: np.ndarray, reward: float):
         self.A += np.outer(x, x)

@@ -1,0 +1,64 @@
+"""Remote interactive driver — the Ray Client capability (reference:
+python/ray/util/client/ — a gRPC proxy there). Here a client is just a
+driver with no local arena: it connects to the cluster's GCS over tcp
+with a ray:// URI, and object reads chunk-fetch through the raylets."""
+import os
+import subprocess
+import sys
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+_CLIENT = r"""
+import sys
+import numpy as np
+import ray_tpu
+
+ray_tpu.init(address=sys.argv[1])
+
+@ray_tpu.remote
+def square(x):
+    return x * x
+
+assert ray_tpu.get([square.remote(i) for i in range(10)]) == [i * i for i in range(10)]
+
+@ray_tpu.remote
+class Acc:
+    def __init__(self):
+        self.v = 0
+    def add(self, x):
+        self.v += x
+        return self.v
+
+a = Acc.remote()
+assert ray_tpu.get(a.add.remote(5)) == 5
+assert ray_tpu.get(a.add.remote(7)) == 12
+
+# a LARGE object (beyond inline) fetched into the storeless client
+big = ray_tpu.get(square.options(name="big").remote(np.arange(200_000)))
+assert big.shape == (200_000,) and int(big[7]) == 49
+ray_tpu.kill(a)
+ray_tpu.shutdown()
+print("CLIENT_OK")
+"""
+
+
+def test_ray_client_uri_remote_driver():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    c.connect()
+    try:
+        with open(os.path.join(c.procs.session_dir, "gcs_address")) as f:
+            tcp = next(l for l in f.read().splitlines() if l.startswith("tcp:"))
+        port = tcp.rsplit(":", 1)[1]
+        uri = f"ray://127.0.0.1:{port}"  # the GCS binds 0.0.0.0
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-c", _CLIENT, uri],
+            env=env, capture_output=True, text=True, timeout=180,
+        )
+        assert proc.returncode == 0, f"client failed:\n{proc.stdout}\n{proc.stderr}"
+        assert "CLIENT_OK" in proc.stdout
+    finally:
+        c.shutdown()

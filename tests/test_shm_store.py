@@ -112,3 +112,35 @@ def test_cross_process_blocking_get(store):
     store.put_bytes(oid, b"z" * 12345)
     out, _ = p.communicate(timeout=30)
     assert "LEN 12345" in out
+
+
+def test_zero_copy_views_pin_under_pressure(ray_start_regular):
+    """The liveness signal for zero-copy reads must live on the handed
+    slices: a decoded value keeps its arena slot pinned even after its
+    ObjectRef dies and allocation pressure churns the arena (regression:
+    ctypes-backed memoryview.release never raised BufferError, so pins
+    released under live numpy readers and slots were reused — torn
+    batches in the streaming executor)."""
+    import numpy as np
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def make(i):
+        return np.full(64 * 1024, i, np.float64)  # 512 KiB
+
+    ref = make.remote(7)
+    arr = ray_tpu.get(ref)          # zero-copy view into the arena
+    del ref                          # owner pin may now be released...
+    import gc
+
+    gc.collect()
+    # ...but the VALUE must keep the slot alive: churn the arena hard
+    churn = [ray_tpu.put(np.full(256 * 1024, k, np.float64)) for k in range(40)]
+    for c in churn:
+        ray_tpu.get(c)
+    del churn
+    assert bool((arr == 7).all()), "zero-copy view torn by arena reuse"
+    # and once the value dies the slot becomes reclaimable again (the
+    # sweep releases it — no permanent leak)
+    del arr
