@@ -132,10 +132,14 @@ def bench_runtime(extra):
 
     putters = [Putter.remote() for _ in range(2)]
     ray_tpu.get([p.put_n.remote(1) for p in putters])
-    t0 = time.perf_counter()
     n_each = 12
-    ray_tpu.get([p.put_n.remote(n_each) for p in putters])
-    mc_gib = 2 * n_each * 8 * 1024 * 1024 / (1 << 30) / (time.perf_counter() - t0)
+    mc_gib = 0.0
+    for _ in range(3):  # best-of-3, like the single-client section
+        t0 = time.perf_counter()
+        ray_tpu.get([p.put_n.remote(n_each) for p in putters])
+        mc_gib = max(
+            mc_gib, 2 * n_each * 8 * 1024 * 1024 / (1 << 30) / (time.perf_counter() - t0)
+        )
     extra["multi_client_put_gib_per_s"] = round(mc_gib, 2)
     log(f"[bench] multi-client put bandwidth (2 clients): {mc_gib:.2f} GiB/s")
 

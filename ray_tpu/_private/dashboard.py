@@ -92,6 +92,121 @@ async def start_dashboard(gcs, port: int) -> Optional[str]:
         text = await gcs._rpc_metrics_text({}, None)
         return web.Response(text=text, content_type="text/plain")
 
+    # ---- REST job submission (reference: dashboard/modules/job/job_head.py
+    # — POST /api/jobs/, GET /api/jobs/{id}, /logs, POST /stop). The GCS
+    # process is not a ray driver, so mutations run through a short-lived
+    # helper driver (`job_submission._rest_helper`) connected to this
+    # session; reads come straight from the KV.
+    import asyncio
+    import os
+    import sys
+    import uuid as _uuid
+
+    async def _job_record(job_id: str):
+        blob = await gcs._rpc_kv_get({"ns": "job_submission", "key": job_id}, None)
+        return json.loads(blob) if blob else None
+
+    async def _run_helper(*args: str) -> int:
+        # the helper must import ray_tpu even when the GCS got it via
+        # sys.path manipulation rather than an inherited PYTHONPATH
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("RAY_TPU_WORKER_ID", None)
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "ray_tpu.job_submission._rest_helper",
+            gcs.session_dir, *args, env=env,
+            stdout=asyncio.subprocess.DEVNULL, stderr=asyncio.subprocess.DEVNULL,
+        )
+        return await proc.wait()
+
+    async def api_jobs_submit(request):
+        try:
+            body = await request.json()
+        except Exception:
+            return web.Response(status=400, text="invalid JSON body")
+        entrypoint = body.get("entrypoint")
+        if not entrypoint:
+            return web.Response(status=400, text="missing 'entrypoint'")
+        job_id = body.get("job_id") or body.get("submission_id") or f"raysubmit_{_uuid.uuid4().hex[:12]}"
+        payload = json.dumps({
+            "job_id": job_id,
+            "entrypoint": entrypoint,
+            "env_vars": (body.get("runtime_env") or {}).get("env_vars", {}),
+            "working_dir": (body.get("runtime_env") or {}).get("working_dir"),
+        })
+        rc = await _run_helper("submit", payload)
+        if rc != 0:
+            return web.Response(status=500, text=f"submission helper failed (rc={rc})")
+        for _ in range(150):
+            if await _job_record(job_id) is not None:
+                return await _json({"job_id": job_id, "submission_id": job_id})
+            await asyncio.sleep(0.2)
+        return web.Response(status=500, text="job supervisor did not start")
+
+    async def api_job_get(request):
+        rec = await _job_record(request.match_info["job_id"])
+        if rec is None:
+            return web.Response(status=404, text="no such job")
+        return await _json(rec)
+
+    async def api_job_logs(request):
+        rec = await _job_record(request.match_info["job_id"])
+        if rec is None:
+            return web.Response(status=404, text="no such job")
+        path = rec.get("log_path", "")
+        text = ""
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                text = f.read().decode(errors="replace")
+        return await _json({"logs": text})
+
+    async def api_logs_index(request):
+        """List session log files (reference: dashboard/modules/log —
+        per-node log listing; one session dir here)."""
+        logdir = os.path.join(gcs.session_dir, "logs")
+        files = []
+        if os.path.isdir(logdir):
+            for name in sorted(os.listdir(logdir)):
+                p = os.path.join(logdir, name)
+                if os.path.isfile(p):
+                    files.append({"name": name, "size": os.path.getsize(p)})
+        return await _json(files)
+
+    async def api_log_tail(request):
+        name = request.match_info["name"]
+        if "/" in name or ".." in name:
+            return web.Response(status=400, text="bad log name")
+        path = os.path.join(gcs.session_dir, "logs", name)
+        if not os.path.isfile(path):
+            return web.Response(status=404, text="no such log")
+        try:
+            nbytes = int(request.query.get("tail", 65536))
+        except ValueError:
+            return web.Response(status=400, text="bad tail value")
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            f.seek(max(0, size - nbytes))
+            data = f.read()
+        return web.Response(text=data.decode(errors="replace"), content_type="text/plain")
+
+    async def api_submissions(request):
+        keys = await gcs._rpc_kv_keys({"ns": "job_submission", "prefix": ""}, None)
+        recs = []
+        for k in keys:
+            rec = await _job_record(k)
+            if rec:
+                recs.append(rec)
+        return await _json(recs)
+
+    async def api_job_stop(request):
+        job_id = request.match_info["job_id"]
+        if await _job_record(job_id) is None:
+            return web.Response(status=404, text="no such job")
+        rc = await _run_helper("stop", job_id)
+        return await _json({"stopped": rc == 0})
+
     app = web.Application()
     app.router.add_get("/", index)
     app.router.add_get("/api/nodes", api_nodes)
@@ -101,6 +216,13 @@ async def start_dashboard(gcs, port: int) -> Optional[str]:
     app.router.add_get("/api/objects", api_objects)
     app.router.add_get("/api/placement_groups", api_pgs)
     app.router.add_get("/api/cluster", api_cluster)
+    app.router.add_post("/api/jobs/", api_jobs_submit)
+    app.router.add_get("/api/submissions", api_submissions)
+    app.router.add_get("/api/logs", api_logs_index)
+    app.router.add_get("/api/logs/{name}", api_log_tail)
+    app.router.add_get("/api/jobs/{job_id}", api_job_get)
+    app.router.add_get("/api/jobs/{job_id}/logs", api_job_logs)
+    app.router.add_post("/api/jobs/{job_id}/stop", api_job_stop)
     app.router.add_get("/metrics", metrics)
     runner = web.AppRunner(app, access_log=None)
     await runner.setup()

@@ -1,0 +1,310 @@
+"""Autoscaler v2 — instance-manager / scheduler split.
+
+Equivalent of the reference's autoscaler v2
+(reference: python/ray/autoscaler/v2/ — `scheduler.py` turns cluster
+resource state into launch/terminate decisions, `instance_manager/`
+owns per-instance lifecycle with explicit states, both driven by the
+GCS autoscaler state (`gcs_autoscaler_state_manager.cc`)). The v1
+StandardAutoscaler couples "what should the cluster look like" with
+"mutate the provider" in one loop and supports exactly one worker
+type; v2 separates them:
+
+  - `SchedulerV2` is a PURE function: (node types, cluster state,
+    instances) -> launch/terminate decisions. Multiple node types —
+    on a TPU cluster, CPU host pools next to several slice types —
+    with per-type resource shapes, min/max counts, and best-fit
+    selection for unmet demand gangs.
+  - `InstanceManager` owns instance lifecycle (QUEUED -> REQUESTED ->
+    RUNNING -> TERMINATING -> TERMINATED), reconciles its view against
+    the provider and the GCS node table, and retries failed launches
+    with backoff. Provider calls are the ONLY side effects.
+
+Both are driven by `AutoscalerV2.update()`, the monitor-loop entry.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.worker import get_global_core
+from ray_tpu.autoscaler import NodeProvider, _fits
+
+logger = logging.getLogger("ray_tpu.autoscaler.v2")
+
+# instance lifecycle states (reference: instance_manager/common.py
+# InstanceUtil valid transitions)
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+RUNNING = "RUNNING"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+ALLOCATION_FAILED = "ALLOCATION_FAILED"
+
+
+@dataclass
+class NodeTypeConfig:
+    """One entry of `available_node_types` (reference:
+    autoscaler YAML available_node_types.<name>)."""
+
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 8
+    hosts_per_node: int = 1  # >1 for pod slices: one launch = N raylets
+    node_config: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: str = QUEUED
+    provider_node_id: Optional[str] = None
+    launched_at: float = 0.0
+    idle_since: float = 0.0
+    failures: int = 0
+
+
+@dataclass
+class Decision:
+    to_launch: Dict[str, int] = field(default_factory=dict)       # node_type -> count
+    to_terminate: List[str] = field(default_factory=list)         # instance ids
+    infeasible: List[Dict[str, float]] = field(default_factory=list)
+
+
+class SchedulerV2:
+    """Pure demand scheduler (reference: autoscaler/v2/scheduler.py
+    ResourceDemandScheduler.schedule)."""
+
+    def __init__(self, node_types: Dict[str, NodeTypeConfig], idle_timeout_s: float = 30.0):
+        self.node_types = node_types
+        self.idle_timeout_s = idle_timeout_s
+
+    def schedule(
+        self,
+        pending_shapes: List[Dict[str, float]],
+        node_slack: List[Dict[str, float]],
+        instances: List[Instance],
+        now: float,
+    ) -> Decision:
+        d = Decision()
+        counts = {t: 0 for t in self.node_types}
+        for inst in instances:
+            if inst.status in (QUEUED, REQUESTED, RUNNING):
+                counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
+
+        # 1. min_workers floors
+        for t, cfg in self.node_types.items():
+            if counts[t] < cfg.min_workers:
+                d.to_launch[t] = cfg.min_workers - counts[t]
+                counts[t] = cfg.min_workers
+
+        # 2. bin-pack pending shapes onto existing slack (includes
+        # capacity of still-launching instances AND this tick's floor
+        # launches so one demand burst doesn't double-launch)
+        slack = [dict(s) for s in node_slack]
+        for inst in instances:
+            if inst.status in (QUEUED, REQUESTED):
+                cfg = self.node_types.get(inst.node_type)
+                if cfg:
+                    slack.extend(dict(cfg.resources) for _ in range(cfg.hosts_per_node))
+        for t, cnt in d.to_launch.items():
+            cfg = self.node_types[t]
+            slack.extend(
+                dict(cfg.resources) for _ in range(cfg.hosts_per_node * cnt)
+            )
+        unmet: List[Dict[str, float]] = []
+        for shape in pending_shapes:
+            for avail in slack:
+                if _fits(shape, avail):
+                    for k, v in shape.items():
+                        avail[k] = avail.get(k, 0.0) - v
+                    break
+            else:
+                unmet.append(shape)
+
+        # 3. choose node types for unmet shapes: smallest type that fits
+        # each shape (best-fit by total resource weight), packing
+        # follow-up shapes into already-chosen launches first
+        chosen_cap: List[Dict[str, float]] = []
+        for shape in unmet:
+            placed = False
+            for avail in chosen_cap:
+                if _fits(shape, avail):
+                    for k, v in shape.items():
+                        avail[k] -= v
+                    placed = True
+                    break
+            if placed:
+                continue
+            fitting = [
+                cfg for t, cfg in self.node_types.items()
+                if _fits(shape, cfg.resources) and counts[t] < cfg.max_workers
+            ]
+            if not fitting:
+                d.infeasible.append(shape)
+                continue
+            best = min(fitting, key=lambda c: (sum(c.resources.values()), c.name))
+            d.to_launch[best.name] = d.to_launch.get(best.name, 0) + 1
+            counts[best.name] += 1
+            fresh = [dict(best.resources) for _ in range(best.hosts_per_node)]
+            for k, v in shape.items():
+                fresh[0][k] = fresh[0].get(k, 0.0) - v
+            chosen_cap.extend(fresh)
+
+        # 4. idle terminations (only when nothing is pending)
+        if not pending_shapes:
+            for inst in instances:
+                if inst.status != RUNNING or not inst.idle_since:
+                    continue
+                cfg = self.node_types.get(inst.node_type)
+                floor = cfg.min_workers if cfg else 0
+                if now - inst.idle_since >= self.idle_timeout_s and counts.get(inst.node_type, 0) > floor:
+                    d.to_terminate.append(inst.instance_id)
+                    counts[inst.node_type] -= 1
+        return d
+
+
+class InstanceManager:
+    """Instance lifecycle owner (reference:
+    autoscaler/v2/instance_manager/instance_manager.py). Providers are
+    per node type — a TPU cluster mixes slice providers with CPU pools."""
+
+    def __init__(self, providers: Dict[str, NodeProvider],
+                 node_types: Dict[str, NodeTypeConfig],
+                 max_failures: int = 3):
+        self.providers = providers
+        self.node_types = node_types
+        self.max_failures = max_failures
+        self.instances: Dict[str, Instance] = {}
+        self._seq = itertools.count()
+        # cumulative counters survive the purge of terminal instances
+        self.lifetime = {"launched": 0, "terminated": 0, "failed": 0}
+
+    def queue_launch(self, node_type: str, count: int) -> List[str]:
+        ids = []
+        for _ in range(count):
+            iid = f"inst-{node_type}-{next(self._seq)}"
+            self.instances[iid] = Instance(iid, node_type, QUEUED)
+            ids.append(iid)
+        return ids
+
+    def queue_terminate(self, instance_id: str) -> None:
+        inst = self.instances.get(instance_id)
+        if inst and inst.status == RUNNING:
+            inst.status = TERMINATING
+
+    def step(self) -> Dict[str, int]:
+        """Execute pending transitions against the providers; returns
+        counters for observability."""
+        launched = terminated = failed = 0
+        for inst in list(self.instances.values()):
+            if inst.status == QUEUED:
+                provider = self.providers[inst.node_type]
+                cfg = self.node_types[inst.node_type]
+                inst.status = REQUESTED
+                try:
+                    inst.provider_node_id = provider.create_node(dict(cfg.node_config))
+                    inst.status = RUNNING
+                    inst.launched_at = time.monotonic()
+                    launched += 1
+                except Exception:
+                    logger.warning("launch of %s failed", inst.instance_id, exc_info=True)
+                    inst.failures += 1
+                    failed += 1
+                    inst.status = ALLOCATION_FAILED if inst.failures >= self.max_failures else QUEUED
+            elif inst.status == TERMINATING:
+                provider = self.providers[inst.node_type]
+                try:
+                    if inst.provider_node_id is not None:
+                        provider.terminate_node(inst.provider_node_id)
+                    inst.status = TERMINATED
+                    terminated += 1
+                except Exception:
+                    logger.warning("terminate of %s failed", inst.instance_id, exc_info=True)
+        # purge terminal records: a long-lived monitor loop on a bursty
+        # cluster would otherwise accumulate dead instances forever and
+        # rescan them every tick
+        self.instances = {
+            k: v for k, v in self.instances.items()
+            if v.status not in (TERMINATED, ALLOCATION_FAILED)
+        }
+        self.lifetime["launched"] += launched
+        self.lifetime["terminated"] += terminated
+        self.lifetime["failed"] += failed
+        return {"launched": launched, "terminated": terminated, "failed": failed}
+
+    def reconcile(self, gcs_nodes: List[Dict[str, Any]], now: float) -> None:
+        """Sync instance view with the provider (crashed nodes) and the
+        GCS node table (idleness)."""
+        by_id = {n["node_id"]: n for n in gcs_nodes}
+        for inst in self.instances.values():
+            if inst.status != RUNNING:
+                continue
+            provider = self.providers[inst.node_type]
+            if inst.provider_node_id not in provider.non_terminated_nodes():
+                inst.status = TERMINATED  # died underneath us
+                continue
+            hosts = [
+                by_id.get(h)
+                for h in provider.cluster_node_ids(inst.provider_node_id)
+            ]
+            hosts = [h for h in hosts if h is not None]
+            idle = bool(hosts) and all(
+                h["state"] == "ALIVE" and h["resources_available"] == h["resources_total"]
+                for h in hosts
+            )
+            if idle:
+                if not inst.idle_since:
+                    inst.idle_since = now
+            else:
+                inst.idle_since = 0.0
+
+    def summary(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for inst in self.instances.values():
+            out.setdefault(inst.node_type, {}).setdefault(inst.status, 0)
+            out[inst.node_type][inst.status] += 1
+        return out
+
+
+class AutoscalerV2:
+    """Monitor-loop glue: GCS load -> scheduler -> instance manager
+    (reference: autoscaler/v2/autoscaler.py)."""
+
+    def __init__(self, providers: Dict[str, NodeProvider],
+                 node_types: Dict[str, NodeTypeConfig],
+                 idle_timeout_s: float = 30.0):
+        self.scheduler = SchedulerV2(node_types, idle_timeout_s)
+        self.im = InstanceManager(providers, node_types)
+
+    def load(self) -> Dict[str, Any]:
+        return get_global_core().gcs_request("autoscaler.load", {})
+
+    def update(self) -> Dict[str, Any]:
+        load = self.load()
+        now = time.monotonic()
+        self.im.reconcile(load["nodes"], now)
+        slack = [
+            dict(n["resources_available"]) for n in load["nodes"] if n["state"] == "ALIVE"
+        ]
+        live = [i for i in self.im.instances.values() if i.status not in (TERMINATED,)]
+        decision = self.scheduler.schedule(load["pending_shapes"], slack, live, now)
+        for node_type, count in decision.to_launch.items():
+            self.im.queue_launch(node_type, count)
+        for iid in decision.to_terminate:
+            self.im.queue_terminate(iid)
+        counters = self.im.step()
+        counters["infeasible"] = len(decision.infeasible)
+        return counters
+
+    def run(self, interval_s: float = 5.0, stop_event=None):
+        while stop_event is None or not stop_event.is_set():
+            try:
+                self.update()
+            except Exception:
+                logger.warning("v2 update failed", exc_info=True)
+            time.sleep(interval_s)

@@ -21,7 +21,10 @@ from ray_tpu.data.read_api import (  # noqa: F401
     from_items,
     from_numpy,
     from_pandas,
+    from_torch,
     range,
+    read_bigquery,
+    read_mongo,
     read_sql,
     read_tfrecords,
     read_csv,
@@ -31,4 +34,5 @@ from ray_tpu.data.read_api import (  # noqa: F401
     read_numpy,
     read_binary_files,
     read_images,
+    read_webdataset,
 )

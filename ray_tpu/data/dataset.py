@@ -89,6 +89,16 @@ def _write_tfrecords_block(blk, path: str):
 
 
 @ray_tpu.remote
+def _write_webdataset_block(blk, path: str):
+    from ray_tpu.data import block as B
+    from ray_tpu.data.webdataset import write_samples
+
+    with open(path, "wb") as f:
+        write_samples(f, B.block_rows(blk))
+    return path
+
+
+@ray_tpu.remote
 def _zip_blocks(left, *right_parts):
     right = B.concat_blocks(list(right_parts))
     for name in right.column_names:
@@ -357,6 +367,53 @@ class Dataset:
                 out[k] = t
             yield out
 
+    def iter_tf_batches(self, *, batch_size: int = 256, drop_last: bool = False) -> Iterator[Any]:
+        """Batches as dicts of tf tensors (reference: data/iterator.py
+        iter_tf_batches)."""
+        import tensorflow as tf
+
+        for batch in self.iter_batches(batch_size=batch_size, batch_format="numpy", drop_last=drop_last):
+            yield {
+                k: tf.convert_to_tensor(v) if getattr(v, "dtype", None) is not None
+                and v.dtype.kind not in "OUS" else v
+                for k, v in batch.items()
+            }
+
+    def to_tf(self, feature_columns, label_columns, *, batch_size: int = 256,
+              drop_last: bool = False):
+        """A `tf.data.Dataset` of (features, labels) dict pairs
+        (reference: data/iterator.py to_tf). Column dtypes/shapes are
+        inferred from the first batch; single-column sides yield bare
+        tensors like the reference."""
+        import tensorflow as tf
+
+        feats = [feature_columns] if isinstance(feature_columns, str) else list(feature_columns)
+        labels = [label_columns] if isinstance(label_columns, str) else list(label_columns)
+        probe = next(self.iter_batches(batch_size=2, batch_format="numpy"))
+
+        def spec(col):
+            v = probe[col]
+            return tf.TensorSpec(shape=(None,) + v.shape[1:], dtype=tf.as_dtype(v.dtype))
+
+        def side(batch, cols):
+            if len(cols) == 1:
+                return tf.convert_to_tensor(batch[cols[0]])
+            return {c: tf.convert_to_tensor(batch[c]) for c in cols}
+
+        def sig(cols):
+            if len(cols) == 1:
+                return spec(cols[0])
+            return {c: spec(c) for c in cols}
+
+        def gen():
+            for batch in self.iter_batches(batch_size=batch_size, batch_format="numpy",
+                                           drop_last=drop_last):
+                yield side(batch, feats), side(batch, labels)
+
+        return tf.data.Dataset.from_generator(
+            gen, output_signature=(sig(feats), sig(labels))
+        )
+
     def streaming_split(self, n: int, *, equal: bool = False) -> List["DataIterator"]:
         """N iterators over disjoint subsets, one per train worker
         (reference: dataset.streaming_split feeding Train). Default:
@@ -514,6 +571,18 @@ class Dataset:
         refs = self._execute_refs()
         ray_tpu.get([
             _write_tfrecords_block.remote(ref, os.path.join(path, f"part-{i:05d}.tfrecord"))
+            for i, ref in enumerate(refs)
+        ])
+
+    def write_webdataset(self, path: str):
+        """One .tar webdataset shard per block, written in tasks
+        (reference: Dataset.write_webdataset)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        refs = self._execute_refs()
+        ray_tpu.get([
+            _write_webdataset_block.remote(ref, os.path.join(path, f"part-{i:05d}.tar"))
             for i, ref in enumerate(refs)
         ])
 

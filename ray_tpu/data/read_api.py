@@ -167,6 +167,24 @@ def read_binary_files(paths, **kw) -> Dataset:
 
 
 @ray_tpu.remote
+def _read_webdataset(path, decode_images: bool):
+    from ray_tpu.data.webdataset import read_samples
+
+    with _open(path) as f:
+        return B.to_block(read_samples(f, decode_images=decode_images))
+
+
+def read_webdataset(paths, *, decode_images: bool = True, **kw) -> Dataset:
+    """Tar shards in webdataset layout, one block per shard (reference:
+    data/datasource/webdataset_datasource.py; implemented natively on
+    tarfile — see ray_tpu/data/webdataset.py)."""
+    return Dataset([
+        LazyBlock(lambda p=p: _read_webdataset.remote(p, decode_images))
+        for p in _expand(paths)
+    ])
+
+
+@ray_tpu.remote
 def _read_sql_shard(connection_factory, sql: str, shard: Optional[int], num_shards: int):
     conn = connection_factory()
     try:
@@ -228,6 +246,107 @@ def read_tfrecords(paths, *, verify_crc: bool = False, **kw) -> Dataset:
     return Dataset([
         LazyBlock(lambda p=p: _read_tfrecords.remote(p, verify_crc)) for p in _expand(paths)
     ])
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline: Optional[List[Dict]] = None, parallelism: int = 4,
+               client_factory=None) -> Dataset:
+    """MongoDB collection reader (reference:
+    data/datasource/mongo_datasource.py). Parallelism shards on `_id`
+    hash buckets through an aggregation `$match`, so each task streams
+    an independent cursor. `client_factory(uri)` injects the client —
+    pymongo when installed, a fake in tests (the same injectable-
+    transport pattern as the GCE slice provider)."""
+    if client_factory is None:
+        def client_factory(u):
+            try:
+                import pymongo
+            except ImportError:
+                raise ImportError(
+                    "read_mongo needs pymongo (not installed) or an explicit "
+                    "client_factory"
+                ) from None
+            return pymongo.MongoClient(u)
+
+    @ray_tpu.remote
+    def _read_shard(shard: int, num_shards: int):
+        client = client_factory(uri)
+        coll = client[database][collection]
+        stages = list(pipeline or [])
+        if num_shards > 1:
+            # $abs: $toHashedIndexKey is signed and $mod keeps the
+            # dividend's sign — without it, negative-hash documents
+            # match no shard and silently vanish
+            stages.insert(0, {"$match": {"$expr": {"$eq": [
+                {"$mod": [{"$abs": {"$toHashedIndexKey": "$_id"}}, num_shards]}, shard
+            ]}}})
+        rows = [{k: v for k, v in doc.items()} for doc in coll.aggregate(stages)]
+        return B.to_block(rows)
+
+    return Dataset([
+        LazyBlock(lambda i=i: _read_shard.remote(i, parallelism))
+        for i in builtins.range(parallelism)
+    ])
+
+
+def read_bigquery(query: Optional[str] = None, *, project_id: Optional[str] = None,
+                  dataset: Optional[str] = None, parallelism: int = 1,
+                  client_factory=None) -> Dataset:
+    """BigQuery reader (reference:
+    data/datasource/bigquery_datasource.py). Runs the query (or a full
+    `dataset` table scan) and pages rows into blocks.
+    `client_factory(project_id)` injects the client — google-cloud-
+    bigquery when installed, a fake in tests."""
+    if query is None and dataset is None:
+        raise ValueError("read_bigquery needs `query` or `dataset`")
+    sql = query or f"SELECT * FROM `{dataset}`"
+    if client_factory is None:
+        def client_factory(proj):
+            try:
+                from google.cloud import bigquery
+            except ImportError:
+                raise ImportError(
+                    "read_bigquery needs google-cloud-bigquery (not installed) "
+                    "or an explicit client_factory"
+                ) from None
+            return bigquery.Client(project=proj)
+
+    @ray_tpu.remote
+    def _read_all():
+        # ONE billed query execution; parallelism comes from splitting
+        # the materialized result into blocks afterwards (running the
+        # query per page would multiply query cost and transfer by P)
+        client = client_factory(project_id)
+        return B.to_block([dict(r) for r in client.query(sql).result()])
+
+    ds = Dataset([LazyBlock(lambda: _read_all.remote())])
+    return ds.repartition(parallelism) if parallelism > 1 else ds
+
+
+def from_torch(torch_dataset, parallelism: int = 8) -> Dataset:
+    """Materialize a map-style `torch.utils.data.Dataset` into blocks
+    (reference: data/read_api.py from_torch / torch_datasource.py).
+    Tensor samples become an "item" tensor column; (x, y) tuples become
+    "item"/"label"; dict samples keep their keys."""
+    import numpy as np
+
+    def _rowify(sample):
+        import torch
+
+        def cv(v):
+            out = v.numpy() if isinstance(v, torch.Tensor) else np.asarray(v)
+            # 0-d arrays (scalar labels) must land as python scalars —
+            # arrow can't ingest 0-d ndarrays in a column
+            return out.item() if out.ndim == 0 else out
+
+        if isinstance(sample, dict):
+            return {k: cv(v) for k, v in sample.items()}
+        if isinstance(sample, (tuple, list)) and len(sample) == 2:
+            return {"item": cv(sample[0]), "label": cv(sample[1])}
+        return {"item": cv(sample)}
+
+    rows = [_rowify(torch_dataset[i]) for i in builtins.range(len(torch_dataset))]
+    return from_items(rows, parallelism=parallelism)
 
 
 def from_huggingface(hf_dataset, parallelism: int = 8) -> Dataset:

@@ -127,13 +127,42 @@ class JobSupervisor:
 
 
 class JobSubmissionClient:
-    """Submit and manage jobs (reference: JobSubmissionClient — HTTP there,
-    direct cluster RPCs here; `address` accepts the same forms as
-    ray_tpu.init)."""
+    """Submit and manage jobs (reference: JobSubmissionClient,
+    dashboard/modules/job/sdk.py:39). Two transports:
+
+      - cluster mode (default): `address` is any form ray_tpu.init
+        accepts; mutations go through the detached supervisor actor.
+      - REST mode: `address` is an ``http://host:port`` dashboard URL —
+        the reference's primary transport; no cluster connection is made
+        from this process (reference: job_head.py REST endpoints).
+    """
 
     def __init__(self, address: Optional[str] = None):
+        self._http = None
+        if address and address.startswith("http"):
+            self._http = address.rstrip("/")
+            return
         if not ray_tpu.is_initialized():
             ray_tpu.init(address=address or "auto")
+
+    # ---- REST transport -------------------------------------------------
+    def _rest(self, method: str, path: str, body: Optional[Dict[str, Any]] = None):
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._http + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise KeyError(f"no such job ({path})") from None
+            raise RuntimeError(f"{method} {path} failed: {e.code} {e.read().decode(errors='replace')}") from None
 
     def submit_job(
         self,
@@ -144,6 +173,13 @@ class JobSubmissionClient:
         working_dir: Optional[str] = None,
     ) -> str:
         job_id = job_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        if self._http is not None:
+            out = self._rest("POST", "/api/jobs/", {
+                "entrypoint": entrypoint,
+                "job_id": job_id,
+                "runtime_env": dict(runtime_env or {}, working_dir=working_dir or (runtime_env or {}).get("working_dir")),
+            })
+            return out["job_id"]
         env_vars = (runtime_env or {}).get("env_vars", {})
         working_dir = working_dir or (runtime_env or {}).get("working_dir")
         JobSupervisor.options(
@@ -158,6 +194,11 @@ class JobSubmissionClient:
         raise TimeoutError(f"job {job_id} supervisor did not start")
 
     def _get_record(self, job_id: str) -> Optional[Dict[str, Any]]:
+        if self._http is not None:
+            try:
+                return self._rest("GET", f"/api/jobs/{job_id}")
+            except KeyError:
+                return None
         from ray_tpu._private.worker import get_global_core
 
         blob = get_global_core().gcs_request("kv.get", {"ns": _KV_NS, "key": job_id})
@@ -186,10 +227,14 @@ class JobSubmissionClient:
         raise TimeoutError(f"job {job_id} still {status} after {timeout}s")
 
     def stop_job(self, job_id: str) -> bool:
+        if self._http is not None:
+            return bool(self._rest("POST", f"/api/jobs/{job_id}/stop")["stopped"])
         sup = ray_tpu.get_actor(f"_job_supervisor:{job_id}")
         return ray_tpu.get(sup.stop.remote())
 
     def get_job_logs(self, job_id: str) -> str:
+        if self._http is not None:
+            return self._rest("GET", f"/api/jobs/{job_id}/logs")["logs"]
         # the supervisor exits after the job terminates — fall back to the
         # log file it left in the session dir
         try:
@@ -203,6 +248,8 @@ class JobSubmissionClient:
             raise
 
     def list_jobs(self) -> List[Dict[str, Any]]:
+        if self._http is not None:
+            return self._rest("GET", "/api/submissions")
         from ray_tpu._private.worker import get_global_core
 
         core = get_global_core()

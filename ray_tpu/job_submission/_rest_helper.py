@@ -1,0 +1,41 @@
+"""REST-submission helper driver.
+
+The dashboard's job endpoints run inside the GCS process, which is not
+a ray driver; this short-lived process connects to the session as a
+driver and performs the one mutation (submit or stop) through the same
+`JobSubmissionClient` path the SDK uses (reference analogue: the
+dashboard process hosting JobManager is itself a Ray driver —
+dashboard/modules/job/job_manager.py).
+
+Usage: python -m ray_tpu.job_submission._rest_helper <session_dir> submit <json>
+       python -m ray_tpu.job_submission._rest_helper <session_dir> stop <job_id>
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv) -> int:
+    session_dir, action = argv[0], argv[1]
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    ray_tpu.init(address=f"session:{session_dir}")
+    client = JobSubmissionClient()
+    if action == "submit":
+        spec = json.loads(argv[2])
+        client.submit_job(
+            entrypoint=spec["entrypoint"],
+            job_id=spec["job_id"],
+            runtime_env={"env_vars": spec.get("env_vars") or {}},
+            working_dir=spec.get("working_dir"),
+        )
+        return 0
+    if action == "stop":
+        return 0 if client.stop_job(argv[2]) else 1
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -8,6 +8,7 @@ multi-learner gradient reduction is an ICI psum under pjit (or
 lockstep pytree averaging across learner actors on separate hosts).
 """
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.apex_dqn import APEXDQN, APEXDQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.bandits import (  # noqa: F401
@@ -16,11 +17,16 @@ from ray_tpu.rllib.algorithms.bandits import (  # noqa: F401
     LinUCB,
     LinUCBConfig,
 )
+from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config  # noqa: F401
+from ray_tpu.rllib.algorithms.dt import DT, DTConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.es import ES, ESConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.td3 import TD3, TD3Config  # noqa: F401
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401

@@ -1,0 +1,244 @@
+"""APEX-DQN — distributed prioritized experience replay.
+
+Equivalent of the reference's Ape-X DQN
+(reference: rllib/algorithms/apex_dqn/apex_dqn.py — Horgan et al.:
+many actors generate n-step transitions WITH their own initial TD
+priorities, sharded prioritized replay actors hold the data, and the
+learner overlaps replay sampling/updates with actor collection).
+
+Mapping onto this stack: env runners are `ApexEnvRunner` actors that
+assemble n-step returns per env lane and score each transition with
+the current network; replay shards are lightweight actors around
+`PrioritizedReplayBuffer`; `training_step` kicks off the runners'
+sample round, trains against the shards while that round is in flight
+(one-ahead sample prefetch per shard), then lands the new transitions.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
+from ray_tpu.rllib.env.off_policy_env_runner import OffPolicyEnvRunner
+
+
+@ray_tpu.remote(num_cpus=0)
+class ReplayShardActor:
+    """One shard of the distributed prioritized replay
+    (reference: apex uses `ReplayActor`s sharding a PER buffer)."""
+
+    def __init__(self, capacity: int, alpha: float, beta: float, seed: int):
+        from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer
+
+        self.buf = PrioritizedReplayBuffer(capacity, alpha=alpha, beta=beta, seed=seed)
+
+    def add(self, batch, priorities=None) -> int:
+        self.buf.add_with_priorities(batch, priorities)
+        return len(self.buf)
+
+    def size(self) -> int:
+        return len(self.buf)
+
+    def sample(self, n: int):
+        return self.buf.sample(n)
+
+    def update_priorities(self, td) -> None:
+        self.buf.update_priorities(np.asarray(td))
+
+
+class ApexEnvRunner(OffPolicyEnvRunner):
+    """Off-policy runner emitting n-step transitions with initial TD
+    priorities. n-step windows are assembled per env LANE (the flat
+    fragment batch interleaves envs, so composition happens here in the
+    step loop where continuity is known)."""
+
+    def __init__(self, config, worker_index: int = 0):
+        super().__init__(config, worker_index)
+        self._pending: List[List[list]] = [[] for _ in range(self.num_envs)]
+
+    def _flush_lane(self, lane: List[list], rows: List[tuple], final_obs, terminated: bool):
+        for obs0, act0, ret, depth in lane:
+            rows.append((obs0, act0, ret, final_obs, terminated, depth))
+        lane.clear()
+
+    def sample(self) -> Dict[str, Any]:
+        cfg = self.config
+        T = cfg.rollout_fragment_length
+        n_step, gamma = cfg.n_step, cfg.gamma
+        self._on_fragment_start()
+
+        rows: List[tuple] = []
+        obs = self._obs
+        prev_done = self._prev_done
+        for _ in range(T):
+            action, env_action = self._select_actions(obs)
+            next_obs, reward, terminated, truncated, _ = self.env.step(env_action)
+            done = terminated | truncated
+            live = self._account_step(np.asarray(reward), done, prev_done)
+            for i in range(self.num_envs):
+                lane = self._pending[i]
+                if not live[i]:
+                    lane.clear()  # autoreset frame: stale action
+                    continue
+                r = float(reward[i])
+                for e in lane:
+                    e[2] += (gamma ** e[3]) * r
+                    e[3] += 1
+                lane.append([obs[i].astype(np.float32), action[i], r, 1])
+                if terminated[i] or truncated[i]:
+                    # episode end: every open window closes here; only a
+                    # true termination stops the bootstrap
+                    self._flush_lane(lane, rows, next_obs[i].astype(np.float32), bool(terminated[i]))
+                elif lane[0][3] >= n_step:
+                    obs0, act0, ret, depth = lane.pop(0)
+                    rows.append((obs0, act0, ret, next_obs[i].astype(np.float32), False, depth))
+            obs = next_obs
+            prev_done = done
+        self._obs = obs
+        self._prev_done = prev_done
+
+        if rows:
+            batch = {
+                "obs": np.stack([r[0] for r in rows]),
+                "actions": np.asarray([r[1] for r in rows], np.int64),
+                "rewards": np.asarray([r[2] for r in rows], np.float32),
+                "next_obs": np.stack([r[3] for r in rows]),
+                "terminateds": np.asarray([r[4] for r in rows], bool),
+                # per-row bootstrap discount: gamma**depth — partial
+                # windows flushed at truncation carry their true depth
+                "discounts": np.asarray([gamma ** r[5] for r in rows], np.float32),
+            }
+            # initial priorities: |n-step TD error| under the CURRENT net
+            # (reference: apex actors compute priorities before shipping)
+            q_now = np.asarray(self._q_fn(self.params, batch["obs"]))
+            q_next = np.asarray(self._q_fn(self.params, batch["next_obs"]))
+            q_sa = q_now[np.arange(len(rows)), batch["actions"]]
+            target = batch["rewards"] + batch["discounts"] * (
+                1.0 - batch["terminateds"].astype(np.float32)
+            ) * q_next.max(axis=-1)
+            priorities = np.abs(target - q_sa)
+        else:
+            batch, priorities = None, None
+
+        n = len(rows)
+        self._global_step += n
+        metrics = self._drain_episode_metrics(n, self._weights_seq)
+        metrics.update(self._extra_metrics())
+        return {"batch": batch, "metrics": metrics, "priorities": priorities}
+
+
+class APEXDQNConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.env_runner_cls = ApexEnvRunner
+        self.num_env_runners = 2
+        self.num_replay_shards = 2
+        self.n_step = 3
+        self.prioritized_replay_alpha = 0.6
+        self.prioritized_replay_beta = 0.4
+        # the n-step return already spans n transitions: the learner's
+        # gamma must be gamma**n_step on the bootstrap term — handled by
+        # passing an effective gamma to the learner below
+        self.train_batch_size = 64
+        self.training_intensity = 1.0
+        self.target_network_update_freq = 500
+
+
+class APEXDQN(DQN):
+    """training_step overlaps replay-shard training with the runners'
+    in-flight sample round (reference: apex_dqn.py training_step)."""
+
+    config_class = APEXDQNConfig
+
+    def __init__(self, config):
+        if config.num_env_runners < 1:
+            raise ValueError("APEX requires remote env runners (num_env_runners >= 1)")
+        # DQN.__init__ builds a LOCAL replay we don't use; skip straight
+        # to Algorithm init then attach shards
+        from ray_tpu.rllib.algorithms.algorithm import Algorithm
+
+        Algorithm.__init__(self, config)
+        # n-step discounting: each batch row carries its own bootstrap
+        # discount (gamma**depth, see ApexEnvRunner) which the DQN
+        # learner prefers over its scalar cfg.gamma — truncation-flushed
+        # partial windows bootstrap with their true depth
+        self.shards = [
+            ReplayShardActor.remote(
+                config.replay_buffer_capacity // config.num_replay_shards,
+                config.prioritized_replay_alpha,
+                config.prioritized_replay_beta,
+                config.seed + i,
+            )
+            for i in range(config.num_replay_shards)
+        ]
+        self._rr = 0
+        self._last_sampled = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        group = self.env_runner_group
+
+        # 1. weights out, then kick off the sample round WITHOUT waiting
+        self._weights_seq += 1
+        group.sync_weights(
+            self.learner_group.get_weights(), self._weights_seq,
+            global_step=self._env_steps_lifetime,
+        )
+        sample_refs = [r.sample.remote() for r in group.remote_runners]
+
+        # 2. train against the shards while the round is in flight,
+        # one-ahead prefetch so sampling and updating overlap
+        acc: Dict[str, list] = {}
+        sizes = ray_tpu.get([s.size.remote() for s in self.shards])
+        warm = sum(sizes) >= cfg.num_steps_sampled_before_learning_starts
+        if warm:
+            num_updates = max(1, int(self._last_sampled * cfg.training_intensity / cfg.train_batch_size))
+            order = [self.shards[(self._rr + u) % len(self.shards)] for u in range(num_updates)]
+            self._rr = (self._rr + num_updates) % len(self.shards)
+            pending = order[0].sample.remote(cfg.train_batch_size)
+            for u, shard in enumerate(order):
+                batch = ray_tpu.get(pending, timeout=60)
+                nxt = order[u + 1] if u + 1 < len(order) else None
+                if nxt is not None and nxt is not shard:
+                    # prefetch only from a DIFFERENT shard: the buffer's
+                    # update_priorities applies to its last sample, so a
+                    # same-shard prefetch must wait until the priority
+                    # push below is enqueued (actor calls are FIFO)
+                    pending = nxt.sample.remote(cfg.train_batch_size)
+                for k, v in self.learner_group.update_once(batch).items():
+                    acc.setdefault(k, []).append(v)
+                td = self.learner_group.get_td_errors()
+                if td is not None:
+                    shard.update_priorities.remote(td)
+                if nxt is not None and nxt is shard:
+                    pending = nxt.sample.remote(cfg.train_batch_size)
+
+        # 3. land the finished sample round on the shards
+        samples = ray_tpu.get(sample_refs, timeout=300)
+        sampled = 0
+        for s in samples:
+            if s["batch"] is not None:
+                shard = self.shards[self._rr % len(self.shards)]
+                self._rr += 1
+                shard.add.remote(s["batch"], s["priorities"])
+                sampled += len(s["batch"]["actions"])
+        self._last_sampled = sampled
+
+        results = self._fold_sample_metrics(samples)
+        results["epsilon"] = samples[0]["metrics"].get("epsilon")
+        results["learner"] = {k: float(np.mean(v)) for k, v in acc.items()}
+        results["replay_shard_sizes"] = sizes
+        return results
+
+    def stop(self) -> None:
+        super().stop()
+        for s in self.shards:
+            try:
+                ray_tpu.kill(s)
+            except Exception:
+                pass
+
+
+APEXDQNConfig.algo_class = APEXDQN

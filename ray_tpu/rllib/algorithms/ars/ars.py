@@ -1,0 +1,165 @@
+"""ARS — Augmented Random Search.
+
+Equivalent of the reference's ARS (reference: rllib/algorithms/ars/ars.py
+— Mania et al.'s random-search policy optimizer: antithetic Gaussian
+directions like ES, but (1) only the top-k directions by best-of-pair
+return contribute to the update, (2) the step is normalized by the
+standard deviation of the selected returns, and (3) rollouts whiten
+observations with a running mean/std shared across iterations). Shares
+the ES task fan-out: every direction evaluates as one task.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.es.es import ES, ESConfig, _flatten, _unflatten
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _ars_rollout(module_blob, flat_params, env_name, env_config, seed: int,
+                 episodes: int, obs_mean, obs_std):
+    """Greedy episodes with whitened observations; returns
+    (mean return, env steps, obs count, obs sum, obs sumsq) so the
+    driver can fold the stats into its running normalizer."""
+    import gymnasium as gym
+    import jax.numpy as jnp
+    import numpy as _np
+    import pickle
+
+    module, template = pickle.loads(module_blob)
+    params = _unflatten(_np.asarray(flat_params, _np.float32), template)
+    mean = _np.asarray(obs_mean, _np.float32)
+    std = _np.asarray(obs_std, _np.float32)
+    env = gym.make(env_name, **(env_config or {}))
+    total, steps = 0.0, 0
+    cnt, s1, s2 = 0, _np.zeros_like(mean, _np.float64), _np.zeros_like(mean, _np.float64)
+    for ep in range(episodes):
+        obs, _ = env.reset(seed=seed + ep)
+        done = False
+        while not done:
+            o = _np.asarray(obs, _np.float32)
+            cnt += 1
+            s1 += o
+            s2 += o.astype(_np.float64) ** 2
+            white = (o - mean) / std
+            logits = module.forward(params, jnp.asarray(white)[None])["logits"]
+            action = int(jnp.argmax(logits, axis=-1)[0])
+            obs, r, term, trunc, _ = env.step(action)
+            total += float(r)
+            steps += 1
+            done = term or trunc
+    env.close()
+    return total / episodes, steps, cnt, s1, s2
+
+
+class ARSConfig(ESConfig):
+    def __init__(self):
+        super().__init__()
+        self.population = 16           # direction PAIRS per iteration
+        self.num_top_directions = 8    # k: directions kept for the update
+        self.noise_std = 0.05
+        self.ars_lr = 0.05
+        self.observation_filter = True  # running obs mean/std whitening
+
+
+class ARS(ES):
+    config_class = ARSConfig
+
+    def __init__(self, config):
+        super().__init__(config)
+        # running observation normalizer (reference: MeanStdFilter,
+        # rllib/utils/filter.py) — folded from rollout-side sufficient
+        # statistics, so the driver never sees raw observations
+        dim = int(np.prod(self._spaces[0].shape))
+        self._obs_count = 0
+        self._obs_sum = np.zeros(dim, np.float64)
+        self._obs_sumsq = np.zeros(dim, np.float64)
+
+    def _obs_stats(self):
+        if not self.config.observation_filter or self._obs_count < 2:
+            return np.zeros(self._obs_sum.shape, np.float32), np.ones(self._obs_sum.shape, np.float32)
+        mean = self._obs_sum / self._obs_count
+        var = np.maximum(self._obs_sumsq / self._obs_count - mean**2, 1e-6)
+        return mean.astype(np.float32), np.sqrt(var).astype(np.float32)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n, std = cfg.population, cfg.noise_std
+        k = min(cfg.num_top_directions, n)
+        mean, sd = self._obs_stats()
+        eps = self._rng.standard_normal((n, len(self.theta))).astype(np.float32)
+        refs = []
+        for i in range(n):
+            for sign in (1.0, -1.0):
+                refs.append(_ars_rollout.remote(
+                    self._module_blob, self.theta + sign * std * eps[i],
+                    cfg.env, cfg.env_config,
+                    seed=int(self._rng.integers(1 << 30)),
+                    episodes=cfg.episodes_per_eval,
+                    obs_mean=mean, obs_std=sd,
+                ))
+        results = ray_tpu.get(refs)
+        returns = np.asarray([r[0] for r in results], np.float32).reshape(n, 2)
+        env_steps = int(sum(r[1] for r in results))
+        for _, _, cnt, s1, s2 in results:
+            self._obs_count += cnt
+            self._obs_sum += s1
+            self._obs_sumsq += s2
+        # top-k directions by best-of-pair; step scaled by the std of the
+        # returns that actually enter the update (ARS's variance control)
+        best = returns.max(axis=1)
+        top = np.argsort(-best)[:k]
+        used = returns[top]
+        sigma_r = float(used.std()) or 1.0
+        grad = ((used[:, 0] - used[:, 1])[:, None] * eps[top]).sum(axis=0) / (k * sigma_r)
+        self.theta = self.theta + cfg.ars_lr * grad
+        self._env_steps_lifetime += env_steps
+        return {
+            "episode_return_mean": float(returns.mean()),
+            "episode_return_best": float(returns.max()),
+            "num_evaluations": int(returns.size),
+            "num_env_steps": env_steps,
+            "return_std_topk": sigma_r,
+        }
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax.numpy as jnp
+
+        mean, sd = self._obs_stats()
+        white = (np.asarray(obs, np.float32) - mean) / sd
+        params = _unflatten(self.theta, self._template)
+        logits = self.module.forward(params, jnp.asarray(white)[None])["logits"]
+        return int(jnp.argmax(logits, axis=-1)[0])
+
+    def save_to_path(self, path: str) -> str:
+        import os
+        import pickle
+
+        super().save_to_path(path)
+        with open(os.path.join(path, "obs_filter.pkl"), "wb") as f:
+            pickle.dump(
+                {"count": self._obs_count, "sum": self._obs_sum, "sumsq": self._obs_sumsq}, f
+            )
+        return path
+
+    @classmethod
+    def from_checkpoint(cls, path: str) -> "ARS":
+        import os
+        import pickle
+
+        algo = super().from_checkpoint(path)
+        fp = os.path.join(path, "obs_filter.pkl")
+        if os.path.exists(fp):
+            with open(fp, "rb") as f:
+                st = pickle.load(f)
+            algo._obs_count = st["count"]
+            algo._obs_sum = st["sum"]
+            algo._obs_sumsq = st["sumsq"]
+        return algo
+
+
+ARSConfig.algo_class = ARS

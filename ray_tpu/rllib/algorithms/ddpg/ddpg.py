@@ -1,0 +1,74 @@
+"""DDPG — deep deterministic policy gradients.
+
+Equivalent of the reference's DDPG
+(reference: rllib/algorithms/ddpg/ddpg.py — deterministic actor +
+single Q critic with target networks and Ornstein-Uhlenbeck/Gaussian
+exploration noise). Here DDPG is TD3 with the three TD3 additions
+turned off: one critic (twin_q=False), no target policy smoothing
+(target_noise=0), and an actor update every step (policy_delay=1) —
+which is exactly how the two algorithms relate in the literature, and
+keeps one jitted learner path for both.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.td3.td3 import TD3, TD3Config, TD3EnvRunner
+
+
+class DDPGEnvRunner(TD3EnvRunner):
+    """Ornstein-Uhlenbeck exploration noise (reference:
+    rllib/utils/exploration/ornstein_uhlenbeck_noise.py) — temporally
+    correlated noise suits momentum-driven continuous-control envs;
+    plain Gaussian (TD3's choice) is available via ou_theta=1, ou_sigma.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._ou_state = None
+        # persistent generator: reseeding per step from _global_step
+        # (constant within a fragment) would freeze the OU increments
+        # into a per-fragment bias instead of exploration noise
+        self._noise_rng = np.random.default_rng(self.config.seed * 9973 + self.worker_index)
+
+    def _select_actions(self, obs):
+        cfg = self.config
+        if self._warmup:
+            return super()._select_actions(obs)
+        self._rng, key = self._jax.random.split(self._rng)
+        a, _ = self._sample_fn(self.params, obs.astype(np.float32), key)
+        a = np.asarray(a, np.float32)
+        if self._ou_state is None or self._ou_state.shape != a.shape:
+            self._ou_state = np.zeros_like(a)
+        # dx = theta * (mu - x) + sigma * N(0, 1), mu = 0
+        self._ou_state = (
+            self._ou_state
+            + cfg.ou_theta * (0.0 - self._ou_state)
+            + cfg.ou_sigma * self._noise_rng.normal(size=a.shape).astype(np.float32)
+        )
+        action = np.clip(a + cfg.exploration_noise_scale * self._ou_state, -1.0, 1.0)
+        low, high = self.module.action_low, self.module.action_high
+        return action, low + (action + 1.0) * 0.5 * (high - low)
+
+
+class DDPGConfig(TD3Config):
+    def __init__(self):
+        super().__init__()
+        self.env_runner_cls = DDPGEnvRunner
+        # the three TD3 deltas, reverted:
+        self.twin_q = False
+        self.policy_delay = 1
+        self.target_noise = 0.0
+        # OU exploration
+        self.ou_theta = 0.15
+        self.ou_sigma = 0.2
+        self.exploration_noise_scale = 1.0
+        self.tau = 0.005
+        self.lr = 1e-3
+
+
+class DDPG(TD3):
+    config_class = DDPGConfig
+
+
+DDPGConfig.algo_class = DDPG

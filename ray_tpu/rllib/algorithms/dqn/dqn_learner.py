@@ -39,7 +39,11 @@ class DQNLearner(Learner):
             else:
                 next_a = jnp.argmax(q_next_t, axis=-1)
             q_next = jnp.take_along_axis(q_next_t, next_a[:, None], axis=1)[:, 0]
-            target = batch["rewards"] + cfg.gamma * (1.0 - batch["terminateds"].astype(jnp.float32)) * q_next
+            # n-step producers (APEX) ship a per-row bootstrap discount
+            # (gamma**depth — truncation-flushed partial windows have
+            # depth < n_step); plain 1-step batches fall back to gamma
+            disc = batch["discounts"] if "discounts" in batch else cfg.gamma
+            target = batch["rewards"] + disc * (1.0 - batch["terminateds"].astype(jnp.float32)) * q_next
             td = q - jax.lax.stop_gradient(target)
             huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td**2, jnp.abs(td) - 0.5)
             w = batch.get("weights", jnp.ones_like(huber))

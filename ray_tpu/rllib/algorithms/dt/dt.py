@@ -1,0 +1,328 @@
+"""DT — Decision Transformer (offline RL as sequence modeling).
+
+Equivalent of the reference's DT
+(reference: rllib/algorithms/dt/dt.py — Chen et al.: model trajectories
+as (return-to-go, state, action) token triplets with a causal
+transformer; act at eval time by conditioning on a target return).
+Jax-native: the transformer is an explicit-pytree module like the rest
+of the stack — embeddings + pre-LN causal attention blocks, jitted
+end to end; training runs through the standard Learner minibatch SGD
+over sampled context windows.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner.learner import Learner
+from ray_tpu.rllib.core.learner.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.utils.env import env_spaces
+
+
+def _dense_init(rng, n_in, n_out, scale=None):
+    if scale is None:
+        scale = 1.0 / np.sqrt(n_in)
+    w = jax.random.normal(rng, (n_in, n_out), jnp.float32) * scale
+    return {"w": w, "b": jnp.zeros((n_out,), jnp.float32)}
+
+
+def _dense(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def _ln(p, x):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-5) * p["g"] + p["b"]
+
+
+def _ln_init(d):
+    return {"g": jnp.ones((d,), jnp.float32), "b": jnp.zeros((d,), jnp.float32)}
+
+
+class DTModule(RLModule):
+    """Causal transformer over interleaved (RTG, obs, action) tokens.
+
+    Sequence layout for a K-step context: [R_0 s_0 a_0 R_1 s_1 a_1 ...];
+    action logits for step t are read from the *state* token's output
+    (position 3t+1), so a_t is predicted from everything up to s_t.
+    """
+
+    def __init__(self, obs_space, action_space, model_config=None):
+        cfg = dict(model_config or {})
+        self.obs_dim = int(np.prod(obs_space.shape))
+        self.n_actions = int(action_space.n)
+        self.embed_dim = int(cfg.get("embed_dim", 64))
+        self.n_layers = int(cfg.get("n_layers", 2))
+        self.n_heads = int(cfg.get("n_heads", 2))
+        self.context_length = int(cfg.get("context_length", 20))
+        self.max_timestep = int(cfg.get("max_timestep", 2048))
+
+    def init_params(self, rng):
+        d = self.embed_dim
+        keys = jax.random.split(rng, 5 + self.n_layers)
+        layers = []
+        for i in range(self.n_layers):
+            lk = jax.random.split(keys[5 + i], 4)
+            layers.append({
+                "ln1": _ln_init(d),
+                "qkv": _dense_init(lk[0], d, 3 * d),
+                "proj": _dense_init(lk[1], d, d, scale=0.02),
+                "ln2": _ln_init(d),
+                "fc1": _dense_init(lk[2], d, 4 * d),
+                "fc2": _dense_init(lk[3], 4 * d, d, scale=0.02),
+            })
+        return {
+            "embed_rtg": _dense_init(keys[0], 1, d),
+            "embed_obs": _dense_init(keys[1], self.obs_dim, d),
+            "embed_act": jax.random.normal(keys[2], (self.n_actions + 1, d), jnp.float32) * 0.02,
+            "embed_t": jax.random.normal(keys[3], (self.max_timestep, d), jnp.float32) * 0.02,
+            "layers": layers,
+            "ln_f": _ln_init(d),
+            "head": _dense_init(keys[4], d, self.n_actions, scale=0.02),
+        }
+
+    def _block(self, p, x, mask):
+        B, T, d = x.shape
+        h = self.n_heads
+        y = _ln(p["ln1"], x)
+        qkv = _dense(p["qkv"], y).reshape(B, T, 3, h, d // h)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,h,hd]
+        att = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(d // h)
+        att = jnp.where(mask, att, -1e9)
+        att = jax.nn.softmax(att, axis=-1)
+        y = jnp.einsum("bhts,bshd->bthd", att, v).reshape(B, T, d)
+        x = x + _dense(p["proj"], y)
+        y = _ln(p["ln2"], x)
+        y = _dense(p["fc2"], jax.nn.gelu(_dense(p["fc1"], y)))
+        return x + y
+
+    def forward_seq(self, params, rtg, obs, actions, timesteps):
+        """rtg [B,K], obs [B,K,D], actions [B,K] int, timesteps [B,K] int
+        → action logits [B,K,n_actions] (one per state token)."""
+        B, K = rtg.shape
+        te = params["embed_t"][jnp.clip(timesteps, 0, self.max_timestep - 1)]  # [B,K,d]
+        er = _dense(params["embed_rtg"], rtg[..., None]) + te
+        eo = _dense(params["embed_obs"], obs) + te
+        ea = params["embed_act"][jnp.clip(actions, 0, self.n_actions)] + te
+        # interleave to [B, 3K, d]
+        x = jnp.stack([er, eo, ea], axis=2).reshape(B, 3 * K, self.embed_dim)
+        T = 3 * K
+        causal = jnp.tril(jnp.ones((T, T), bool))[None, None]  # [1,1,T,T]
+        for p in params["layers"]:
+            x = self._block(p, x, causal)
+        x = _ln(params["ln_f"], x)
+        state_tok = x.reshape(B, K, 3, self.embed_dim)[:, :, 1]  # output at s_t
+        return _dense(params["head"], state_tok)
+
+    # RLModule interface compatibility (single-obs forward is undefined
+    # for a sequence model; evaluation goes through DT.evaluate)
+    def forward(self, params, obs):
+        raise NotImplementedError("DTModule is sequence-conditioned; use forward_seq")
+
+
+class DTLearner(Learner):
+    """Masked cross-entropy over the context window's action tokens."""
+
+    def compute_loss(self, params, batch):
+        logits = self.module.forward_seq(
+            params, batch["rtg"], batch["obs"], batch["actions"], batch["timesteps"]
+        )
+        logp = jax.nn.log_softmax(logits)
+        tgt = jnp.take_along_axis(logp, batch["actions"][..., None], axis=-1)[..., 0]
+        mask = batch["mask"]
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = -(tgt * mask).sum() / denom
+        acc = ((jnp.argmax(logits, -1) == batch["actions"]) * mask).sum() / denom
+        return loss, {"total_loss": loss, "accuracy": acc}
+
+
+class DTConfig(AlgorithmConfig):
+    learner_class = DTLearner
+
+    def __init__(self):
+        super().__init__()
+        self.module_class = DTModule
+        self.model_config = {"embed_dim": 64, "n_layers": 2, "n_heads": 2, "context_length": 20}
+        self.offline_data: Any = None
+        self.rtg_scale = 100.0        # returns are divided by this before embedding
+        self.target_return = None     # eval conditioning; defaults to best seen
+        self.windows_per_iter = 2048  # sampled context windows per train()
+        self.lr = 3e-4
+        self.minibatch_size = 128
+        self.num_epochs = 1
+
+    def offline(self, data=None):
+        """data: {"obs": [N,D], "actions": [N], "rewards": [N], "dones": [N]}
+        flat transition arrays (episodes split on `dones`), or a list of
+        per-episode dicts with those keys."""
+        if data is not None:
+            self.offline_data = data
+        return self
+
+    def copy(self) -> "DTConfig":
+        data, self.offline_data = self.offline_data, None
+        try:
+            out = super().copy()
+        finally:
+            self.offline_data = data
+        out.offline_data = data
+        return out
+
+
+class DT(Algorithm):
+    config_class = DTConfig
+
+    def __init__(self, config):
+        if config.offline_data is None:
+            raise ValueError(
+                "DT requires offline episodes: DTConfig().offline({'obs': ..., "
+                "'actions': ..., 'rewards': ..., 'dones': ...})"
+            )
+        self.config = config
+        self.env_runner_group = None
+        self._spaces = env_spaces(config)
+        self.learner_group = LearnerGroup(config, *self._spaces)
+        self._iteration = 0
+        self._weights_seq = 0
+        self._env_steps_lifetime = 0
+        self._recent_returns: List[float] = []
+        self._episodes = self._segment(config.offline_data)
+        self._best_return = max(float(ep["rtg"][0]) for ep in self._episodes)
+        self._rng = np.random.default_rng(config.seed)
+        self._eval_module = None
+        self._act_fn = None
+
+    @staticmethod
+    def _segment(data) -> List[Dict[str, np.ndarray]]:
+        """Split flat transition arrays into episodes and precompute
+        returns-to-go (reverse cumulative rewards)."""
+        if isinstance(data, list):
+            episodes = [
+                {
+                    "obs": np.asarray(ep["obs"], np.float32),
+                    "actions": np.asarray(ep["actions"], np.int64),
+                    "rewards": np.asarray(ep["rewards"], np.float32),
+                }
+                for ep in data
+            ]
+        else:
+            obs = np.asarray(data["obs"], np.float32)
+            act = np.asarray(data["actions"], np.int64)
+            rew = np.asarray(data["rewards"], np.float32)
+            dones = np.asarray(data["dones"], bool)
+            episodes = []
+            start = 0
+            for i in range(len(dones)):
+                if dones[i]:
+                    episodes.append({
+                        "obs": obs[start : i + 1],
+                        "actions": act[start : i + 1],
+                        "rewards": rew[start : i + 1],
+                    })
+                    start = i + 1
+            if start < len(dones):
+                episodes.append({"obs": obs[start:], "actions": act[start:], "rewards": rew[start:]})
+        for ep in episodes:
+            ep["rtg"] = np.cumsum(ep["rewards"][::-1])[::-1].astype(np.float32)
+        return [ep for ep in episodes if len(ep["actions"]) > 0]
+
+    def _sample_windows(self, n: int) -> Dict[str, np.ndarray]:
+        cfg = self.config
+        K = int(cfg.model_config.get("context_length", 20))
+        D = int(np.prod(self._spaces[0].shape))
+        lens = np.asarray([len(ep["actions"]) for ep in self._episodes], np.float64)
+        probs = lens / lens.sum()  # sample windows ∝ episode length
+        eps = self._rng.choice(len(self._episodes), size=n, p=probs)
+        batch = {
+            "rtg": np.zeros((n, K), np.float32),
+            "obs": np.zeros((n, K, D), np.float32),
+            "actions": np.zeros((n, K), np.int64),
+            "timesteps": np.zeros((n, K), np.int64),
+            "mask": np.zeros((n, K), np.float32),
+        }
+        for i, e in enumerate(eps):
+            ep = self._episodes[e]
+            T = len(ep["actions"])
+            end = int(self._rng.integers(1, T + 1))  # window covers [end-k, end)
+            k = min(K, end)
+            sl = slice(end - k, end)
+            batch["rtg"][i, K - k :] = ep["rtg"][sl] / cfg.rtg_scale
+            batch["obs"][i, K - k :] = ep["obs"][sl].reshape(k, D)
+            batch["actions"][i, K - k :] = ep["actions"][sl]
+            batch["timesteps"][i, K - k :] = np.arange(end - k, end)
+            batch["mask"][i, K - k :] = 1.0
+        return batch
+
+    def training_step(self) -> Dict[str, Any]:
+        batch = self._sample_windows(self.config.windows_per_iter)
+        stats = self.learner_group.update(batch)
+        self._weights_seq += 1
+        return {
+            "learner": stats,
+            "episode_return_mean": float("nan"),
+            "num_offline_episodes": len(self._episodes),
+        }
+
+    def evaluate(self, num_episodes: int = 10, target_return: float = None) -> Dict[str, Any]:
+        """Roll out the model conditioned on a target return (defaults to
+        the best return in the dataset — 'be as good as the best you saw')."""
+        from ray_tpu.rllib.utils.env import make_single_env
+
+        cfg = self.config
+        if target_return is None:
+            target_return = cfg.target_return if cfg.target_return is not None else self._best_return
+        if self._eval_module is None:
+            self._eval_module = cfg.build_module(*self._spaces)
+            self._act_fn = jax.jit(self._eval_module.forward_seq)
+        weights = self.learner_group.get_weights()
+        K = self._eval_module.context_length
+        D = self._eval_module.obs_dim
+        env = make_single_env(cfg)
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=2000 + ep)
+            rtgs: List[float] = [target_return / cfg.rtg_scale]
+            obs_hist: List[np.ndarray] = [np.asarray(obs, np.float32).reshape(D)]
+            act_hist: List[int] = []
+            total, done, t = 0.0, False, 0
+            while not done:
+                k = min(K, len(obs_hist))
+                b = {
+                    "rtg": np.zeros((1, K), np.float32),
+                    "obs": np.zeros((1, K, D), np.float32),
+                    "actions": np.zeros((1, K), np.int64),
+                    "timesteps": np.zeros((1, K), np.int64),
+                }
+                b["rtg"][0, K - k :] = rtgs[-k:]
+                b["obs"][0, K - k :] = np.stack(obs_hist[-k:])
+                # a_t not yet taken: pad id at the last slot
+                acts = act_hist[-(k - 1) :] + [self._eval_module.n_actions] if k > 1 else [
+                    self._eval_module.n_actions
+                ]
+                b["actions"][0, K - k :] = acts
+                b["timesteps"][0, K - k :] = np.arange(t - k + 1, t + 1)
+                logits = self._act_fn(weights, b["rtg"], b["obs"], b["actions"], b["timesteps"])
+                action = int(jnp.argmax(logits[0, -1]))
+                obs, r, term, trunc, _ = env.step(action)
+                total += float(r)
+                act_hist.append(action)
+                rtgs.append(rtgs[-1] - float(r) / cfg.rtg_scale)
+                obs_hist.append(np.asarray(obs, np.float32).reshape(D))
+                t += 1
+                done = term or trunc
+            returns.append(total)
+        env.close()
+        return {"episode_return_mean": float(np.mean(returns)), "episodes": returns}
+
+    def stop(self) -> None:
+        pass
+
+
+DTConfig.algo_class = DT

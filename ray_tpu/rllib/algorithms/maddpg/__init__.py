@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.maddpg.maddpg import MADDPG, MADDPGConfig  # noqa: F401

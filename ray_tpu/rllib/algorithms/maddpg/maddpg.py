@@ -1,0 +1,254 @@
+"""MADDPG — multi-agent DDPG with centralized critics.
+
+Equivalent of the reference's MADDPG
+(reference: rllib/algorithms/maddpg/maddpg.py — Lowe et al.:
+decentralized deterministic actors pi_i(o_i), centralized critics
+Q_i(o_all, a_all) trained off joint replay; target actors feed the
+critic targets, so each agent's training sees the others' policies
+and the nonstationarity of independent learners disappears).
+
+Jax-native: per-agent actor/critic pytrees, one jitted update that
+scans nothing — the agent set is static, so the joint concatenation
+and the per-agent losses unroll at trace time into a single XLA
+program. The env is driven driver-locally over the MultiAgentEnv dict
+API (like the reference's old-stack MADDPG, which was also a
+single-learner algorithm)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dreamerv3.dreamerv3 import _mlp, _mlp_init
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.actor_lr = 1e-3
+        self.critic_lr = 1e-3
+        self.gamma = 0.95
+        self.tau = 0.01
+        self.hidden = (64, 64)
+        self.train_batch_size = 256
+        self.replay_capacity = 100_000
+        self.exploration_noise = 0.3
+        self.noise_decay_steps = 15_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.updates_per_iter = 16
+        self.rollout_steps_per_iter = 100
+
+
+class MADDPG(Algorithm):
+    config_class = MADDPGConfig
+
+    def __init__(self, config: MADDPGConfig):
+        import optax
+
+        self.config = config
+        self.env_runner_group = None
+        self.learner_group = None
+        self._iteration = 0
+        self._weights_seq = 0
+        self._env_steps_lifetime = 0
+        self._recent_returns: List[float] = []
+        env_cls = config.env
+        self._env = env_cls(**(config.env_config or {})) if isinstance(env_cls, type) else env_cls
+        self.agents = list(self._env.possible_agents)
+        self.obs_dims = {
+            a: int(np.prod(self._env.observation_space(a).shape)) for a in self.agents
+        }
+        self.act_dims = {
+            a: int(np.prod(self._env.action_space(a).shape)) for a in self.agents
+        }
+        joint_obs = sum(self.obs_dims.values())
+        joint_act = sum(self.act_dims.values())
+        cfg = config
+
+        rng = jax.random.PRNGKey(cfg.seed)
+        self._rng, *keys = jax.random.split(rng, 1 + 2 * len(self.agents))
+        self.actors = {}
+        self.critics = {}
+        for i, a in enumerate(self.agents):
+            self.actors[a] = _mlp_init(
+                keys[2 * i], (self.obs_dims[a],) + tuple(cfg.hidden), self.act_dims[a], out_scale=0.01
+            )
+            self.critics[a] = _mlp_init(
+                keys[2 * i + 1], (joint_obs + joint_act,) + tuple(cfg.hidden), 1, out_scale=0.1
+            )
+        self.target_actors = jax.tree.map(jnp.asarray, self.actors)
+        self.target_critics = jax.tree.map(jnp.asarray, self.critics)
+
+        self._actor_opt = optax.adam(cfg.actor_lr)
+        self._critic_opt = optax.adam(cfg.critic_lr)
+        self._actor_opt_state = {a: self._actor_opt.init(self.actors[a]) for a in self.agents}
+        self._critic_opt_state = {a: self._critic_opt.init(self.critics[a]) for a in self.agents}
+
+        # joint replay: per-agent obs/act/next_obs + shared reward/done
+        self._replay: Dict[str, np.ndarray] = {}
+        self._next = 0
+        self._size = 0
+        self._np_rng = np.random.default_rng(cfg.seed)
+
+        self._build_update()
+        self._act_jit = jax.jit(self._act_all)
+        self._obs_now, _ = self._env.reset(seed=cfg.seed)
+        self._ep_ret = 0.0
+
+    # ---------------- policies -------------------------------------------
+    def _act_all(self, actors, obs_dict):
+        return {a: jnp.tanh(_mlp(actors[a], obs_dict[a])) for a in self.agents}
+
+    # ---------------- replay ---------------------------------------------
+    def _add(self, row: Dict[str, np.ndarray]) -> None:
+        cap = self.config.replay_capacity
+        if not self._replay:
+            for k, v in row.items():
+                self._replay[k] = np.zeros((cap,) + np.asarray(v).shape, np.float32)
+        i = self._next
+        for k, v in row.items():
+            self._replay[k][i] = v
+        self._next = (i + 1) % cap
+        self._size = min(self._size + 1, cap)
+
+    def _sample(self, n: int) -> Dict[str, jnp.ndarray]:
+        idx = self._np_rng.integers(0, self._size, size=n)
+        return {k: jnp.asarray(v[idx]) for k, v in self._replay.items()}
+
+    # ---------------- jitted update --------------------------------------
+    def _build_update(self):
+        import optax
+
+        cfg = self.config
+        agents = self.agents
+
+        def joint(batch, prefix):
+            return jnp.concatenate([batch[f"{prefix}_{a}"] for a in agents], -1)
+
+        def update(actors, critics, t_actors, t_critics, a_states, c_states, batch):
+            obs_all = joint(batch, "obs")
+            act_all = joint(batch, "act")
+            next_obs_all = joint(batch, "nobs")
+            # target joint action from the TARGET actors
+            next_act_all = jnp.concatenate(
+                [jnp.tanh(_mlp(t_actors[a], batch[f"nobs_{a}"])) for a in agents], -1
+            )
+            stats = {}
+            new_actors, new_critics = {}, {}
+            new_a_states, new_c_states = {}, {}
+            for a in agents:
+                q_next = _mlp(t_critics[a], jnp.concatenate([next_obs_all, next_act_all], -1))[..., 0]
+                y = batch["reward"] + cfg.gamma * (1.0 - batch["done"]) * q_next
+                y = jax.lax.stop_gradient(y)
+
+                def critic_loss(cp):
+                    q = _mlp(cp, jnp.concatenate([obs_all, act_all], -1))[..., 0]
+                    return jnp.mean((q - y) ** 2)
+
+                closs, cgrad = jax.value_and_grad(critic_loss)(critics[a])
+                cupd, c_state = self._critic_opt.update(cgrad, c_states[a], critics[a])
+                new_critics[a] = optax.apply_updates(critics[a], cupd)
+                new_c_states[a] = c_state
+
+                def actor_loss(ap):
+                    # replace only agent a's action with its current policy
+                    acts = [
+                        jnp.tanh(_mlp(ap, batch[f"obs_{b}"])) if b == a else batch[f"act_{b}"]
+                        for b in agents
+                    ]
+                    q = _mlp(
+                        jax.lax.stop_gradient(new_critics[a]),
+                        jnp.concatenate([obs_all, jnp.concatenate(acts, -1)], -1),
+                    )[..., 0]
+                    return -jnp.mean(q)
+
+                aloss, agrad = jax.value_and_grad(actor_loss)(actors[a])
+                aupd, a_state = self._actor_opt.update(agrad, a_states[a], actors[a])
+                new_actors[a] = optax.apply_updates(actors[a], aupd)
+                new_a_states[a] = a_state
+                stats[f"critic_loss_{a}"] = closs
+                stats[f"actor_loss_{a}"] = aloss
+            t_actors = jax.tree.map(
+                lambda t, p: (1 - cfg.tau) * t + cfg.tau * p, t_actors, new_actors
+            )
+            t_critics = jax.tree.map(
+                lambda t, p: (1 - cfg.tau) * t + cfg.tau * p, t_critics, new_critics
+            )
+            return new_actors, new_critics, t_actors, t_critics, new_a_states, new_c_states, stats
+
+        self._update = jax.jit(update)
+
+    # ---------------- training loop --------------------------------------
+    def _noise_scale(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps_lifetime / max(1, cfg.noise_decay_steps))
+        return cfg.exploration_noise * (1.0 - 0.9 * frac)
+
+    def _collect(self, steps: int) -> int:
+        cfg = self.config
+        for _ in range(steps):
+            obs_j = {a: jnp.asarray(self._obs_now[a], jnp.float32) for a in self.agents}
+            acts = self._act_jit(self.actors, obs_j)
+            scale = self._noise_scale()
+            action_dict = {
+                a: np.clip(
+                    np.asarray(acts[a], np.float32)
+                    + scale * self._np_rng.normal(size=self.act_dims[a]).astype(np.float32),
+                    -1.0, 1.0,
+                )
+                for a in self.agents
+            }
+            nobs, rewards, terms, truncs, _ = self._env.step(action_dict)
+            done = bool(terms.get("__all__")) or bool(truncs.get("__all__"))
+            row = {"reward": np.float32(np.mean([rewards[a] for a in self.agents])),
+                   "done": np.float32(terms.get("__all__", False) and not truncs.get("__all__", False))}
+            for a in self.agents:
+                row[f"obs_{a}"] = np.asarray(self._obs_now[a], np.float32)
+                row[f"act_{a}"] = np.asarray(action_dict[a], np.float32).reshape(self.act_dims[a])
+                row[f"nobs_{a}"] = np.asarray(nobs[a], np.float32)
+            self._add(row)
+            self._ep_ret += row["reward"]
+            self._env_steps_lifetime += 1
+            if done:
+                self._recent_returns.append(self._ep_ret)
+                self._recent_returns = self._recent_returns[-100:]
+                self._ep_ret = 0.0
+                self._obs_now, _ = self._env.reset()
+            else:
+                self._obs_now = nobs
+        return steps
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        sampled = self._collect(cfg.rollout_steps_per_iter)
+        stats: Dict[str, float] = {}
+        if self._size >= cfg.num_steps_sampled_before_learning_starts:
+            for _ in range(cfg.updates_per_iter):
+                batch = self._sample(cfg.train_batch_size)
+                (self.actors, self.critics, self.target_actors, self.target_critics,
+                 self._actor_opt_state, self._critic_opt_state, st) = self._update(
+                    self.actors, self.critics, self.target_actors, self.target_critics,
+                    self._actor_opt_state, self._critic_opt_state, batch,
+                )
+            stats = {k: float(v) for k, v in st.items()}
+        ret = float(np.mean(self._recent_returns[-20:])) if self._recent_returns else float("nan")
+        return {
+            "episode_return_mean": ret,
+            "num_env_steps": sampled,
+            "replay_size": self._size,
+            "learner": stats,
+        }
+
+    def compute_actions(self, obs_dict) -> Dict[str, np.ndarray]:
+        obs_j = {a: jnp.asarray(obs_dict[a], jnp.float32) for a in self.agents}
+        return {a: np.asarray(v) for a, v in self._act_jit(self.actors, obs_j).items()}
+
+    def stop(self) -> None:
+        pass
+
+
+MADDPGConfig.algo_class = MADDPG

@@ -52,6 +52,13 @@ class TD3EnvRunner(ContinuousOffPolicyEnvRunner):
     """Deterministic actions + Gaussian exploration noise (reference:
     TD3's exploration config — no entropy term to explore with)."""
 
+    def __init__(self, config, worker_index: int = 0):
+        super().__init__(config, worker_index)
+        # persistent generator: reseeding per step from _global_step
+        # (constant within a fragment) repeats the same draw every step
+        # of a fragment — correlated pseudo-noise, not exploration
+        self._noise_rng = np.random.default_rng(config.seed * 7919 + worker_index)
+
     def _select_actions(self, obs):
         self._rng, key = self._jax.random.split(self._rng)
         if self._warmup:
@@ -63,7 +70,7 @@ class TD3EnvRunner(ContinuousOffPolicyEnvRunner):
             )
         else:
             a, _ = self._sample_fn(self.params, obs.astype(np.float32), key)
-            noise = np.random.default_rng(int(self._global_step)).normal(
+            noise = self._noise_rng.normal(
                 0.0, self.config.exploration_noise, size=np.asarray(a).shape
             )
             action = np.clip(np.asarray(a, np.float32) + noise.astype(np.float32), -1.0, 1.0)
@@ -95,22 +102,28 @@ class TD3Learner(Learner):
         self._pi_opt = optax.adam(cfg.lr)
         self._pi_opt_state = self._pi_opt.init(self.params["pi"])
 
+        twin_q = getattr(cfg, "twin_q", True)
+
         def _grads(params, target_params, batch, rng, with_actor: bool):
             # target policy smoothing: clipped noise on the target action
+            # (DDPG sets target_noise=0 → the noise term traces away)
             noise = jnp.clip(
                 cfg.target_noise * jax.random.normal(rng, batch["actions"].shape),
                 -cfg.target_noise_clip, cfg.target_noise_clip,
             )
             next_a = jnp.clip(module.act(target_params, batch["next_obs"]) + noise, -1.0, 1.0)
             tq1, tq2 = module.q_values(target_params, batch["next_obs"], next_a)
+            tq = jnp.minimum(tq1, tq2) if twin_q else tq1
             target = batch["rewards"] + cfg.gamma * (
                 1.0 - batch["terminateds"].astype(jnp.float32)
-            ) * jnp.minimum(tq1, tq2)
+            ) * tq
             target = jax.lax.stop_gradient(target)
 
             def critic_loss(p):
                 q1, q2 = module.q_values(p, batch["obs"], batch["actions"])
-                return 0.5 * jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2), (q1 - target)
+                if twin_q:
+                    return 0.5 * jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2), (q1 - target)
+                return 0.5 * jnp.mean((q1 - target) ** 2), (q1 - target)
 
             (closs, td), cgrads = jax.value_and_grad(critic_loss, has_aux=True)(params)
             stats = {"critic_loss": closs, "mean_q_target": jnp.mean(target)}

@@ -101,3 +101,58 @@ class TwoAgentTarget(MultiAgentEnv):
         truncs = {a: False for a in self.possible_agents}
         truncs["__all__"] = False
         return obs, rewards, terms, truncs, {a: {} for a in self.possible_agents}
+
+
+class TwoAgentContinuousTarget(MultiAgentEnv):
+    """Continuous cooperative fixture (original): each agent applies a
+    1-D velocity in [-1, 1] to its own point; the SHARED reward is the
+    summed progress of both points toward their targets. The minimal
+    continuous-control shape for centralized-critic algorithms
+    (MADDPG): the optimal joint policy needs both agents moving."""
+
+    def __init__(self, horizon: int = 25):
+        import gymnasium as gym
+
+        self.possible_agents = ["a0", "a1"]
+        obs_sp = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+        act_sp = gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
+        self.observation_spaces = {a: obs_sp for a in self.possible_agents}
+        self.action_spaces = {a: act_sp for a in self.possible_agents}
+        self.horizon = horizon
+        self._rng = np.random.default_rng(0)
+        self.step_size = 0.25
+
+    def _obs(self):
+        return {
+            a: np.array([self._pos[a], self._target[a]], np.float32)
+            for a in self.possible_agents
+        }
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._pos = {a: 0.0 for a in self.possible_agents}
+        self._target = {
+            a: float(self._rng.choice([-0.8, 0.8])) for a in self.possible_agents
+        }
+        self._t = 0
+        return self._obs(), {a: {} for a in self.possible_agents}
+
+    def step(self, action_dict):
+        self._t += 1
+        shared = 0.0
+        for a in self.possible_agents:
+            act = float(np.clip(np.asarray(action_dict[a]).reshape(-1)[0], -1.0, 1.0))
+            before = abs(self._pos[a] - self._target[a])
+            self._pos[a] = float(np.clip(self._pos[a] + self.step_size * act, -1.0, 1.0))
+            shared += before - abs(self._pos[a] - self._target[a])
+        done = self._t >= self.horizon
+        obs = self._obs()
+        rewards = {a: shared for a in self.possible_agents}
+        terms = {a: False for a in self.possible_agents}
+        terms["__all__"] = False
+        # horizon end is a TRUNCATION: the state isn't terminal, so the
+        # critic target must keep bootstrapping through it
+        truncs = {a: done for a in self.possible_agents}
+        truncs["__all__"] = done
+        return obs, rewards, terms, truncs, {a: {} for a in self.possible_agents}

@@ -36,8 +36,9 @@ class ReplayBuffer:
             v = np.asarray(v)
             self._storage[k] = np.empty((self.capacity,) + v.shape[1:], dtype=v.dtype)
 
-    def add(self, batch: Dict[str, np.ndarray]) -> None:
-        """Append a batch of transitions (each value shaped (N, ...))."""
+    def add(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        """Append a batch of transitions (each value shaped (N, ...));
+        returns the storage indices written."""
         self._ensure_storage(batch)
         n = len(next(iter(batch.values())))
         idx = (self._next + np.arange(n)) % self.capacity
@@ -46,6 +47,7 @@ class ReplayBuffer:
         self._next = int((self._next + n) % self.capacity)
         self._size = int(min(self._size + n, self.capacity))
         self._on_add(idx)
+        return idx
 
     def _on_add(self, idx: np.ndarray) -> None:  # PER hook
         pass
@@ -118,6 +120,20 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         out = {k: v[idx] for k, v in self._storage.items()}
         out["weights"] = weights.astype(np.float32)
         return out
+
+    def add_with_priorities(self, batch: Dict[str, np.ndarray],
+                            priorities: Optional[np.ndarray] = None) -> None:
+        """Append with producer-computed initial priorities (APEX: the
+        env runner scores its own transitions by TD error so fresh data
+        competes immediately instead of entering at max priority)."""
+        idx = self.add(batch)
+        if priorities is not None:
+            prios = (np.abs(np.asarray(priorities, np.float64)) + self.eps) ** self.alpha
+            self._tree.set(idx, prios)
+            if len(priorities):
+                self._max_priority = max(
+                    self._max_priority, float(np.abs(priorities).max() + self.eps)
+                )
 
     def update_priorities(self, td_errors: np.ndarray) -> None:
         """Re-prioritize the transitions returned by the last sample()."""

@@ -117,6 +117,26 @@ def cmd_submit(args):
         sys.exit(0 if status == "SUCCEEDED" else 1)
 
 
+def cmd_debug(args):
+    """Attach to a waiting remote breakpoint (reference: `ray debug`)."""
+    import ray_tpu
+    from ray_tpu.util import rpdb
+
+    ray_tpu.init(address=args.address or "auto")
+    bps = rpdb.list_breakpoints()
+    if not bps:
+        print("no active breakpoints")
+        return
+    for i, bp in enumerate(bps):
+        print(f"[{i}] pid={bp['pid']} {bp['where']} ({bp['host']}:{bp['port']})")
+    idx = args.index
+    if idx is None:
+        idx = 0 if len(bps) == 1 else int(input("attach to which breakpoint? "))
+    bp = bps[idx]
+    print(f"attaching to {bp['host']}:{bp['port']} — pdb commands apply in the remote frame")
+    rpdb.connect(bp["host"], bp["port"])
+
+
 def cmd_up(args):
     """Launch a cluster from a YAML config and keep the autoscaler
     reconciling until interrupted (reference: `ray up` +
@@ -184,6 +204,11 @@ def main(argv=None):
 
     p = sub.add_parser("status", help="show cluster nodes/actors/jobs")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("debug", help="attach to an active remote breakpoint")
+    p.add_argument("--address", default=None)
+    p.add_argument("--index", type=int, default=None, help="breakpoint index to attach to")
+    p.set_defaults(fn=cmd_debug)
 
     p = sub.add_parser("submit", help="submit a job (everything after -- is the entrypoint)")
     p.add_argument("--address", default=None)

@@ -39,7 +39,7 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x5250554153544f52ULL;  // "RPUASTOR"
+constexpr uint64_t kMagic = 0x5250554153544f53ULL;  // "RPUASTOS" (v2: LRU list)
 constexpr uint32_t kIdLen = 16;
 constexpr uint64_t kAlign = 64;  // cacheline-align object payloads
 
@@ -58,6 +58,13 @@ struct Entry {
   uint64_t lru_tick;
   uint32_t pending_delete;
   uint32_t pad;
+  // intrusive LRU list of EVICTABLE entries (sealed, refcount 0, not
+  // pending-delete), links are table index + 1 (0 = none). Makes
+  // eviction O(1) instead of an O(table_capacity) scan per evicted
+  // object — under arena churn (fan-out bursts, multi-client puts past
+  // the arena size) the scan dominated create() lock hold times.
+  uint64_t lru_next;
+  uint64_t lru_prev;
 };
 
 // Free/used block header (boundary-tagged).
@@ -81,6 +88,8 @@ struct Header {
   uint64_t used_bytes;
   uint64_t num_objects;
   uint64_t lru_counter;
+  uint64_t lru_head;  // coldest evictable entry (table index + 1)
+  uint64_t lru_tail;  // hottest evictable entry (table index + 1)
   pthread_mutex_t mutex;
   pthread_cond_t cond;
 };
@@ -104,6 +113,45 @@ inline Block* block_at(Store* s, uint64_t off) {
 
 inline uint64_t bsize(Block* b) { return b->size & ~kUsedBit; }
 inline bool bused(Block* b) { return b->size & kUsedBit; }
+
+// --- evictable-entry LRU list (all ops under the store mutex) ---
+
+inline uint64_t entry_index(Store* s, Entry* e) {
+  return (uint64_t)(e - table(s)) + 1;  // +1: 0 means "none"
+}
+
+inline Entry* entry_at(Store* s, uint64_t idx1) {
+  return idx1 ? &table(s)[idx1 - 1] : nullptr;
+}
+
+void lru_remove(Store* s, Entry* e) {
+  Entry* prev = entry_at(s, e->lru_prev);
+  Entry* next = entry_at(s, e->lru_next);
+  if (prev)
+    prev->lru_next = e->lru_next;
+  else if (s->hdr->lru_head == entry_index(s, e))
+    s->hdr->lru_head = e->lru_next;
+  if (next)
+    next->lru_prev = e->lru_prev;
+  else if (s->hdr->lru_tail == entry_index(s, e))
+    s->hdr->lru_tail = e->lru_prev;
+  e->lru_next = e->lru_prev = 0;
+}
+
+void lru_push_tail(Store* s, Entry* e) {
+  uint64_t idx = entry_index(s, e);
+  e->lru_next = 0;
+  e->lru_prev = s->hdr->lru_tail;
+  Entry* tail = entry_at(s, s->hdr->lru_tail);
+  if (tail) tail->lru_next = idx;
+  s->hdr->lru_tail = idx;
+  if (!s->hdr->lru_head) s->hdr->lru_head = idx;
+}
+
+inline bool lru_linked(Store* s, Entry* e) {
+  return e->lru_prev != 0 || e->lru_next != 0 ||
+         s->hdr->lru_head == entry_index(s, e);
+}
 
 uint64_t hash_id(const uint8_t* id) {
   // FNV-1a over the 16-byte id
@@ -248,6 +296,7 @@ void dealloc(Store* s, uint64_t payload_off) {
 }
 
 void free_entry_payload(Store* s, Entry* e) {
+  if (lru_linked(s, e)) lru_remove(s, e);
   dealloc(s, e->offset);
   e->state = kTombstone;
   e->refcount = 0;
@@ -257,20 +306,12 @@ void free_entry_payload(Store* s, Entry* e) {
 
 // Evict the oldest sealed refcount-0 object. Equivalent role to plasma's
 // LRU EvictionPolicy (reference:
-// src/ray/object_manager/plasma/eviction_policy.cc). Returns false when
-// nothing is evictable.
+// src/ray/object_manager/plasma/eviction_policy.cc). O(1): pop the head
+// of the evictable LRU list. Returns false when nothing is evictable.
 bool evict_one(Store* s) {
-  Entry* t = table(s);
-  uint64_t cap = s->hdr->table_capacity;
-  Entry* victim = nullptr;
-  for (uint64_t i = 0; i < cap; i++) {
-    Entry* e = &t[i];
-    if (e->state == kSealed && e->refcount == 0 &&
-        (!victim || e->lru_tick < victim->lru_tick))
-      victim = e;
-  }
+  Entry* victim = entry_at(s, s->hdr->lru_head);
   if (!victim) return false;
-  free_entry_payload(s, victim);
+  free_entry_payload(s, victim);  // unlinks
   return true;
 }
 
@@ -429,6 +470,7 @@ int shm_store_create(void* handle, const uint8_t* id, uint64_t size, uint64_t* o
   e->refcount = 1;  // creator holds a ref until seal+release
   e->lru_tick = ++s->hdr->lru_counter;
   e->pending_delete = 0;
+  e->lru_next = e->lru_prev = 0;  // tombstone reuse: clear stale links
   s->hdr->num_objects++;
   unlock(s);
   *offset_out = off;
@@ -445,6 +487,12 @@ int shm_store_seal(void* handle, const uint8_t* id) {
   }
   e->state = kSealed;
   e->refcount -= 1;  // drop creator ref
+  if (e->refcount == 0) {
+    if (e->pending_delete)
+      free_entry_payload(s, e);  // deleted mid-put: nothing to keep
+    else
+      lru_push_tail(s, e);
+  }
   pthread_cond_broadcast(&s->hdr->cond);
   unlock(s);
   return ST_OK;
@@ -473,6 +521,7 @@ int shm_store_get(void* handle, const uint8_t* id, uint64_t* offset_out,
     if (e && e->state == kSealed && !e->pending_delete) {
       // pending_delete entries are DELETED from readers' point of view:
       // their payload only survives for refs taken before the delete
+      if (e->refcount == 0) lru_remove(s, e);  // pinned: not evictable
       e->refcount++;
       e->lru_tick = ++s->hdr->lru_counter;
       *offset_out = e->offset;
@@ -538,7 +587,12 @@ int shm_store_release(void* handle, const uint8_t* id) {
     return ST_NOT_FOUND;
   }
   if (e->refcount > 0) e->refcount--;
-  if (e->refcount == 0 && e->pending_delete) free_entry_payload(s, e);
+  if (e->refcount == 0) {
+    if (e->pending_delete)
+      free_entry_payload(s, e);
+    else if (!lru_linked(s, e))
+      lru_push_tail(s, e);  // last reader gone: evictable again
+  }
   unlock(s);
   return ST_OK;
 }
@@ -576,6 +630,20 @@ void shm_store_usage(void* handle, uint64_t* used, uint64_t* capacity, uint64_t*
 static int list_cold(Store* s, uint8_t* out, uint64_t* sizes, int max_n,
                      bool include_pinned) {
   if (max_n > 256) max_n = 256;
+  if (!include_pinned) {
+    // exact LRU order for free: walk the evictable list from the cold end
+    int n = 0;
+    lock(s);
+    for (Entry* e = entry_at(s, s->hdr->lru_head); e && n < max_n;
+         e = entry_at(s, e->lru_next)) {
+      if (e->pending_delete) continue;  // defensive: deleted-for-readers
+      memcpy(out + n * kIdLen, e->id, kIdLen);
+      sizes[n] = e->size;
+      n++;
+    }
+    unlock(s);
+    return n;
+  }
   // ONE table scan under the lock (an O(max_n * capacity) selection sort
   // would stall every concurrent get/put for the duration): keep the
   // max_n coldest entries in a small insertion-sorted window.

@@ -1,0 +1,266 @@
+"""GBDTTrainer — distributed gradient-boosted decision trees.
+
+Equivalent of the reference's GBDT trainers
+(reference: python/ray/train/gbdt_trainer.py + xgboost/xgboost_trainer.py,
+lightgbm/lightgbm_trainer.py — thin wrappers around distributed
+xgboost/lightgbm). Those libraries aren't in this image, so the
+capability is implemented natively: histogram-based boosting in the
+xgboost "approx" shape — quantile feature binning, per-shard
+gradient/hessian histograms computed as tasks over Dataset blocks,
+driver-side split search and level-wise tree growth. The distributed
+pattern matches the reference's: data stays sharded in the object
+store; only fixed-size histograms (bins x features x 2 floats) travel
+per boosting round.
+
+Supports squared-error regression and binary logistic classification.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _bin_shard(blk, feature_columns, label_column, edges):
+    """Bin one block's features; returns (binned uint8 [N,F], labels)."""
+    import numpy as np
+
+    from ray_tpu.data import block as B
+
+    rows = B.block_to_batch(blk, "numpy")
+    X = np.stack([np.asarray(rows[c], np.float64) for c in feature_columns], 1)
+    y = np.asarray(rows[label_column], np.float64)
+    binned = np.empty(X.shape, np.uint8)
+    for f in range(X.shape[1]):
+        binned[:, f] = np.searchsorted(edges[f], X[:, f], side="left")
+    return binned, y
+
+
+@ray_tpu.remote
+def _histogram_shard(binned_labels, preds, n_bins, node_ids, n_nodes, objective):
+    """Per-shard grad/hess histograms for every open node:
+    [n_nodes, F, n_bins, 2]."""
+    import numpy as np
+
+    binned, y = binned_labels
+    if objective == "binary:logistic":
+        p = 1.0 / (1.0 + np.exp(-preds))
+        grad = p - y
+        hess = np.maximum(p * (1.0 - p), 1e-9)
+    else:
+        grad = preds - y
+        hess = np.ones_like(y)
+    N, F = binned.shape
+    out = np.zeros((n_nodes, F, n_bins, 2), np.float64)
+    for node in range(n_nodes):
+        mask = node_ids == node
+        if not mask.any():
+            continue
+        b = binned[mask]
+        g = grad[mask]
+        h = hess[mask]
+        for f in range(F):
+            out[node, f, :, 0] = np.bincount(b[:, f], weights=g, minlength=n_bins)
+            out[node, f, :, 1] = np.bincount(b[:, f], weights=h, minlength=n_bins)
+    return out
+
+
+@ray_tpu.remote
+def _apply_tree_shard(binned_labels, node_ids, splits):
+    """Route each shard row one level down: splits = {node: (f, bin)};
+    children ids are 2*node+1 / 2*node+2 in a level-order numbering."""
+    import numpy as np
+
+    binned, _ = binned_labels
+    # rows of nodes that became leaves this level KEEP their node id so
+    # the leaf-value update still reaches them
+    new_ids = node_ids.copy()
+    for node, (f, thr_bin) in splits.items():
+        mask = node_ids == node
+        go_left = binned[mask, f] <= thr_bin
+        ids = np.where(go_left, 2 * node + 1, 2 * node + 2)
+        new_ids[mask] = ids
+    return new_ids
+
+
+@ray_tpu.remote
+def _update_preds_shard(preds, node_ids, leaf_values, lr):
+    import numpy as np
+
+    leaf = np.asarray([leaf_values.get(int(n), 0.0) for n in node_ids])
+    return preds + lr * leaf
+
+
+class _Tree:
+    """One regression tree: parallel-array nodes in level-order
+    numbering (node k's children are 2k+1 / 2k+2)."""
+
+    def __init__(self, max_depth: int):
+        size = 2 ** (max_depth + 1) - 1
+        self.feature = np.full(size, -1, np.int32)
+        self.threshold = np.zeros(size, np.float64)  # raw-value threshold
+        self.value = np.zeros(size, np.float64)
+        self.is_leaf = np.zeros(size, bool)
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        out = np.empty(len(X))
+        for i, x in enumerate(X):
+            node = 0
+            while not self.is_leaf[node] and self.feature[node] >= 0:
+                node = 2 * node + 1 if x[self.feature[node]] <= self.threshold[node] else 2 * node + 2
+            out[i] = self.value[node]
+        return out
+
+
+class GBDTModel:
+    """A fitted booster: bias + lr-scaled trees."""
+
+    def __init__(self, trees: List[_Tree], bias: float, lr: float,
+                 feature_columns: List[str], objective: str):
+        self.trees = trees
+        self.bias = bias
+        self.lr = lr
+        self.feature_columns = feature_columns
+        self.objective = objective
+
+    def predict(self, X) -> np.ndarray:
+        if isinstance(X, dict):
+            X = np.stack([np.asarray(X[c], np.float64) for c in self.feature_columns], 1)
+        X = np.asarray(X, np.float64)
+        raw = np.full(len(X), self.bias)
+        for t in self.trees:
+            raw = raw + self.lr * t.predict(X)
+        if self.objective == "binary:logistic":
+            return 1.0 / (1.0 + np.exp(-raw))
+        return raw
+
+
+class GBDTTrainer:
+    """Distributed histogram GBDT (reference: train/gbdt_trainer.py API
+    shape — datasets + label_column + params; `fit()` returns a result
+    with the fitted model)."""
+
+    def __init__(self, *, datasets: Dict[str, Any], label_column: str,
+                 params: Optional[Dict[str, Any]] = None,
+                 feature_columns: Optional[List[str]] = None,
+                 num_boost_round: int = 20):
+        self.train_ds = datasets["train"]
+        self.label_column = label_column
+        p = dict(params or {})
+        self.objective = p.get("objective", "reg:squarederror")
+        self.max_depth = int(p.get("max_depth", 3))
+        self.lr = float(p.get("eta", p.get("learning_rate", 0.3)))
+        self.reg_lambda = float(p.get("lambda", 1.0))
+        self.min_child_weight = float(p.get("min_child_weight", 1.0))
+        self.n_bins = int(p.get("max_bin", 32))
+        self.num_boost_round = num_boost_round
+        self.feature_columns = feature_columns
+
+    def fit(self) -> "GBDTResult":
+        refs = self.train_ds._execute_refs()
+        # column discovery + quantile bin edges from the first block
+        from ray_tpu.data import block as B
+
+        first = B.block_to_batch(ray_tpu.get(refs[0]), "numpy")
+        feats = self.feature_columns or [c for c in first.keys() if c != self.label_column]
+        sample = np.stack([np.asarray(first[c], np.float64) for c in feats], 1)
+        qs = np.linspace(0, 1, self.n_bins)[1:]
+        edges = [np.unique(np.quantile(sample[:, f], qs)) for f in range(len(feats))]
+
+        binned_refs = [_bin_shard.remote(r, feats, self.label_column, edges) for r in refs]
+        # ONE materialization for sizes + label sums; afterwards only
+        # fixed-size histograms travel per boosting round
+        shards = ray_tpu.get(binned_refs)
+        shard_sizes = [len(b[1]) for b in shards]
+        total = sum(shard_sizes)
+        mean_y = sum(float(np.sum(b[1])) for b in shards) / total
+        del shards
+        if self.objective == "binary:logistic":
+            mean_y = min(max(mean_y, 1e-6), 1 - 1e-6)
+            bias = math.log(mean_y / (1 - mean_y))
+        else:
+            bias = mean_y
+
+        pred_refs = [ray_tpu.put(np.full(n, bias)) for n in shard_sizes]
+        trees: List[_Tree] = []
+        for _ in range(self.num_boost_round):
+            tree, pred_refs = self._boost_one(binned_refs, pred_refs, feats, edges, shard_sizes)
+            trees.append(tree)
+        self.model = GBDTModel(trees, bias, self.lr, feats, self.objective)
+        return GBDTResult(self.model)
+
+    def _boost_one(self, binned_refs, pred_refs, feats, edges, shard_sizes) -> Tuple[_Tree, list]:
+        F = len(feats)
+        n_bins = self.n_bins
+        tree = _Tree(self.max_depth)
+        # node ids per shard, level-order numbering
+        id_refs = [ray_tpu.put(np.zeros(n, np.int64)) for n in shard_sizes]
+        open_nodes = [0]
+        for depth in range(self.max_depth):
+            hist_refs = [
+                _histogram_shard.remote(b, p, n_bins, i, 2 ** (depth + 1) - 1, self.objective)
+                for b, p, i in zip(binned_refs, pred_refs, id_refs)
+            ]
+            hist = sum(ray_tpu.get(hist_refs))  # [nodes, F, bins, 2]
+            splits: Dict[int, Tuple[int, int]] = {}
+            next_open = []
+            for node in open_nodes:
+                G = hist[node, :, :, 0]
+                H = hist[node, :, :, 1]
+                g_tot, h_tot = G[0].sum(), H[0].sum()
+                base = g_tot * g_tot / (h_tot + self.reg_lambda)
+                best_gain, best = 0.0, None
+                gl = np.cumsum(G, 1)
+                hl = np.cumsum(H, 1)
+                gr = g_tot - gl
+                hr = h_tot - hl
+                valid = (hl >= self.min_child_weight) & (hr >= self.min_child_weight)
+                gain = gl**2 / (hl + self.reg_lambda) + gr**2 / (hr + self.reg_lambda) - base
+                gain = np.where(valid, gain, -np.inf)
+                f, b = np.unravel_index(np.argmax(gain), gain.shape)
+                if gain[f, b] > 1e-12 and np.isfinite(gain[f, b]):
+                    splits[node] = (int(f), int(b))
+                    tree.feature[node] = int(f)
+                    thr_edges = edges[f]
+                    tree.threshold[node] = thr_edges[min(int(b), len(thr_edges) - 1)]
+                    next_open += [2 * node + 1, 2 * node + 2]
+                else:
+                    tree.is_leaf[node] = True
+                    tree.value[node] = -g_tot / (h_tot + self.reg_lambda)
+            if not splits:
+                break
+            id_refs = [
+                _apply_tree_shard.remote(b, i, splits)
+                for b, i in zip(binned_refs, id_refs)
+            ]
+            open_nodes = next_open
+        # leaves at the frontier
+        if open_nodes:
+            hist_refs = [
+                _histogram_shard.remote(b, p, n_bins, i, 2 ** (self.max_depth + 1) - 1, self.objective)
+                for b, p, i in zip(binned_refs, pred_refs, id_refs)
+            ]
+            hist = sum(ray_tpu.get(hist_refs))
+            for node in open_nodes:
+                g_tot = hist[node, 0, :, 0].sum()
+                h_tot = hist[node, 0, :, 1].sum()
+                tree.is_leaf[node] = True
+                tree.value[node] = -g_tot / (h_tot + self.reg_lambda)
+        leaf_values = {
+            int(i): float(v) for i, v in enumerate(tree.value) if tree.is_leaf[i]
+        }
+        pred_refs = [
+            _update_preds_shard.remote(p, i, leaf_values, self.lr)
+            for p, i in zip(pred_refs, id_refs)
+        ]
+        return tree, pred_refs
+
+
+class GBDTResult:
+    def __init__(self, model: GBDTModel):
+        self.model = model
+        self.checkpoint = None

@@ -26,4 +26,5 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     MedianStoppingRule,
     PB2,
     PopulationBasedTraining,
+    ResourceChangingScheduler,
 )

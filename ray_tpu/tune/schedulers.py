@@ -323,3 +323,55 @@ class HyperBandForBOHB(HyperBandScheduler):
     scheduler and get the HB+model pairing documented here."""
 
     pass
+
+
+class ResourceChangingScheduler(FIFOScheduler):
+    """Reallocate trial resources mid-run (reference:
+    tune/schedulers/resource_changing_scheduler.py — wraps a base
+    scheduler; a `resources_allocation_function(trial_id, result,
+    current)` returns the trial's new resource dict, and a changed
+    allotment restarts the trial actor from its own latest checkpoint
+    with the new resources).
+
+    The default allocation function grows a trial's CPUs by one each
+    time it survives `grow_every` reports, capped at `max_cpus` — the
+    shape of the reference's DistributeResources default (promising
+    long-running trials soak up freed capacity) without needing a
+    cluster-state oracle in the scheduler.
+    """
+
+    def __init__(self, base_scheduler=None, resources_allocation_function=None,
+                 grow_every: int = 4, max_cpus: int = 4):
+        self.base = base_scheduler or FIFOScheduler()
+        self._alloc = resources_allocation_function
+        self.grow_every = grow_every
+        self.max_cpus = max_cpus
+        self._resources: Dict[str, Dict] = {}
+        self._reports: Dict[str, int] = collections.defaultdict(int)
+
+    def current_resources(self, trial_id: str) -> Dict:
+        return dict(self._resources.get(trial_id, {"num_cpus": 1}))
+
+    def _default_alloc(self, trial_id: str, result: Dict, current: Dict) -> Dict:
+        if self._reports[trial_id] % self.grow_every == 0:
+            cpus = min(int(current.get("num_cpus", 1)) + 1, self.max_cpus)
+            return dict(current, num_cpus=cpus)
+        return current
+
+    def on_result(self, trial_id: str, result: Dict):
+        decision = self.base.on_result(trial_id, result)
+        if decision != CONTINUE:
+            return decision
+        self._reports[trial_id] += 1
+        current = self.current_resources(trial_id)
+        alloc = self._alloc or self._default_alloc
+        new = alloc(trial_id, result, dict(current))
+        if new and new != current:
+            self._resources[trial_id] = dict(new)
+            return ("REALLOC", dict(new))
+        return CONTINUE
+
+    def on_complete(self, trial_id: str):
+        self.base.on_complete(trial_id)
+        self._resources.pop(trial_id, None)
+        self._reports.pop(trial_id, None)

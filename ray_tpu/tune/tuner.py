@@ -268,11 +268,13 @@ class Tuner:
                     searcher.on_trial_complete(tid, t.metrics)
 
         generations: Dict[str, int] = {}
+        trial_resources: Dict[str, Dict[str, Any]] = {}  # ResourceChanging
 
         def _launch(trial_id, config, restore_from=None):
             t = trials[trial_id]
             t.status = "RUNNING"
-            actor = _TrialActor.options(num_cpus=1).remote(trial_id, queue)
+            res = dict(trial_resources.get(trial_id) or {"num_cpus": 1})
+            actor = _TrialActor.options(**res).remote(trial_id, queue)
             done = actor.run.remote(
                 self._trainable, config, os.path.join(run_dir, trial_id), restore_from
             )
@@ -359,6 +361,18 @@ class Tuner:
                 new_config = scheduler.mutate(dict(trials[source].config))
                 t.config = new_config
                 _launch(tid, new_config, restore_from=_latest_checkpoint(source))
+            elif isinstance(decision, tuple) and decision[0] == "REALLOC":
+                # ResourceChangingScheduler: restart THIS trial from its
+                # own latest checkpoint with the new resource allotment
+                entry = running.pop(tid, None)
+                if entry is None:
+                    return
+                try:
+                    ray_tpu.kill(entry[0])
+                except Exception:
+                    pass
+                trial_resources[tid] = dict(decision[1])
+                _launch(tid, dict(t.config), restore_from=_latest_checkpoint(tid))
 
         def drain(block: bool = False, timeout: float = 0.05) -> bool:
             """Process queued reports; returns True if anything arrived."""

@@ -1,0 +1,180 @@
+"""Remote pdb — breakpoints inside tasks/actors on any node.
+
+Equivalent of the reference's rpdb (reference: python/ray/util/rpdb.py
++ the `ray debug` CLI): `ray_tpu.util.rpdb.set_trace()` inside remote
+code opens a TCP pdb server, advertises it in the GCS KV (ns "rpdb"),
+and blocks until a debugger attaches; `ray_tpu debug` on the driver
+lists active breakpoints and bridges the terminal to one.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pdb
+import socket
+import sys
+import time
+from typing import Any, Dict, List
+
+_KV_NS = "rpdb"
+
+
+class _SocketIO:
+    """File-ish adapter bridging pdb's stdin/stdout to one TCP client."""
+
+    def __init__(self, conn: socket.socket):
+        self._conn = conn
+        self._rfile = conn.makefile("r", encoding="utf-8", newline="\n")
+
+    def readline(self):
+        return self._rfile.readline()
+
+    def write(self, data: str):
+        try:
+            self._conn.sendall(data.encode())
+        except OSError:
+            pass
+        return len(data)
+
+    def flush(self):
+        pass
+
+
+class RemotePdb(pdb.Pdb):
+    def __init__(self, conn: socket.socket):
+        io = _SocketIO(conn)
+        super().__init__(stdin=io, stdout=io)
+        self.use_rawinput = False
+        self.prompt = "(rpdb) "
+        self._conn = conn
+
+    def _close_conn(self):
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+    # the session's socket closes when the user resumes or quits — no
+    # code may run after set_trace() installs the tracer (a trailing
+    # cleanup call would fire a --Call-- event and trap the debugger
+    # inside the rpdb machinery instead of the user frame)
+    def do_continue(self, arg):
+        r = super().do_continue(arg)
+        self._close_conn()
+        return r
+
+    do_c = do_cont = do_continue
+
+    def do_quit(self, arg):
+        try:
+            return super().do_quit(arg)
+        finally:
+            self._close_conn()
+
+    do_q = do_exit = do_quit
+
+
+def _kv(method: str, data: Dict[str, Any]):
+    from ray_tpu._private.worker import get_global_core
+
+    return get_global_core().gcs_request(method, data)
+
+
+def set_trace(frame=None):
+    """Open a breakpoint server and wait for `ray_tpu debug` to attach.
+
+    Registers {host, port, pid, where} under ns "rpdb" keyed by
+    "<pid>:<port>"; the record is removed when the session ends.
+    """
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    # bind all interfaces and advertise a routable address: the
+    # attaching driver may sit on another node of the cluster
+    server.bind(("0.0.0.0", 0))
+    server.listen(1)
+    port = server.getsockname()[1]
+    caller = frame or sys._getframe().f_back
+    key = f"{os.getpid()}:{port}"
+    try:
+        # the address other hosts reach THIS host by: route a UDP probe
+        # (no traffic is sent) and read the chosen source address
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        probe.connect(("8.8.8.8", 80))
+        host = probe.getsockname()[0]
+        probe.close()
+    except OSError:
+        host = "127.0.0.1"
+    rec = {
+        "host": host,
+        "port": port,
+        "pid": os.getpid(),
+        "where": f"{caller.f_code.co_filename}:{caller.f_lineno}",
+        "time": time.time(),
+    }
+    try:
+        _kv("kv.put", {"ns": _KV_NS, "key": key, "value": json.dumps(rec)})
+    except Exception:
+        pass  # not connected to a cluster: plain socket pdb still works
+    sys.stderr.write(f"rpdb waiting on 127.0.0.1:{port} ({rec['where']}) — attach with `ray_tpu debug`\n")
+    conn, _ = server.accept()
+    # ALL cleanup happens before the tracer installs: once set_trace
+    # returns, every new call from this frame fires a --Call-- event and
+    # would trap the session inside rpdb instead of the user's frame.
+    # The socket itself closes from RemotePdb.do_continue/do_quit.
+    try:
+        _kv("kv.del", {"ns": _KV_NS, "key": key})
+    except Exception:
+        pass
+    server.close()
+    RemotePdb(conn).set_trace(caller)
+
+
+def list_breakpoints() -> List[Dict[str, Any]]:
+    keys = _kv("kv.keys", {"ns": _KV_NS, "prefix": ""}) or []
+    out = []
+    for k in keys:
+        blob = _kv("kv.get", {"ns": _KV_NS, "key": k})
+        if blob:
+            out.append(json.loads(blob))
+    return out
+
+
+def connect(host: str, port: int, stdin=None, stdout=None) -> None:
+    """Bridge the local terminal to a waiting breakpoint server."""
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    sock = socket.create_connection((host, port), timeout=30)
+    sock.settimeout(0.2)
+    import threading
+
+    done = threading.Event()
+
+    def pump_in():
+        for line in stdin:
+            try:
+                sock.sendall(line.encode())
+            except OSError:
+                break
+            if done.is_set():
+                break
+
+    t = threading.Thread(target=pump_in, daemon=True)
+    t.start()
+    try:
+        while True:
+            try:
+                data = sock.recv(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not data:
+                break
+            stdout.write(data.decode(errors="replace"))
+            stdout.flush()
+    finally:
+        done.set()
+        try:
+            sock.close()
+        except OSError:
+            pass

@@ -164,3 +164,97 @@ head_node_type: head
         assert asc.worker_node_config["resources"]["TPU"] == 4.0
     finally:
         launcher.down()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler v2: scheduler / instance-manager split
+# (reference: python/ray/autoscaler/v2/)
+
+
+def test_v2_scheduler_pure_decisions():
+    """SchedulerV2 is a pure function: floors, best-fit type selection,
+    pending-launch dedup, infeasible filtering, idle termination."""
+    from ray_tpu.autoscaler.v2 import (
+        Instance, NodeTypeConfig, RUNNING, REQUESTED, SchedulerV2,
+    )
+
+    types = {
+        "cpu2": NodeTypeConfig("cpu2", {"CPU": 2.0}, min_workers=1, max_workers=4),
+        "v5e8": NodeTypeConfig("v5e8", {"CPU": 2.0, "TPU": 4.0}, max_workers=2, hosts_per_node=2),
+    }
+    sched = SchedulerV2(types, idle_timeout_s=5.0)
+
+    # empty cluster: the cpu2 floor launches
+    d = sched.schedule([], [], [], now=0.0)
+    assert d.to_launch == {"cpu2": 1}
+
+    # TPU gang demand picks the slice type, one launch covers both bundles
+    insts = [Instance("i0", "cpu2", RUNNING)]
+    d = sched.schedule([{"TPU": 4.0}, {"TPU": 4.0}], [{"CPU": 2.0}], insts, now=0.0)
+    assert d.to_launch.get("v5e8") == 1 and "cpu2" not in d.to_launch
+
+    # a REQUESTED slice already covers the demand: no double-launch
+    insts2 = insts + [Instance("i1", "v5e8", REQUESTED)]
+    d = sched.schedule([{"TPU": 4.0}, {"TPU": 4.0}], [{"CPU": 2.0}], insts2, now=0.0)
+    assert not d.to_launch
+
+    # infeasible shapes never launch
+    d = sched.schedule([{"GPU": 8.0}], [{"CPU": 2.0}], insts, now=0.0)
+    assert not d.to_launch and len(d.infeasible) == 1
+
+    # idle past the timeout terminates, but not below min_workers
+    idle = [
+        Instance("a", "cpu2", RUNNING, idle_since=1.0),
+        Instance("b", "cpu2", RUNNING, idle_since=1.0),
+    ]
+    d = sched.schedule([], [], idle, now=10.0)
+    assert len(d.to_terminate) == 1  # floor of 1 keeps the other
+
+
+def test_v2_end_to_end_mixed_node_types(small_cluster):
+    """AutoscalerV2 with a CPU pool AND a fake TPU-slice pool: CPU demand
+    launches cpu workers, a TPU gang launches a slice, both idle down."""
+    import numpy as np
+
+    from ray_tpu.autoscaler import LocalNodeProvider
+    from ray_tpu.autoscaler.tpu_slices import FakeSliceProvider
+    from ray_tpu.autoscaler.v2 import AutoscalerV2, NodeTypeConfig, RUNNING
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    providers = {
+        "cpu2": LocalNodeProvider(small_cluster, num_cpus=2),
+        "v5e8": FakeSliceProvider(small_cluster, slice_type="v5e-8", cpus_per_host=2),
+    }
+    types = {
+        "cpu2": NodeTypeConfig("cpu2", {"CPU": 2.0}, node_config={"num_cpus": 2}),
+        "v5e8": NodeTypeConfig(
+            "v5e8", {"CPU": 2.0, "TPU": 4.0}, max_workers=2, hosts_per_node=2
+        ),
+    }
+    asc = AutoscalerV2(providers, types, idle_timeout_s=3.0)
+
+    @ray_tpu.remote(num_cpus=2)
+    def crunch(x):
+        return x + 1
+
+    refs = [crunch.remote(i) for i in range(2)]
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="SLICE_PACK")
+    time.sleep(1)  # demand lands in the GCS pending queue
+    asc.update()
+    summary = asc.im.summary()
+    assert summary.get("cpu2", {}).get(RUNNING, 0) >= 1, summary
+    assert summary.get("v5e8", {}).get(RUNNING, 0) == 1, summary
+    assert ray_tpu.get(refs, timeout=120) == [1, 2]
+    assert pg.wait(60), "TPU gang not placed on the v2-launched slice"
+
+    remove_placement_group(pg)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        asc.update()
+        alive = sum(
+            len(p.non_terminated_nodes()) for p in providers.values()
+        )
+        if alive == 0:
+            break
+        time.sleep(1)
+    assert alive == 0, f"v2 idle scale-down incomplete: {asc.im.summary()}"

@@ -121,3 +121,36 @@ def test_cli_start_status_stop():
     with pytest.raises((ConnectionRefusedError, FileNotFoundError)):
         s.connect(gcs_sock)
     s.close()
+
+
+def test_job_submission_rest(ray_start_regular, tmp_path):
+    """The reference's primary job transport: a JobSubmissionClient
+    pointed at the dashboard's HTTP URL — submit, poll, logs, list —
+    with no cluster connection from the client side (reference:
+    dashboard/modules/job/job_head.py REST + sdk.py)."""
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    url_file = os.path.join(global_worker.session_dir, "dashboard_url")
+    deadline = time.time() + 20
+    while time.time() < deadline and not os.path.exists(url_file):
+        time.sleep(0.5)
+    if not os.path.exists(url_file):
+        pytest.skip("dashboard not running (aiohttp unavailable)")
+    base = open(url_file).read().strip()
+
+    client = JobSubmissionClient(base)  # REST mode: http:// address
+    script = tmp_path / "rest_job.py"
+    script.write_text("print('rest job output marker')\n")
+    env = {"PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} {script}",
+        runtime_env={"env_vars": env},
+    )
+    status = client.wait_until_finished(job_id, timeout=180)
+    assert status == JobStatus.SUCCEEDED, client.get_job_logs(job_id)
+    assert "rest job output marker" in client.get_job_logs(job_id)
+    assert any(j["job_id"] == job_id for j in client.list_jobs())
+    # unknown job 404s cleanly
+    with pytest.raises(KeyError):
+        client.get_job_status("raysubmit_nope")

@@ -263,3 +263,59 @@ def test_pool_exhaustion_queues_across_nodes(cluster):
         f"neither cross-node spill nor concurrency: nodes={nodes} elapsed={elapsed:.1f}s"
     )
     assert elapsed < 18 * 0.4 * 0.95, f"queueing starved throughput: {elapsed:.1f}s"
+
+
+def test_load_sync_at_scale_8_nodes():
+    """Syncer scale check (reference: ray_syncer bidi gossip scaled to
+    thousands of raylets; our centralized push design must at least keep
+    an 8-raylet cluster's load views fresh and its scheduler balanced).
+    Every node reports a load view, and a 64-task CPU-bound fan-out
+    lands work on ALL nodes rather than piling on the head."""
+    import collections
+    import subprocess
+    import sys as _sys
+
+    code = """
+import collections
+import time
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+for i in range(7):
+    c.add_node(num_cpus=1)
+c.connect()
+c.wait_for_nodes()
+assert len(ray_tpu.nodes()) == 8
+
+@ray_tpu.remote(num_cpus=1)
+def where(i):
+    import time as _t
+    _t.sleep(0.4)
+    import ray_tpu as rt
+    return rt.get_runtime_context().node_id
+
+spots = ray_tpu.get([where.remote(i) for i in range(64)], timeout=300)
+counts = collections.Counter(spots)
+assert len(counts) == 8, f"tasks only reached {len(counts)}/8 nodes: {counts}"
+# no node got more than 3x its fair share (8 tasks)
+assert max(counts.values()) <= 24, counts
+
+# every node's load view reached the GCS
+deadline = time.time() + 15
+while time.time() < deadline:
+    synced = [n for n in ray_tpu.nodes() if n.get("load", {}).get("store")]
+    if len(synced) == 8:
+        break
+    time.sleep(0.3)
+assert len(synced) == 8, f"only {len(synced)}/8 nodes pushed load views"
+print("SCALE SYNC OK")
+c.shutdown()
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [_sys.executable, "-c", code], capture_output=True, text=True, timeout=420,
+        env={**os.environ, "RAY_TPU_WORKER_POOL_PRESTART": "1",
+             "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")},
+    )
+    assert "SCALE SYNC OK" in r.stdout, r.stdout[-2000:] + "\n" + r.stderr[-2000:]

@@ -5,6 +5,7 @@ ray.timeline / ray.util.multiprocessing).
 import json
 import os
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -77,3 +78,31 @@ def test_multiprocessing_pool(ray_start_regular):
         assert list(p.imap(lambda x: -x, [1, 2, 3])) == [-1, -2, -3]
         r = p.map_async(lambda x: x + 1, range(5))
         assert r.get(timeout=60) == [1, 2, 3, 4, 5]
+
+
+def test_dashboard_log_endpoints(ray_start_regular):
+    """Log browsing over HTTP: index lists session log files, tail
+    returns content (reference: dashboard/modules/log)."""
+    from ray_tpu._private.worker import global_worker
+
+    url_file = os.path.join(global_worker.session_dir, "dashboard_url")
+    deadline = time.time() + 20
+    while time.time() < deadline and not os.path.exists(url_file):
+        time.sleep(0.5)
+    if not os.path.exists(url_file):
+        pytest.skip("dashboard not running")
+    base = open(url_file).read().strip()
+
+    # make sure at least one log file exists
+    logdir = os.path.join(global_worker.session_dir, "logs")
+    os.makedirs(logdir, exist_ok=True)
+    with open(os.path.join(logdir, "probe.log"), "w") as f:
+        f.write("hello from the log tail endpoint\n")
+
+    files = json.load(urllib.request.urlopen(base + "/api/logs", timeout=20))
+    assert any(e["name"] == "probe.log" for e in files)
+    text = urllib.request.urlopen(base + "/api/logs/probe.log?tail=100", timeout=20).read().decode()
+    assert "hello from the log tail" in text
+    # traversal is rejected
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(base + "/api/logs/..%2Fgcs_address", timeout=20)

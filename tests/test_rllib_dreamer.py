@@ -1,0 +1,140 @@
+"""DreamerV3 — model-based RL (reference: rllib/algorithms/dreamerv3/).
+
+Component tests (RSSM shapes, symlog codec, sequence replay lanes,
+world-model loss descent) plus a bounded learning smoke on CartPole.
+"""
+import numpy as np
+import pytest
+
+
+def _small_config(**over):
+    from ray_tpu.rllib import DreamerV3Config
+
+    config = DreamerV3Config().environment("CartPole-v1").debugging(seed=0)
+    config.deter_dim = 64
+    config.stoch_groups = 8
+    config.stoch_classes = 8
+    config.hidden = 64
+    config.batch_size_seqs = 8
+    config.seq_len = 16
+    config.imag_horizon = 10
+    config.num_steps_sampled_before_learning_starts = 300
+    config.rollout_fragment_length = 32
+    config.num_envs_per_env_runner = 4
+    for k, v in over.items():
+        setattr(config, k, v)
+    return config
+
+
+def test_symlog_roundtrip():
+    from ray_tpu.rllib.algorithms.dreamerv3.dreamerv3 import symexp, symlog
+
+    x = np.asarray([-100.0, -1.0, 0.0, 0.5, 10.0, 1e4], np.float32)
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))), x, rtol=1e-4)
+
+
+def test_rssm_shapes_and_reset():
+    """obs_step/img_step produce the right shapes; first-flag zeroing
+    resets the latent state deterministically."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.dreamerv3.dreamerv3 import WorldModel, _mlp, symlog
+
+    config = _small_config()
+    wm = WorldModel(obs_dim=4, n_actions=2, cfg=config)
+    params = wm.init_params(jax.random.PRNGKey(0))
+    B = 3
+    h = jnp.zeros((B, config.deter_dim))
+    z = jnp.zeros((B, wm.stoch_dim))
+    a = jnp.zeros((B, 2))
+    obs = jnp.ones((B, 4))
+    emb = _mlp(params["enc"], symlog(obs))
+    h2, z2, post_lg, prior_lg = wm.obs_step(params, h, z, a, emb, jax.random.PRNGKey(1))
+    assert h2.shape == (B, config.deter_dim) and z2.shape == (B, wm.stoch_dim)
+    assert post_lg.shape == (B, config.stoch_groups, config.stoch_classes)
+    # one-hot structure per group (straight-through sample sums to 1)
+    zg = np.asarray(z2).reshape(B, config.stoch_groups, config.stoch_classes)
+    np.testing.assert_allclose(zg.sum(-1), 1.0, atol=1e-5)
+    h3, z3 = wm.img_step(params, h2, z2, a, jax.random.PRNGKey(2))
+    assert h3.shape == h2.shape and z3.shape == z2.shape
+
+
+def test_sequence_replay_lane_stride():
+    """Sampled subsequences stay on one env lane of the interleaved
+    ring (consecutive rows of a sequence are num_envs apart)."""
+    from ray_tpu.rllib import DreamerV3Config
+
+    config = _small_config()
+    algo = config.algo_class(config)
+    try:
+        n = config.num_envs_per_env_runner
+        # fill with identifiable rows: obs[0] encodes (step, lane)
+        for step in range(64):
+            algo._replay_add({
+                "obs": np.stack([[step, lane, 0, 0] for lane in range(n)]).astype(np.float32),
+                "action": np.zeros(n, np.int64),
+                "reward": np.zeros(n, np.float32),
+                "cont": np.ones(n, np.float32),
+                "first": np.zeros(n, np.float32),
+            })
+        seq = algo._sample_seqs(16, 8)
+        obs = seq["obs"]  # [16, 8, 4]
+        lanes = obs[:, :, 1]
+        steps = obs[:, :, 0]
+        assert (lanes == lanes[:, :1]).all(), "sequence crossed env lanes"
+        assert (np.diff(steps, axis=1) == 1).all(), "sequence not contiguous in time"
+    finally:
+        algo.stop()
+
+
+def test_world_model_loss_decreases():
+    """A few wm updates on a fixed replay fill drive the loss down —
+    the RSSM + heads + KL-balanced objective is trainable."""
+    config = _small_config()
+    algo = config.algo_class(config)
+    try:
+        algo._collect(128)  # 512 transitions
+        first = last = None
+        import jax
+
+        for i in range(12):
+            seq = algo._sample_seqs(config.batch_size_seqs, config.seq_len)
+            algo._rng, k = jax.random.split(algo._rng)
+            algo.wm_params, algo._wm_opt_state, stats, _, _ = algo._wm_update(
+                algo.wm_params, algo._wm_opt_state, seq, k
+            )
+            loss = float(stats["wm_loss"])
+            first = first if first is not None else loss
+            last = loss
+        assert last < first, (first, last)
+    finally:
+        algo.stop()
+
+
+def test_dreamer_learning_smoke():
+    """Bounded end-to-end smoke: the full collect->wm->imagination->AC
+    loop runs, episode returns appear, and the policy ends above the
+    random baseline (~22 on CartPole)."""
+    config = _small_config(train_ratio=48)
+    algo = config.build()
+    best = 0.0
+    for i in range(60):
+        result = algo.train()
+        r = result["episode_return_mean"]
+        if r == r:
+            best = max(best, r)
+        if best > 60:
+            break
+    algo.stop()
+    assert best > 35, f"DreamerV3 never beat random play (best {best})"
+    # checkpoint roundtrip preserves behavior machinery
+    import tempfile
+
+    from ray_tpu.rllib import DreamerV3
+
+    path = algo.save_to_path(tempfile.mkdtemp())
+    algo2 = DreamerV3.from_checkpoint(path)
+    a = algo2.compute_single_action(np.zeros(4, np.float32))
+    assert a in (0, 1)
+    algo2.stop()

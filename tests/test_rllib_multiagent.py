@@ -92,3 +92,47 @@ def test_ppo_with_connectors_learns(ray_start_regular):
         result = algo.train()
         best = max(best, result["episode_return_mean"])
     assert best > 100.0, f"PPO+connectors failed to learn: best={best}"
+
+
+def test_maddpg_learns_cooperative_continuous():
+    """MADDPG on the continuous cooperative fixture: centralized
+    critics over joint obs/actions drive both decentralized actors to
+    their targets — shared return approaches the optimum (~1.6/episode;
+    random play hovers near 0). Reference: rllib/algorithms/maddpg."""
+    from ray_tpu.rllib import MADDPGConfig
+    from ray_tpu.rllib.env.multi_agent_env import TwoAgentContinuousTarget
+
+    config = MADDPGConfig().environment(TwoAgentContinuousTarget).debugging(seed=0)
+    config.num_steps_sampled_before_learning_starts = 500
+    config.updates_per_iter = 24
+    config.rollout_steps_per_iter = 125  # 5 episodes per iteration
+    algo = config.build()
+    best = -1e9
+    for i in range(60):
+        r = algo.train()
+        m = r["episode_return_mean"]
+        if m == m:
+            best = max(best, m)
+        if best > 1.3:
+            break
+    algo.stop()
+    assert best > 1.1, f"MADDPG failed to coordinate (best {best})"
+
+
+def test_maddpg_centralized_critic_shapes():
+    """The critics consume JOINT obs+action; actors stay decentralized
+    (only their own obs)."""
+    import numpy as np
+
+    from ray_tpu.rllib import MADDPGConfig
+    from ray_tpu.rllib.env.multi_agent_env import TwoAgentContinuousTarget
+
+    config = MADDPGConfig().environment(TwoAgentContinuousTarget).debugging(seed=1)
+    algo = config.algo_class(config)
+    # joint input dim: sum obs (2+2) + sum act (1+1) = 6
+    assert algo.critics["a0"][0]["w"].shape[0] == 6
+    assert algo.actors["a0"][0]["w"].shape[0] == 2
+    acts = algo.compute_actions({"a0": np.zeros(2, np.float32), "a1": np.ones(2, np.float32)})
+    assert set(acts) == {"a0", "a1"} and acts["a0"].shape == (1,)
+    assert np.all(np.abs(acts["a0"]) <= 1.0)
+    algo.stop()

@@ -144,3 +144,33 @@ def test_zero_copy_views_pin_under_pressure(ray_start_regular):
     # and once the value dies the slot becomes reclaimable again (the
     # sweep releases it — no permanent leak)
     del arr
+
+
+def test_lru_list_exact_order_and_repin(store):
+    """The O(1) eviction list: list_evictable returns coldest-first in
+    release order; a get() re-pin removes the entry from the evictable
+    set and a release puts it back at the HOT end."""
+    ids = [os.urandom(16) for _ in range(4)]
+    for oid in ids:
+        store.put_bytes(oid, b"x" * (12 * 1024 * 1024))  # 48 of ~59 MiB
+    cold = [oid for oid, _ in store.list_evictable(16)]
+    assert cold[:4] == ids, "expected insertion order, coldest first"
+
+    # re-pin the coldest: it must leave the evictable set...
+    buf = store.get(ids[0], timeout_ms=0)
+    assert ids[0] not in [oid for oid, _ in store.list_evictable(16)]
+    # ...and return at the hot end on release
+    buf.release()
+    cold = [oid for oid, _ in store.list_evictable(16)]
+    assert cold[-1] == ids[0] and cold[0] == ids[1]
+
+    # delete unlinks from the evictable list
+    store.delete(ids[2])
+    assert ids[2] not in [oid for oid, _ in store.list_evictable(16)]
+
+    # pressure eviction pops the cold end first: an 18 MiB put needs one
+    # eviction beyond the deleted hole — the coldest (ids[1]) dies while
+    # ids[3] and the re-released-last ids[0] survive
+    store.put_bytes(os.urandom(16), b"y" * (18 * 1024 * 1024))
+    assert store.get(ids[1], timeout_ms=-1) is None, "coldest not evicted first"
+    assert store.contains(ids[3]) and store.contains(ids[0])

@@ -144,3 +144,60 @@ def test_trainer_jax_training_loop(ray_start_regular, tmp_path):
         run_config=RunConfig(storage_path=str(tmp_path)),
     ).fit()
     assert result.metrics["final_loss"] < 1.0
+
+
+def test_gbdt_trainer_classification(ray_start_regular):
+    """Native distributed GBDT (reference: train/gbdt_trainer.py +
+    xgboost_trainer.py — here a from-scratch histogram booster since
+    xgboost isn't in the image): binary classification on a nonlinear
+    target reaches high accuracy; per-round traffic is histograms, not
+    rows."""
+    import numpy as np
+
+    import ray_tpu.data as rd
+    from ray_tpu.train.gbdt_trainer import GBDTTrainer
+
+    rng = np.random.default_rng(0)
+    n = 4000
+    x0 = rng.uniform(-2, 2, n)
+    x1 = rng.uniform(-2, 2, n)
+    # XOR-style quadrant labels: linearly inseparable, tree-friendly
+    y = ((x0 * x1) > 0).astype(np.float64)
+    ds = rd.from_items(
+        [{"x0": float(a), "x1": float(b), "label": float(c)} for a, b, c in zip(x0, x1, y)],
+        parallelism=4,
+    )
+    trainer = GBDTTrainer(
+        datasets={"train": ds},
+        label_column="label",
+        params={"objective": "binary:logistic", "max_depth": 3, "eta": 0.4},
+        num_boost_round=12,
+    )
+    result = trainer.fit()
+    probe = np.stack([x0[:500], x1[:500]], 1)
+    preds = result.model.predict(probe)
+    acc = float(((preds > 0.5) == (y[:500] > 0.5)).mean())
+    assert acc > 0.93, acc
+
+
+def test_gbdt_trainer_regression(ray_start_regular):
+    import numpy as np
+
+    import ray_tpu.data as rd
+    from ray_tpu.train.gbdt_trainer import GBDTTrainer
+
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-3, 3, 3000)
+    y = np.sin(x) * 2 + 0.05 * rng.normal(size=x.shape)
+    ds = rd.from_items([{"x": float(a), "y": float(b)} for a, b in zip(x, y)], parallelism=3)
+    trainer = GBDTTrainer(
+        datasets={"train": ds}, label_column="y",
+        params={"max_depth": 3, "eta": 0.3}, num_boost_round=25,
+    )
+    model = trainer.fit().model
+    grid = np.linspace(-3, 3, 200)[:, None]
+    mse = float(np.mean((model.predict(grid) - 2 * np.sin(grid[:, 0])) ** 2))
+    assert mse < 0.1, mse
+    # dict-batch prediction path
+    p = model.predict({"x": np.asarray([0.5, -0.5])})
+    assert abs(p[0] - 2 * np.sin(0.5)) < 0.5

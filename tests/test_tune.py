@@ -359,3 +359,51 @@ def test_bohb_pairing(ray_start_regular, tmp_path):
     grid = tuner.fit()
     best = min(t.metrics["loss"] for t in grid if "loss" in t.metrics)
     assert best < 0.3, f"BOHB run found nothing good: {best}"
+
+
+def test_resource_changing_scheduler(ray_start_regular, tmp_path):
+    """ResourceChangingScheduler: a trial whose allocation function grows
+    its CPUs restarts from its own checkpoint with the new allotment and
+    still finishes; progress is preserved across the restart (reference:
+    tune/schedulers/resource_changing_scheduler.py)."""
+    import os
+    import tempfile
+
+    from ray_tpu.air import Checkpoint
+    from ray_tpu.air.session import get_checkpoint
+    from ray_tpu.tune.schedulers import ResourceChangingScheduler
+
+    def train_fn(config):
+        start = 0
+        ckpt = get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "iter.txt")) as f:
+                start = int(f.read())
+        for i in range(start + 1, 9):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "iter.txt"), "w") as f:
+                f.write(str(i))
+            tune.report({"score": float(i), "iter": i}, checkpoint=Checkpoint(d))
+
+    allocs = []
+
+    def alloc_fn(trial_id, result, current):
+        # grow to 2 CPUs once the trial proves itself at iter 3
+        if result.get("iter", 0) == 3 and current.get("num_cpus", 1) < 2:
+            allocs.append(trial_id)
+            return dict(current, num_cpus=2)
+        return current
+
+    sched = ResourceChangingScheduler(resources_allocation_function=alloc_fn)
+    tuner = Tuner(
+        train_fn,
+        param_space={"x": tune.grid_search([1])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=sched,
+                               max_concurrent_trials=1),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    # the trial restarted (realloc fired) and still reached the end
+    assert allocs, "allocation function never grew the trial"
+    assert best.metrics["score"] == 8.0, best.metrics
+    assert sched.current_resources(allocs[0])["num_cpus"] == 2
