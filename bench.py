@@ -111,17 +111,21 @@ def bench_runtime(extra):
     log(f"[bench] put bandwidth: {gib:.2f} GiB/s (baseline {BASELINES['put_gib_per_s']}; "
         f"single-threaded DRAM memcpy on this box ~2.5 GiB/s)")
 
-    # multi-client puts: 2 worker processes putting 8 MiB objects
-    # concurrently with the driver (reference: multi_client_put_* axes,
-    # ray_perf.py — its rig has a core per client; here all clients share
-    # the one core, so this measures framework overhead under contention,
-    # not added bandwidth)
+    # multi-client puts: 2 worker processes putting 16 MiB objects
+    # concurrently (reference: multi_client_put_* axes, ray_perf.py —
+    # its rig has a core per client; here all clients share the one
+    # core, so this measures framework overhead under contention, not
+    # added bandwidth)
     @ray_tpu.remote
     class Putter:
         def __init__(self):
             import numpy as _np
 
-            self.arr = _np.ones(8 * 1024 * 1024 // 8, _np.float64)
+            # SAME 16 MiB objects as the single-client section: an
+            # apples-to-apples aggregate-vs-solo comparison (smaller
+            # objects amortize per-put overhead worse and measured as a
+            # phantom multi-client penalty)
+            self.arr = _np.ones(16 * 1024 * 1024 // 8, _np.float64)
 
         def put_n(self, n):
             import ray_tpu as _rt
@@ -132,13 +136,13 @@ def bench_runtime(extra):
 
     putters = [Putter.remote() for _ in range(2)]
     ray_tpu.get([p.put_n.remote(1) for p in putters])
-    n_each = 12
+    n_each = 8
     mc_gib = 0.0
     for _ in range(3):  # best-of-3, like the single-client section
         t0 = time.perf_counter()
         ray_tpu.get([p.put_n.remote(n_each) for p in putters])
         mc_gib = max(
-            mc_gib, 2 * n_each * 8 * 1024 * 1024 / (1 << 30) / (time.perf_counter() - t0)
+            mc_gib, 2 * n_each * 16 * 1024 * 1024 / (1 << 30) / (time.perf_counter() - t0)
         )
     extra["multi_client_put_gib_per_s"] = round(mc_gib, 2)
     log(f"[bench] multi-client put bandwidth (2 clients): {mc_gib:.2f} GiB/s")
@@ -254,7 +258,7 @@ def bench_runtime(extra):
         ray_tpu.get([c.drive.remote(per) for c in callers])
         return 4 * per / (time.perf_counter() - t0)
 
-    r = best_of(3, _nn_run, settle=2.0)
+    r = best_of(5, _nn_run, settle=2.0)
     extra["actor_calls_async_nn"] = round(r, 1)
     log(f"[bench] n:n async actor calls: {r:.0f}/s (baseline {BASELINES['actor_calls_async_nn']:.0f})")
 
@@ -280,7 +284,7 @@ def bench_runtime(extra):
         ray_tpu.get([noop.remote() for _ in range(1500)])
         return 1500 / (time.perf_counter() - t0)
 
-    r = best_of(3, _task_run, settle=2.0)
+    r = best_of(5, _task_run, settle=2.0)
     extra["tasks_async"] = round(r, 1)
     log(f"[bench] async tasks: {r:.0f}/s (baseline {BASELINES['tasks_async']:.0f})")
 

@@ -29,6 +29,7 @@ from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup  # noqa: F401
 from ray_tpu.rllib.core.rl_module import RLModule, DiscreteMLPModule  # noqa: F401

@@ -1,0 +1,235 @@
+"""QMIX — cooperative multi-agent Q-learning with monotonic mixing.
+
+Equivalent of the reference's QMIX
+(reference: rllib/algorithms/qmix/qmix.py — Rashid et al.: per-agent
+utility networks Q_i(o_i, a_i) combined by a mixing network whose
+weights are produced by hypernetworks on the global state and forced
+positive, so argmax_a Q_tot decomposes into per-agent argmaxes while
+credit assignment flows through the state-conditioned mixer).
+
+Jax-native like MADDPG: per-agent nets and the hypernet mixer are
+explicit pytrees, the whole TD update (agent forwards, mixer, target
+mixer, grads, adam) is one jitted function. The global state is the
+concatenation of all agents' observations (the standard choice when
+the env exposes no separate state)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dreamerv3.dreamerv3 import _dense, _dense_init, _mlp, _mlp_init
+
+
+class QMIXConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.gamma = 0.99
+        self.hidden = (64, 64)
+        self.mixer_embed = 32
+        self.train_batch_size = 128
+        self.replay_capacity = 50_000
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 8_000
+        self.target_network_update_freq = 200
+        self.num_steps_sampled_before_learning_starts = 500
+        self.updates_per_iter = 16
+        self.rollout_steps_per_iter = 200
+
+
+class QMIX(Algorithm):
+    config_class = QMIXConfig
+
+    def __init__(self, config: QMIXConfig):
+        import optax
+
+        self.config = config
+        self.env_runner_group = None
+        self.learner_group = None
+        self._iteration = 0
+        self._weights_seq = 0
+        self._env_steps_lifetime = 0
+        self._recent_returns: List[float] = []
+        env_cls = config.env
+        self._env = env_cls(**(config.env_config or {})) if isinstance(env_cls, type) else env_cls
+        self.agents = list(self._env.possible_agents)
+        self.obs_dims = {
+            a: int(np.prod(self._env.observation_space(a).shape)) for a in self.agents
+        }
+        self.n_actions = {a: int(self._env.action_space(a).n) for a in self.agents}
+        self.state_dim = sum(self.obs_dims.values())
+        cfg = config
+
+        rng = jax.random.PRNGKey(cfg.seed)
+        keys = jax.random.split(rng, len(self.agents) + 4)
+        self.q_nets = {
+            a: _mlp_init(keys[i], (self.obs_dims[a],) + tuple(cfg.hidden), self.n_actions[a])
+            for i, a in enumerate(self.agents)
+        }
+        n, E = len(self.agents), cfg.mixer_embed
+        k1, k2, k3, k4 = keys[len(self.agents):len(self.agents) + 4]
+        self.mixer = {
+            # hypernets: state -> mixing weights/biases (weights go
+            # through abs() at use time for monotonicity)
+            "hw1": _dense_init(k1, self.state_dim, n * E),
+            "hb1": _dense_init(k2, self.state_dim, E),
+            "hw2": _dense_init(k3, self.state_dim, E),
+            "hb2": _mlp_init(k4, (self.state_dim, E), 1),
+        }
+        self.t_q_nets = jax.tree.map(jnp.asarray, self.q_nets)
+        self.t_mixer = jax.tree.map(jnp.asarray, self.mixer)
+        self._opt = optax.adam(cfg.lr)
+        self._opt_state = self._opt.init((self.q_nets, self.mixer))
+        self._updates = 0
+
+        from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+        self._replay = ReplayBuffer(cfg.replay_capacity, seed=cfg.seed)
+        self._np_rng = np.random.default_rng(cfg.seed)
+        self._build_update()
+        self._obs_now, _ = self._env.reset(seed=cfg.seed)
+        self._ep_ret = 0.0
+
+    # ---------------- mixer -----------------------------------------------
+    def _mix(self, mixer, per_agent_q, state):
+        """Monotonic mix: Q_tot = w2(s)^T elu(|W1(s)| q + b1(s)) + b2(s)."""
+        B = state.shape[0]
+        n, E = len(self.agents), self.config.mixer_embed
+        w1 = jnp.abs(_dense(mixer["hw1"], state)).reshape(B, n, E)
+        b1 = _dense(mixer["hb1"], state)
+        w2 = jnp.abs(_dense(mixer["hw2"], state))
+        b2 = _mlp(mixer["hb2"], state)[..., 0]
+        hidden = jax.nn.elu(jnp.einsum("bn,bne->be", per_agent_q, w1) + b1)
+        return jnp.sum(hidden * w2, -1) + b2
+
+    # ---------------- jitted update ----------------------------------------
+    def _build_update(self):
+        import optax
+
+        cfg = self.config
+        agents = self.agents
+
+        def td_loss(params, targets, batch):
+            q_nets, mixer = params
+            t_q_nets, t_mixer = targets
+            state = jnp.concatenate([batch[f"obs_{a}"] for a in agents], -1)
+            next_state = jnp.concatenate([batch[f"nobs_{a}"] for a in agents], -1)
+            chosen = jnp.stack([
+                jnp.take_along_axis(
+                    _mlp(q_nets[a], batch[f"obs_{a}"]),
+                    batch[f"act_{a}"].astype(jnp.int32)[:, None], 1,
+                )[:, 0]
+                for a in agents
+            ], -1)
+            # double-Q style target: online nets pick, target nets evaluate
+            t_best = jnp.stack([
+                jnp.take_along_axis(
+                    _mlp(t_q_nets[a], batch[f"nobs_{a}"]),
+                    jnp.argmax(_mlp(q_nets[a], batch[f"nobs_{a}"]), -1)[:, None], 1,
+                )[:, 0]
+                for a in agents
+            ], -1)
+            q_tot = self._mix(mixer, chosen, state)
+            t_tot = self._mix(t_mixer, t_best, next_state)
+            y = batch["reward"] + cfg.gamma * (1.0 - batch["done"]) * t_tot
+            td = q_tot - jax.lax.stop_gradient(y)
+            return jnp.mean(td**2), {"loss": jnp.mean(td**2), "q_tot_mean": jnp.mean(q_tot)}
+
+        def update(q_nets, mixer, t_q_nets, t_mixer, opt_state, batch):
+            (_, stats), grads = jax.value_and_grad(td_loss, has_aux=True)(
+                (q_nets, mixer), (t_q_nets, t_mixer), batch
+            )
+            upd, opt_state = self._opt.update(grads, opt_state, (q_nets, mixer))
+            q_nets, mixer = optax.apply_updates((q_nets, mixer), upd)
+            return q_nets, mixer, opt_state, stats
+
+        self._update = jax.jit(update)
+
+        def act(q_nets, obs_dict):
+            return {a: jnp.argmax(_mlp(q_nets[a], obs_dict[a]), -1) for a in agents}
+
+        self._act_jit = jax.jit(act)
+
+    # ---------------- collection -------------------------------------------
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps_lifetime / max(1, cfg.epsilon_timesteps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    def _collect(self, steps: int) -> int:
+        eps = self._epsilon()
+        for _ in range(steps):
+            greedy = self._act_jit(
+                self.q_nets,
+                {a: jnp.asarray(self._obs_now[a], jnp.float32) for a in self.agents},
+            )
+            action_dict = {}
+            for a in self.agents:
+                if self._np_rng.random() < eps:
+                    action_dict[a] = int(self._np_rng.integers(0, self.n_actions[a]))
+                else:
+                    action_dict[a] = int(np.asarray(greedy[a]))
+            nobs, rewards, terms, truncs, _ = self._env.step(action_dict)
+            done = bool(terms.get("__all__")) or bool(truncs.get("__all__"))
+            row = {
+                "reward": np.float32(np.mean([rewards[a] for a in self.agents])),
+                "done": np.float32(bool(terms.get("__all__", False))),
+            }
+            for a in self.agents:
+                row[f"obs_{a}"] = np.asarray(self._obs_now[a], np.float32)
+                row[f"act_{a}"] = np.float32(action_dict[a])
+                row[f"nobs_{a}"] = np.asarray(nobs[a], np.float32)
+            self._replay.add({k: np.asarray(v)[None] for k, v in row.items()})
+            self._ep_ret += row["reward"]
+            self._env_steps_lifetime += 1
+            if done:
+                self._recent_returns.append(self._ep_ret)
+                self._recent_returns = self._recent_returns[-100:]
+                self._ep_ret = 0.0
+                self._obs_now, _ = self._env.reset()
+            else:
+                self._obs_now = nobs
+        return steps
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        sampled = self._collect(cfg.rollout_steps_per_iter)
+        stats: Dict[str, float] = {}
+        if len(self._replay) >= cfg.num_steps_sampled_before_learning_starts:
+            for _ in range(cfg.updates_per_iter):
+                batch = self._replay.sample(cfg.train_batch_size)
+                self.q_nets, self.mixer, self._opt_state, st = self._update(
+                    self.q_nets, self.mixer, self.t_q_nets, self.t_mixer,
+                    self._opt_state, batch,
+                )
+                self._updates += 1
+                if self._updates % cfg.target_network_update_freq == 0:
+                    self.t_q_nets = self.q_nets
+                    self.t_mixer = self.mixer
+            stats = {k: float(v) for k, v in st.items()}
+        ret = float(np.mean(self._recent_returns[-20:])) if self._recent_returns else float("nan")
+        return {
+            "episode_return_mean": ret,
+            "num_env_steps": sampled,
+            "epsilon": self._epsilon(),
+            "replay_size": len(self._replay),
+            "learner": stats,
+        }
+
+    def compute_actions(self, obs_dict) -> Dict[str, int]:
+        greedy = self._act_jit(
+            self.q_nets, {a: jnp.asarray(obs_dict[a], jnp.float32) for a in self.agents}
+        )
+        return {a: int(np.asarray(v)) for a, v in greedy.items()}
+
+    def stop(self) -> None:
+        pass
+
+
+QMIXConfig.algo_class = QMIX

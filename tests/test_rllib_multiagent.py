@@ -136,3 +136,49 @@ def test_maddpg_centralized_critic_shapes():
     assert set(acts) == {"a0", "a1"} and acts["a0"].shape == (1,)
     assert np.all(np.abs(acts["a0"]) <= 1.0)
     algo.stop()
+
+
+def test_qmix_learns_shared_reward():
+    """QMIX on the discrete shared-reward fixture: the monotonic mixer
+    lets per-agent argmax decompose Q_tot, and both agents walk to
+    their targets (optimal shared return ~8/episode, random ~0).
+    Reference: rllib/algorithms/qmix."""
+    from ray_tpu.rllib import QMIXConfig
+    from ray_tpu.rllib.env.multi_agent_env import TwoAgentTarget
+
+    config = QMIXConfig().environment(TwoAgentTarget).debugging(seed=0)
+    config.epsilon_timesteps = 5000
+    algo = config.build()
+    best = -1e9
+    for i in range(60):
+        r = algo.train()
+        m = r["episode_return_mean"]
+        if m == m:
+            best = max(best, m)
+        if best > 6.0:
+            break
+    algo.stop()
+    assert best > 4.0, f"QMIX failed to coordinate (best {best})"
+
+
+def test_qmix_mixer_monotonicity():
+    """The mixing network is monotonic in every agent utility: raising
+    any per-agent Q never lowers Q_tot (the property that makes
+    decentralized argmax sound)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.rllib import QMIXConfig
+    from ray_tpu.rllib.env.multi_agent_env import TwoAgentTarget
+
+    config = QMIXConfig().environment(TwoAgentTarget).debugging(seed=3)
+    algo = config.algo_class(config)
+    rng = np.random.default_rng(0)
+    state = jnp.asarray(rng.normal(size=(32, algo.state_dim)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(32, len(algo.agents))), jnp.float32)
+    base = np.asarray(algo._mix(algo.mixer, q, state))
+    for i in range(len(algo.agents)):
+        bumped = q.at[:, i].add(1.0)
+        up = np.asarray(algo._mix(algo.mixer, bumped, state))
+        assert (up >= base - 1e-5).all(), f"mixer not monotonic in agent {i}"
+    algo.stop()
