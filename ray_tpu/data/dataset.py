@@ -79,6 +79,20 @@ def _slice_rows(blk, start, stop):
 
 
 @ray_tpu.remote
+def _unique_block(blk, column: str):
+    col = blk.column(column).combine_chunks()
+    return list(dict.fromkeys(col.to_pylist()))
+
+
+@ray_tpu.remote
+def _sample_block(blk, fraction: float, seed: int):
+    import numpy as np
+
+    keep = np.random.default_rng(seed).random(blk.num_rows) < fraction
+    return blk.take(np.nonzero(keep)[0])
+
+
+@ray_tpu.remote
 def _write_tfrecords_block(blk, path: str):
     from ray_tpu.data import block as B
     from ray_tpu.data.tfrecords import encode_example, write_records
@@ -292,6 +306,27 @@ class Dataset:
 
     def union(self, other: "Dataset") -> "Dataset":
         return Dataset(self._execute_refs() + other._execute_refs())
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column (reference: Dataset.unique) —
+        per-block distinct in tasks, merged on the driver."""
+        parts = ray_tpu.get([
+            _unique_block.remote(ref, column) for ref in self._execute_refs()
+        ])
+        seen: Dict[Any, None] = {}
+        for p in parts:
+            for v in p:
+                seen.setdefault(v, None)
+        return list(seen)
+
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: Dataset.random_sample)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+        return Dataset([
+            LazyBlock(lambda r=ref, i=i: _sample_block.remote(r, fraction, (seed or 0) + i))
+            for i, ref in enumerate(self._execute_refs())
+        ])
 
     def split(self, n: int) -> List["Dataset"]:
         refs = self._execute_refs()

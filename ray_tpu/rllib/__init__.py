@@ -30,6 +30,7 @@ from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config  # noqa: F401
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.core.learner import Learner, LearnerGroup  # noqa: F401
 from ray_tpu.rllib.core.rl_module import RLModule, DiscreteMLPModule  # noqa: F401

@@ -1,0 +1,310 @@
+"""R2D2 — recurrent replay distributed DQN.
+
+Equivalent of the reference's R2D2
+(reference: rllib/algorithms/r2d2/r2d2.py — Kapturowski et al.: an
+LSTM Q-network trained on replayed SEQUENCES, with a burn-in prefix
+that rebuilds the recurrent state before the TD portion so stale
+stored states don't poison the gradients; double-Q targets computed
+along the same unrolled sequence).
+
+Jax-native: the LSTM cell is an explicit pytree and the whole update
+— burn-in unroll (stop-gradient), train unroll, target-net unroll,
+double-Q TD, adam — is one jitted `lax.scan` program. Sequences come
+from a lane-strided flat ring (the DreamerV3 replay layout); episode
+starts inside a window reset the carried state via the stored `first`
+flags, and windows never straddle the ring's write head.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dreamerv3.dreamerv3 import _dense, _dense_init, _mlp, _mlp_init
+from ray_tpu.rllib.utils.env import env_spaces
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.gamma = 0.997
+        self.hidden = 64          # pre-LSTM dense width
+        self.lstm_size = 64
+        self.burn_in = 8
+        self.train_len = 16       # TD steps after burn-in
+        self.train_batch_size_seqs = 32
+        self.replay_capacity = 100_000
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 10_000
+        self.target_network_update_freq = 400
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.updates_per_iter = 8
+        self.rollout_fragment_length = 64
+        self.num_envs_per_env_runner = 4
+
+
+class LSTMQNet:
+    """Dense -> LSTM -> Q head as explicit pytrees."""
+
+    def __init__(self, obs_dim: int, n_actions: int, cfg: R2D2Config):
+        self.obs_dim = obs_dim
+        self.n_actions = n_actions
+        self.hidden = cfg.hidden
+        self.lstm = cfg.lstm_size
+
+    def init_params(self, rng):
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        H, L = self.hidden, self.lstm
+        return {
+            "enc": _mlp_init(k1, (self.obs_dim,), H),
+            "lstm_x": _dense_init(k2, H, 4 * L),
+            "lstm_h": _dense_init(k3, L, 4 * L),
+            "head": _mlp_init(k4, (L, H), self.n_actions),
+        }
+
+    def cell(self, p, carry, x):
+        """One LSTM step: carry = (h, c), x = encoded obs."""
+        h, c = carry
+        gates = _dense(p["lstm_x"], x) + _dense(p["lstm_h"], h)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f + 1.0), jax.nn.sigmoid(o)
+        c = f * c + i * jnp.tanh(g)
+        h = o * jnp.tanh(c)
+        return (h, c)
+
+    def step(self, p, carry, obs, first):
+        """Encode one obs and advance the state; `first` resets carry."""
+        h, c = carry
+        mask = (1.0 - first)[:, None]
+        carry = (h * mask, c * mask)
+        x = jax.nn.silu(_mlp(p["enc"], obs))
+        carry = self.cell(p, carry, x)
+        q = _mlp(p["head"], carry[0])
+        return carry, q
+
+    def unroll(self, p, carry, obs_seq, first_seq):
+        """obs_seq [B,L,D], first_seq [B,L] -> q [B,L,A], final carry."""
+        def f(carry, t):
+            carry, q = self.step(p, carry, obs_seq[:, t], first_seq[:, t])
+            return carry, q
+
+        carry, qs = jax.lax.scan(f, carry, jnp.arange(obs_seq.shape[1]))
+        return qs.swapaxes(0, 1), carry
+
+    def zero_state(self, batch: int):
+        return (jnp.zeros((batch, self.lstm)), jnp.zeros((batch, self.lstm)))
+
+
+class R2D2(Algorithm):
+    config_class = R2D2Config
+
+    def __init__(self, config: R2D2Config):
+        import optax
+
+        self.config = config
+        self.env_runner_group = None
+        self.learner_group = None
+        self._iteration = 0
+        self._weights_seq = 0
+        self._env_steps_lifetime = 0
+        self._recent_returns: List[float] = []
+        self._spaces = env_spaces(config)
+        obs_dim = int(np.prod(self._spaces[0].shape))
+        self.net = LSTMQNet(obs_dim, int(self._spaces[1].n), config)
+        cfg = config
+
+        rng = jax.random.PRNGKey(cfg.seed)
+        k_net, self._rng = jax.random.split(rng)
+        self.params = self.net.init_params(k_net)
+        self.target_params = jax.tree.map(jnp.asarray, self.params)
+        self._opt = optax.chain(optax.clip_by_global_norm(40.0), optax.adam(cfg.lr))
+        self._opt_state = self._opt.init(self.params)
+        self._updates = 0
+
+        # lane-strided flat ring (DreamerV3 layout)
+        self._replay: Dict[str, np.ndarray] = {}
+        self._replay_next = 0
+        self._replay_size = 0
+        self._np_rng = np.random.default_rng(cfg.seed)
+
+        self._build_fns()
+        self._build_env()
+
+    # ---------------- env interaction -------------------------------------
+    def _build_env(self):
+        import gymnasium as gym
+
+        cfg = self.config
+        self._env = gym.make_vec(cfg.env, num_envs=cfg.num_envs_per_env_runner,
+                                 **(cfg.env_config or {}))
+        obs, _ = self._env.reset(seed=cfg.seed)
+        n = cfg.num_envs_per_env_runner
+        self._obs = np.asarray(obs, np.float32).reshape(n, -1)
+        self._carry = self.net.zero_state(n)
+        self._first = np.ones(n, np.float32)
+        self._ep_ret = np.zeros(n, np.float64)
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps_lifetime / max(1, cfg.epsilon_timesteps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    def _collect(self, steps: int) -> int:
+        cfg = self.config
+        n = cfg.num_envs_per_env_runner
+        eps = self._epsilon()
+        for _ in range(steps):
+            self._carry, q = self._step_jit(
+                self.params, self._carry, jnp.asarray(self._obs), jnp.asarray(self._first)
+            )
+            greedy = np.asarray(jnp.argmax(q, -1))
+            explore = self._np_rng.random(n) < eps
+            action = np.where(
+                explore, self._np_rng.integers(0, self.net.n_actions, n), greedy
+            ).astype(np.int64)
+            next_obs, reward, term, trunc, _ = self._env.step(action)
+            done = np.asarray(term) | np.asarray(trunc)
+            self._ep_ret += np.asarray(reward)
+            self._replay_add({
+                "obs": self._obs,
+                "action": action,
+                "reward": np.asarray(reward, np.float32),
+                "term": np.asarray(term, np.float32),
+                "first": self._first.astype(np.float32),
+            })
+            for i in np.nonzero(done)[0]:
+                self._recent_returns.append(float(self._ep_ret[i]))
+                self._ep_ret[i] = 0.0
+            self._recent_returns = self._recent_returns[-100:]
+            self._obs = np.asarray(next_obs, np.float32).reshape(n, -1)
+            self._first = done.astype(np.float32)
+            self._env_steps_lifetime += n
+        return steps * n
+
+    # ---------------- sequence replay (lane-strided ring) -----------------
+    def _replay_add(self, rows: Dict[str, np.ndarray]) -> None:
+        cap = self.config.replay_capacity
+        nrows = len(rows["reward"])
+        if not self._replay:
+            for k, v in rows.items():
+                self._replay[k] = np.zeros((cap,) + v.shape[1:], v.dtype)
+        idx = (self._replay_next + np.arange(nrows)) % cap
+        for k, v in rows.items():
+            self._replay[k][idx] = v
+        self._replay_next = int((self._replay_next + nrows) % cap)
+        self._replay_size = int(min(self._replay_size + nrows, cap))
+
+    def _sample_seqs(self, batch: int, length: int) -> Dict[str, np.ndarray]:
+        n_env = self.config.num_envs_per_env_runner
+        cap = self.config.replay_capacity
+        span = length * n_env
+        hi = self._replay_size - span
+        starts = self._np_rng.integers(0, max(1, hi), size=batch)
+        starts = starts - (starts % n_env)
+        base = self._replay_next if self._replay_size == cap else 0
+        lane = self._np_rng.integers(0, n_env, size=batch)
+        idx = (base + starts[:, None] + lane[:, None] + n_env * np.arange(length)[None, :]) % cap
+        return {k: v[idx] for k, v in self._replay.items()}
+
+    # ---------------- jitted update ----------------------------------------
+    def _build_fns(self):
+        import optax
+
+        cfg = self.config
+        net = self.net
+        B_in = cfg.burn_in
+
+        self._step_jit = jax.jit(net.step)
+
+        def loss_fn(params, target_params, seq):
+            # sequence layout: [B, burn_in + train_len + 1] (the +1 step
+            # provides the bootstrap target for the last train step)
+            obs, first = seq["obs"], seq["first"]
+            B = obs.shape[0]
+            zero = net.zero_state(B)
+            # burn-in: rebuild recurrent state, no gradients
+            if B_in > 0:
+                _, carry = net.unroll(params, zero, obs[:, :B_in], first[:, :B_in])
+                carry = jax.lax.stop_gradient(carry)
+                _, t_carry = net.unroll(target_params, zero, obs[:, :B_in], first[:, :B_in])
+            else:
+                carry = t_carry = zero
+            q_seq, _ = net.unroll(params, carry, obs[:, B_in:], first[:, B_in:])
+            t_seq, _ = net.unroll(target_params, t_carry, obs[:, B_in:], first[:, B_in:])
+            # TD over steps [0, L-1] of the post-burn-in window; step t's
+            # bootstrap uses t+1 — invalid when t+1 starts a new episode
+            # or the transition terminated
+            a = seq["action"][:, B_in:-1]
+            r = seq["reward"][:, B_in:-1]
+            term = seq["term"][:, B_in:-1]
+            next_first = first[:, B_in + 1:]
+            q_sa = jnp.take_along_axis(q_seq[:, :-1], a[..., None], -1)[..., 0]
+            next_a = jnp.argmax(q_seq[:, 1:], -1)  # double-Q: online picks
+            q_next = jnp.take_along_axis(t_seq[:, 1:], next_a[..., None], -1)[..., 0]
+            # a next-step episode boundary invalidates the bootstrap
+            # UNLESS the transition terminated (then it contributes 0)
+            valid = 1.0 - (next_first * (1.0 - term))
+            target = r + cfg.gamma * (1.0 - term) * q_next
+            td = (q_sa - jax.lax.stop_gradient(target)) * valid
+            loss = jnp.mean(td**2)
+            return loss, {"loss": loss, "mean_q": jnp.mean(q_sa)}
+
+        def update(params, target_params, opt_state, seq):
+            (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, seq
+            )
+            upd, opt_state = self._opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, upd), opt_state, stats
+
+        self._update = jax.jit(update)
+
+    # ---------------- training loop ----------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        sampled = self._collect(cfg.rollout_fragment_length)
+        stats: Dict[str, float] = {}
+        if self._replay_size >= cfg.num_steps_sampled_before_learning_starts:
+            L = cfg.burn_in + cfg.train_len + 1
+            for _ in range(cfg.updates_per_iter):
+                seq = self._sample_seqs(cfg.train_batch_size_seqs, L)
+                self.params, self._opt_state, st = self._update(
+                    self.params, self.target_params, self._opt_state, seq
+                )
+                self._updates += 1
+                if self._updates % cfg.target_network_update_freq == 0:
+                    self.target_params = self.params
+            stats = {k: float(v) for k, v in st.items()}
+        ret = float(np.mean(self._recent_returns)) if self._recent_returns else float("nan")
+        return {
+            "episode_return_mean": ret,
+            "num_env_steps": sampled,
+            "epsilon": self._epsilon(),
+            "replay_size": self._replay_size,
+            "learner": stats,
+        }
+
+    def compute_single_action(self, obs, explore: bool = False):
+        if not hasattr(self, "_eval_carry") or self._eval_carry is None:
+            self._eval_carry = self.net.zero_state(1)
+            self._eval_first = np.ones(1, np.float32)
+        self._eval_carry, q = self._step_jit(
+            self.params, self._eval_carry,
+            jnp.asarray(obs, jnp.float32).reshape(1, -1), jnp.asarray(self._eval_first),
+        )
+        self._eval_first = np.zeros(1, np.float32)
+        return int(np.asarray(jnp.argmax(q, -1))[0])
+
+    def reset_eval_state(self) -> None:
+        self._eval_carry = None
+
+    def stop(self) -> None:
+        self._env.close()
+
+
+R2D2Config.algo_class = R2D2

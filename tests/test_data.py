@@ -324,3 +324,21 @@ def test_groupby_larger_than_arena_bounded(ray_start_regular):
     assert fetched["bytes"] < total_data / 100, (
         f"driver fetched {fetched['bytes']} bytes — groupby is materializing on the driver"
     )
+
+
+def test_unique_and_random_sample(ray_start_regular):
+    """Dataset.unique (task-side distinct, driver merge) and
+    random_sample (Bernoulli rows) — reference: Dataset.unique /
+    random_sample."""
+    import ray_tpu.data as rd
+
+    ds = rd.from_items([{"g": i % 5, "v": i} for i in range(100)], parallelism=4)
+    assert sorted(ds.unique("g")) == [0, 1, 2, 3, 4]
+
+    half = ds.random_sample(0.5, seed=7)
+    n = len(half.take_all())
+    assert 25 <= n <= 75, n  # loose Bernoulli bounds
+    none = ds.random_sample(0.0).take_all()
+    assert none == []
+    full = ds.random_sample(1.0).take_all()
+    assert len(full) == 100

@@ -1,0 +1,95 @@
+"""R2D2 — recurrent replay DQN (reference: rllib/algorithms/r2d2/).
+
+The learning test uses a velocity-masked CartPole: only (cart position,
+pole angle) are observable, so the value function needs MEMORY to
+estimate velocities — the setting recurrence exists for.
+"""
+import numpy as np
+import pytest
+
+
+def _register_masked_cartpole():
+    import gymnasium as gym
+    from gymnasium.spaces import Box
+
+    if "MaskedCartPole-v0" in gym.registry:
+        return
+
+    def make(**kwargs):
+        from gymnasium.wrappers import TransformObservation
+
+        env = gym.make("CartPole-v1", **kwargs)
+        space = Box(-np.inf, np.inf, (2,), np.float32)
+        return TransformObservation(env, lambda o: o[[0, 2]].astype(np.float32), space)
+
+    gym.register("MaskedCartPole-v0", entry_point=make)
+
+
+def test_lstm_unroll_shapes_and_first_reset():
+    """The LSTM unroll produces per-step Q values, and a `first` flag
+    mid-sequence resets the carried state (same output as a fresh
+    unroll from that point)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import R2D2Config
+    from ray_tpu.rllib.algorithms.r2d2.r2d2 import LSTMQNet
+
+    cfg = R2D2Config()
+    net = LSTMQNet(obs_dim=3, n_actions=2, cfg=cfg)
+    params = net.init_params(jax.random.PRNGKey(0))
+    B, L = 4, 6
+    obs = jnp.asarray(np.random.default_rng(0).normal(size=(B, L, 3)), jnp.float32)
+    first = jnp.zeros((B, L))
+    q, carry = net.unroll(params, net.zero_state(B), obs, first)
+    assert q.shape == (B, L, 2) and carry[0].shape == (B, cfg.lstm_size)
+
+    # first=1 at t=3 must make steps 3.. independent of steps 0..2
+    first_mid = first.at[:, 3].set(1.0)
+    q_mid, _ = net.unroll(params, net.zero_state(B), obs, first_mid)
+    q_fresh, _ = net.unroll(params, net.zero_state(B), obs[:, 3:], jnp.zeros((B, L - 3)))
+    np.testing.assert_allclose(np.asarray(q_mid[:, 3:]), np.asarray(q_fresh), rtol=1e-5)
+
+
+def test_r2d2_learns_velocity_masked_cartpole():
+    """With only positions observable, the recurrent Q-net must exceed
+    what a memoryless policy can reach (feedforward DQN plateaus near
+    ~80-110 here; random is ~22)."""
+    _register_masked_cartpole()
+    from ray_tpu.rllib import R2D2Config
+
+    config = R2D2Config().environment("MaskedCartPole-v0").debugging(seed=0)
+    config.epsilon_timesteps = 6000
+    config.updates_per_iter = 12
+    algo = config.build()
+    best = 0.0
+    for i in range(150):
+        r = algo.train()
+        m = r["episode_return_mean"]
+        if m == m:
+            best = max(best, m)
+        if best > 130:
+            break
+    algo.stop()
+    assert best > 110, f"R2D2 failed on memory task (best {best})"
+
+
+def test_r2d2_eval_keeps_state():
+    """compute_single_action carries the recurrent state across calls
+    and reset_eval_state clears it."""
+    _register_masked_cartpole()
+    from ray_tpu.rllib import R2D2Config
+
+    config = R2D2Config().environment("MaskedCartPole-v0").debugging(seed=1)
+    algo = config.algo_class(config)
+    obs = np.asarray([0.1, 0.2], np.float32)
+    a1 = algo.compute_single_action(obs)
+    carry_after_1 = np.asarray(algo._eval_carry[0]).copy()
+    algo.compute_single_action(obs)
+    carry_after_2 = np.asarray(algo._eval_carry[0])
+    assert not np.allclose(carry_after_1, carry_after_2), "state not carried"
+    algo.reset_eval_state()
+    a3 = algo.compute_single_action(obs)
+    np.testing.assert_allclose(np.asarray(algo._eval_carry[0]), carry_after_1, rtol=1e-5)
+    assert a1 == a3
+    algo.stop()
