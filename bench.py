@@ -564,10 +564,52 @@ def bench_tpu_train(extra):
         return None
 
 
+def bench_pixel_rl(extra):
+    """Pixel-RL throughput: conv-PPO on the native MinAtar-style
+    Breakout (BASELINE.json north star #2 — "RLlib PPO Atari"; ale_py is
+    not in this image, so the pixel task is the 10x10x4 MinAtar-style
+    env). Real deployment split: the env-runner ACTOR samples with the
+    conv forward on its CPU host (raylet pins workers to JAX cpu), the
+    driver-side learner runs conv fwd/bwd on the TPU chip. Reported as
+    env-steps consumed per second of full train() iterations."""
+    try:
+        import ray_tpu
+        from ray_tpu.rllib import PPOConfig
+        from ray_tpu.rllib.env.minatar_breakout import register
+
+        register()
+        config = (
+            PPOConfig()
+            .environment("MinAtarBreakout-v0")
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=16,
+                         rollout_fragment_length=128)
+            .training(lr=1e-3, train_batch_size=2048, minibatch_size=256, num_epochs=4)
+            .debugging(seed=0)
+        )
+        algo = config.build()
+        for _ in range(2):  # compile both sides
+            algo.train()
+        t0 = time.perf_counter()
+        steps = 0
+        iters = 0
+        while iters < 3 or time.perf_counter() - t0 < 5.0:
+            r = algo.train()
+            steps += r.get("num_env_steps_sampled", 2048) or 2048
+            iters += 1
+        dt = time.perf_counter() - t0
+        algo.stop()
+        extra["pixel_ppo_env_steps_per_s"] = round(steps / dt, 0)
+        log(f"[bench] pixel conv-PPO: {steps / dt:,.0f} env-steps/s "
+            f"(TPU learner + CPU runner actor)")
+    except Exception as e:
+        log(f"[bench] pixel RL bench skipped: {e}")
+
+
 def main():
     extra = {}
     bench_runtime(extra)
     bench_broadcast(extra)
+    bench_pixel_rl(extra)
     mfu = bench_tpu_train(extra)
     if mfu is not None:
         headline = {

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import builtins
 import itertools
+
+import numpy as np
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
 import ray_tpu
@@ -323,8 +325,11 @@ class Dataset:
         """Bernoulli row sample (reference: Dataset.random_sample)."""
         if not 0.0 <= fraction <= 1.0:
             raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+        # seed=None must give per-call randomness (and seed=0 must not
+        # alias it), so draw a fresh base only when seed is truly absent
+        base = int(np.random.default_rng().integers(2**31)) if seed is None else seed
         return Dataset([
-            LazyBlock(lambda r=ref, i=i: _sample_block.remote(r, fraction, (seed or 0) + i))
+            LazyBlock(lambda r=ref, i=i: _sample_block.remote(r, fraction, base + i))
             for i, ref in enumerate(self._execute_refs())
         ])
 

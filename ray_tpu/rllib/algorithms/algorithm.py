@@ -150,9 +150,23 @@ class AlgorithmConfig:
 
     # -- builders -------------------------------------------------------------
     def build_module(self, obs_space, action_space):
-        from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+        from ray_tpu.rllib.core.rl_module import DiscreteConvModule, DiscreteMLPModule
 
-        module_class = self.module_class or DiscreteMLPModule
+        module_class = self.module_class
+        if module_class is None:
+            # catalog behavior (reference: rllib catalog picks a
+            # CNNEncoderConfig for image observations,
+            # core/models/configs.py:637): 3-D obs → conv torso. Tiny
+            # 3-D spaces the filter stack would collapse to zero fall
+            # back to the flattening MLP (they worked that way before
+            # conv existed, and must keep working).
+            is_image = getattr(obs_space, "shape", None) is not None and len(obs_space.shape) == 3
+            if is_image:
+                try:
+                    return DiscreteConvModule(obs_space, action_space, self.model_config)
+                except ValueError:
+                    pass
+            module_class = DiscreteMLPModule
         return module_class(obs_space, action_space, self.model_config)
 
     def build_learner_mesh(self):
@@ -185,6 +199,7 @@ class EnvRunnerGroup:
 
             runner_cls = MultiAgentEnvRunner
         runner_cls = runner_cls or SingleAgentEnvRunner
+        self._runner_cls = runner_cls
         self.config = config
         self.local_runner = None
         self.remote_runners: List[Any] = []
@@ -217,10 +232,19 @@ class EnvRunnerGroup:
             return out, None
         if self.local_runner is not None:
             env = self.local_runner.env
-            return env.single_observation_space, env.single_action_space
-        from ray_tpu.rllib.utils.env import env_spaces
+            # the connector-transformed space when the runner computed one
+            obs_space = getattr(self.local_runner, "module_obs_space", None)
+            return obs_space or env.single_observation_space, env.single_action_space
+        from ray_tpu.rllib.env.single_agent_env_runner import SingleAgentEnvRunner
+        from ray_tpu.rllib.utils.env import env_spaces, module_obs_space_for
 
-        return env_spaces(self.config)
+        obs_space, action_space = env_spaces(self.config)
+        # only the SingleAgentEnvRunner family applies env_to_module
+        # connectors while sampling; transforming the learner's space for
+        # runner classes that ship raw observations would desync them
+        if issubclass(self._runner_cls, SingleAgentEnvRunner):
+            obs_space = module_obs_space_for(self.config, obs_space)
+        return obs_space, action_space
 
     def sample(self) -> List[Dict[str, Any]]:
         if self.local_runner is not None:

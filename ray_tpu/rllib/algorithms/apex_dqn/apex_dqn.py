@@ -110,15 +110,20 @@ class ApexEnvRunner(OffPolicyEnvRunner):
                 # windows flushed at truncation carry their true depth
                 "discounts": np.asarray([gamma ** r[5] for r in rows], np.float32),
             }
-            # initial priorities: |n-step TD error| under the CURRENT net
-            # (reference: apex actors compute priorities before shipping)
-            q_now = np.asarray(self._q_fn(self.params, batch["obs"]))
-            q_next = np.asarray(self._q_fn(self.params, batch["next_obs"]))
-            q_sa = q_now[np.arange(len(rows)), batch["actions"]]
-            target = batch["rewards"] + batch["discounts"] * (
-                1.0 - batch["terminateds"].astype(np.float32)
-            ) * q_next.max(axis=-1)
-            priorities = np.abs(target - q_sa)
+            if getattr(cfg, "prioritized_replay", True):
+                # initial priorities: |n-step TD error| under the CURRENT
+                # net (reference: apex actors score before shipping).
+                # Skipped for uniform replay (plain DQN n_step>1) — two
+                # full-batch Q forwards the consumer would discard.
+                q_now = np.asarray(self._q_fn(self.params, batch["obs"]))
+                q_next = np.asarray(self._q_fn(self.params, batch["next_obs"]))
+                q_sa = q_now[np.arange(len(rows)), batch["actions"]]
+                target = batch["rewards"] + batch["discounts"] * (
+                    1.0 - batch["terminateds"].astype(np.float32)
+                ) * q_next.max(axis=-1)
+                priorities = np.abs(target - q_sa)
+            else:
+                priorities = None
         else:
             batch, priorities = None, None
 
@@ -136,6 +141,10 @@ class APEXDQNConfig(DQNConfig):
         self.num_env_runners = 2
         self.num_replay_shards = 2
         self.n_step = 3
+        self.prioritized_replay = True  # the replay shards are always PER
+        # future escape hatch for remote learners with runner-side
+        # priority refresh; declared so config.training() accepts it
+        self.distributed_per = False
         self.prioritized_replay_alpha = 0.6
         self.prioritized_replay_beta = 0.4
         # the n-step return already spans n transitions: the learner's
@@ -155,6 +164,17 @@ class APEXDQN(DQN):
     def __init__(self, config):
         if config.num_env_runners < 1:
             raise ValueError("APEX requires remote env runners (num_env_runners >= 1)")
+        if getattr(config, "num_learners", 0) and not getattr(
+            config, "distributed_per", False
+        ):
+            # mirrors DQN.__init__'s prioritized-replay validation, which
+            # Algorithm.__init__ below bypasses: without a local learner,
+            # get_td_errors() yields nothing and shard priorities would
+            # silently never refresh past the producer-computed estimates
+            raise ValueError(
+                "APEX-DQN priority refresh requires a local learner "
+                "(num_learners=0) unless distributed_per is enabled"
+            )
         # DQN.__init__ builds a LOCAL replay we don't use; skip straight
         # to Algorithm init then attach shards
         from ray_tpu.rllib.algorithms.algorithm import Algorithm
@@ -199,7 +219,9 @@ class APEXDQN(DQN):
             self._rr = (self._rr + num_updates) % len(self.shards)
             pending = order[0].sample.remote(cfg.train_batch_size)
             for u, shard in enumerate(order):
-                batch = ray_tpu.get(pending, timeout=60)
+                # generous timeout: on the 1-core CI box a full-suite run
+                # can starve this actor round-trip for minutes
+                batch = ray_tpu.get(pending, timeout=300)
                 nxt = order[u + 1] if u + 1 < len(order) else None
                 if nxt is not None and nxt is not shard:
                     # prefetch only from a DIFFERENT shard: the buffer's

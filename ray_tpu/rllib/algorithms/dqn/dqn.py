@@ -30,6 +30,10 @@ class DQNConfig(AlgorithmConfig):
         self.num_steps_sampled_before_learning_starts = 1000
         self.target_network_update_freq = 120  # in updates
         self.double_q = True
+        # n-step returns (reference: dqn n_step / rainbow): >1 swaps the
+        # sampler for the Apex n-step runner — the learner consumes the
+        # per-row bootstrap discounts it emits
+        self.n_step = 1
         self.prioritized_replay = False
         self.per_alpha = 0.6
         self.per_beta = 0.4
@@ -53,6 +57,13 @@ class DQN(Algorithm):
                 "remote lockstep learners do not return per-sample TD errors, so "
                 "priorities would silently never update"
             )
+        from ray_tpu.rllib.env.off_policy_env_runner import OffPolicyEnvRunner
+
+        if getattr(config, "n_step", 1) > 1 and config.env_runner_cls is OffPolicyEnvRunner:
+            # lazy import: apex_dqn imports this module
+            from ray_tpu.rllib.algorithms.apex_dqn.apex_dqn import ApexEnvRunner
+
+            config.env_runner_cls = ApexEnvRunner
         super().__init__(config)
         from ray_tpu.rllib.utils.replay_buffers import (
             PrioritizedReplayBuffer,
@@ -84,7 +95,11 @@ class DQN(Algorithm):
         samples = self.env_runner_group.sample()
         sampled = 0
         for s in samples:
-            self.replay.add(s["batch"])
+            if s["batch"] is not None:  # n-step runner may hold partial windows
+                if cfg.prioritized_replay and s.get("priorities") is not None:
+                    self.replay.add_with_priorities(s["batch"], s["priorities"])
+                else:
+                    self.replay.add(s["batch"])
             sampled += s["metrics"]["num_env_steps"]
 
         results = self._fold_sample_metrics(samples)

@@ -192,7 +192,12 @@ class DreamerV3(Algorithm):
         self._critic_opt = optax.chain(optax.clip_by_global_norm(100.0), optax.adam(cfg.critic_lr))
         self._critic_opt_state = self._critic_opt.init(self.critic_params)
 
-        # sequence replay: flat ring of (obs, action, reward, cont, first)
+        # sequence replay: flat ring of (obs, action, reward, cont, first);
+        # capacity must be a lane multiple or wrap-around interleaves
+        # lanes. Kept on self (never mutate the caller's config); floored
+        # to one lane row so tiny capacities can't truncate to zero.
+        n_env_ = cfg.num_envs_per_env_runner
+        self._replay_cap = max(n_env_, cfg.replay_capacity - cfg.replay_capacity % n_env_)
         self._replay: Dict[str, np.ndarray] = {}
         self._replay_next = 0
         self._replay_size = 0
@@ -203,11 +208,16 @@ class DreamerV3(Algorithm):
 
     # ---------------- env interaction (driver-local vector env) ---------
     def _build_env(self):
-        import gymnasium as gym
+        from ray_tpu.rllib.utils.env import make_vector_env
 
         cfg = self.config
-        self._env = gym.make_vec(cfg.env, num_envs=cfg.num_envs_per_env_runner,
-                                 **(cfg.env_config or {}))
+        # NEXT_STEP autoreset, with the autoreset frame RELABELED in
+        # _collect as the episode's terminal frame (canonical DreamerV3
+        # layout): the world model must SEE terminal observations — with
+        # constant-reward envs the cont head is the only danger signal,
+        # and dropping final frames (SAME_STEP) leaves imagination with
+        # nothing to avoid.
+        self._env = make_vector_env(cfg)
         obs, _ = self._env.reset(seed=cfg.seed)
         n = cfg.num_envs_per_env_runner
         self._obs = obs
@@ -215,6 +225,8 @@ class DreamerV3(Algorithm):
         self._z = np.zeros((n, self.wm.stoch_dim), np.float32)
         self._prev_a = np.zeros((n, self.wm.n_actions), np.float32)
         self._first = np.ones(n, bool)
+        self._prev_done = np.zeros(n, bool)
+        self._prev_term = np.zeros(n, bool)
         self._ep_ret = np.zeros(n, np.float64)
 
         wm, cfg_ = self.wm, self.config
@@ -239,6 +251,7 @@ class DreamerV3(Algorithm):
         n = cfg.num_envs_per_env_runner
         steps = 0
         for _ in range(num_steps):
+            prev_done, prev_term = self._prev_done, self._prev_term
             self._rng, key = jax.random.split(self._rng)
             h, z, action = self._act_fn(
                 self.wm_params, self.actor_params,
@@ -247,13 +260,24 @@ class DreamerV3(Algorithm):
             )
             a_np = np.asarray(action)
             next_obs, reward, term, trunc, _ = self._env.step(a_np)
-            done = np.asarray(term) | np.asarray(trunc)
-            self._ep_ret += np.asarray(reward)
+            term, trunc = np.asarray(term), np.asarray(trunc)
+            done = term | trunc
+            reward = np.asarray(reward, np.float32)
+            self._ep_ret += reward
+            # NEXT_STEP autoreset relabeling (canonical DreamerV3 frame
+            # layout): lanes where the PREVIOUS step ended hold the dead
+            # episode's final observation with an env-ignored action and
+            # reward 0 — store them as the episode's TERMINAL frame
+            # (action=noop, cont=0 iff terminated, first=0). The latent
+            # thus unrolls through the fatal transition and the cont head
+            # learns terminal states — with constant-reward envs this is
+            # the only danger signal imagination has. first=1 lands one
+            # row later, on the reset observation.
             rows = {
                 "obs": np.asarray(self._obs, np.float32).reshape(n, -1),
-                "action": a_np.astype(np.int64),
-                "reward": np.asarray(reward, np.float32),
-                "cont": 1.0 - np.asarray(term, np.float32),
+                "action": np.where(prev_done, 0, a_np).astype(np.int64),
+                "reward": reward,
+                "cont": np.where(prev_done, 1.0 - prev_term, 1.0).astype(np.float32),
                 "first": self._first.astype(np.float32),
             }
             self._replay_add(rows)
@@ -265,14 +289,16 @@ class DreamerV3(Algorithm):
             self._z = np.asarray(z)
             self._prev_a = np.eye(self.wm.n_actions, dtype=np.float32)[a_np]
             self._obs = next_obs
-            self._first = done  # vector envs autoreset: next frame is new
+            self._first = prev_done  # reset obs arrives one step after done
+            self._prev_done = done
+            self._prev_term = term
             steps += n
         self._env_steps_lifetime += steps
         return steps
 
     # ---------------- sequence replay ------------------------------------
     def _replay_add(self, rows: Dict[str, np.ndarray]) -> None:
-        cap = self.config.replay_capacity
+        cap = self._replay_cap
         n = len(rows["reward"])
         if not self._replay:
             for k, v in rows.items():
@@ -288,7 +314,7 @@ class DreamerV3(Algorithm):
         interleaved envs are `num_envs` apart, so stride by num_envs to
         stay on one env's lane."""
         n_env = self.config.num_envs_per_env_runner
-        cap = self.config.replay_capacity
+        cap = self._replay_cap
         span = length * n_env
         hi = self._replay_size - span
         starts = self._np_rng.integers(0, max(1, hi), size=batch)

@@ -40,9 +40,9 @@ class R2D2Config(AlgorithmConfig):
         self.train_batch_size_seqs = 32
         self.replay_capacity = 100_000
         self.epsilon_initial = 1.0
-        self.epsilon_final = 0.05
+        self.epsilon_final = 0.02
         self.epsilon_timesteps = 10_000
-        self.target_network_update_freq = 400
+        self.target_network_update_freq = 200
         self.num_steps_sampled_before_learning_starts = 1000
         self.updates_per_iter = 8
         self.rollout_fragment_length = 64
@@ -127,7 +127,12 @@ class R2D2(Algorithm):
         self._opt_state = self._opt.init(self.params)
         self._updates = 0
 
-        # lane-strided flat ring (DreamerV3 layout)
+        # lane-strided flat ring (DreamerV3 layout); capacity must be a
+        # lane multiple or wrap-around indexing interleaves env lanes.
+        # Kept on self (never mutate the caller's config); floored to one
+        # full lane row so a tiny debug capacity can't truncate to zero.
+        n_env = cfg.num_envs_per_env_runner
+        self._replay_cap = max(n_env, cfg.replay_capacity - cfg.replay_capacity % n_env)
         self._replay: Dict[str, np.ndarray] = {}
         self._replay_next = 0
         self._replay_size = 0
@@ -138,11 +143,12 @@ class R2D2(Algorithm):
 
     # ---------------- env interaction -------------------------------------
     def _build_env(self):
-        import gymnasium as gym
+        from ray_tpu.rllib.utils.env import make_same_step_vector_env
 
         cfg = self.config
-        self._env = gym.make_vec(cfg.env, num_envs=cfg.num_envs_per_env_runner,
-                                 **(cfg.env_config or {}))
+        # SAME_STEP autoreset keeps fabricated frames out of the
+        # lane-strided ring — see make_same_step_vector_env
+        self._env = make_same_step_vector_env(cfg)
         obs, _ = self._env.reset(seed=cfg.seed)
         n = cfg.num_envs_per_env_runner
         self._obs = np.asarray(obs, np.float32).reshape(n, -1)
@@ -189,7 +195,7 @@ class R2D2(Algorithm):
 
     # ---------------- sequence replay (lane-strided ring) -----------------
     def _replay_add(self, rows: Dict[str, np.ndarray]) -> None:
-        cap = self.config.replay_capacity
+        cap = self._replay_cap
         nrows = len(rows["reward"])
         if not self._replay:
             for k, v in rows.items():
@@ -202,7 +208,7 @@ class R2D2(Algorithm):
 
     def _sample_seqs(self, batch: int, length: int) -> Dict[str, np.ndarray]:
         n_env = self.config.num_envs_per_env_runner
-        cap = self.config.replay_capacity
+        cap = self._replay_cap
         span = length * n_env
         hi = self._replay_size - span
         starts = self._np_rng.integers(0, max(1, hi), size=batch)
@@ -221,6 +227,20 @@ class R2D2(Algorithm):
         B_in = cfg.burn_in
 
         self._step_jit = jax.jit(net.step)
+
+        # invertible value rescaling (reference: rllib R2D2 lineage,
+        # Kapturowski et al. §2.3): Q-nets predict h(value), compressing
+        # the ~1/(1-gamma) return scale so the MSE stays conditioned
+        eps = 1e-3
+
+        def h(x):
+            return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+        def h_inv(y):
+            return jnp.sign(y) * (
+                ((jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(y) + 1.0 + eps)) - 1.0)
+                 / (2.0 * eps)) ** 2 - 1.0
+            )
 
         def loss_fn(params, target_params, seq):
             # sequence layout: [B, burn_in + train_len + 1] (the +1 step
@@ -250,7 +270,7 @@ class R2D2(Algorithm):
             # a next-step episode boundary invalidates the bootstrap
             # UNLESS the transition terminated (then it contributes 0)
             valid = 1.0 - (next_first * (1.0 - term))
-            target = r + cfg.gamma * (1.0 - term) * q_next
+            target = h(r + cfg.gamma * (1.0 - term) * h_inv(q_next))
             td = (q_sa - jax.lax.stop_gradient(target)) * valid
             loss = jnp.mean(td**2)
             return loss, {"loss": loss, "mean_q": jnp.mean(q_sa)}

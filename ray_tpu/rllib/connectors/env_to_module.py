@@ -53,6 +53,62 @@ class NormalizeObservations(Connector):
         self._m2 = st["m2"]
 
 
+class NormalizePixels(Connector):
+    """uint8 [0,255] HWC pixels → float32 [0,1] (reference: the
+    env_to_module preprocessing rllib applies to Atari frames before the
+    CNN encoder). The scale decision keys on the dtype and the
+    observation SPACE's bounds — never on a batch's content, which would
+    scale the same pixel intensity differently frame to frame. Float
+    envs with byte-range spaces (high > 1.5) divide by `scale`; float
+    envs already in [0, 1] (binary MinAtar-style frames) pass through."""
+
+    def __init__(self, scale: float = 255.0):
+        self.scale = scale
+
+    def __call__(self, obs, *, obs_space=None, **ctx):
+        obs = np.asarray(obs)
+        if obs.dtype == np.uint8:
+            return obs.astype(np.float32) / self.scale
+        obs = obs.astype(np.float32)
+        if obs_space is not None and np.max(obs_space.high) > 1.5:
+            return obs / self.scale
+        return obs
+
+
+class FrameStack(Connector):
+    """Stack the last k frames along the channel axis, per vector-env
+    lane (reference: rllib frame-stacking connector over Atari: velocity
+    becomes observable to a feedforward conv net).
+
+    Stateful: keeps each lane's last k frames. The env runner passes
+    `reset_lanes` (episode-boundary flags) so a new episode starts from
+    a repeated first frame instead of inheriting the dead episode's
+    tail. State rides get_state/set_state, so the runner's shape-probe
+    snapshot/restore (single_agent_env_runner.py) keeps it clean."""
+
+    def __init__(self, k: int = 4):
+        self.k = k
+        self._frames = None  # [E, H, W, C*k] rolling stack
+
+    def __call__(self, obs, *, reset_lanes=None, **ctx):
+        obs = np.asarray(obs, np.float32)
+        e, c = obs.shape[0], obs.shape[-1]
+        if self._frames is None or self._frames.shape[0] != e:
+            self._frames = np.concatenate([obs] * self.k, axis=-1)
+        else:
+            self._frames = np.concatenate([self._frames[..., c:], obs], axis=-1)
+            if reset_lanes is not None and np.any(reset_lanes):
+                idx = np.asarray(reset_lanes, bool)
+                self._frames[idx] = np.concatenate([obs[idx]] * self.k, axis=-1)
+        return self._frames
+
+    def get_state(self):
+        return {"frames": None if self._frames is None else self._frames.copy()}
+
+    def set_state(self, st):
+        self._frames = st["frames"]
+
+
 class OneHotDiscreteObservations(Connector):
     """Discrete obs → one-hot vectors (reference:
     env_to_module/one_hot_observations.py). Needs obs_space in ctx."""

@@ -108,6 +108,105 @@ class ContinuousMLPModule(RLModule):
         return action, logp
 
 
+class DiscreteConvModule(RLModule):
+    """Conv torso for pixel observations — categorical policy + value
+    heads (reference: rllib/core/models/configs.py:637 CNNEncoderConfig
+    and the models/torch visionnet lineage).
+
+    TPU-first: NHWC convs computed in bfloat16 with float32 accumulation
+    (`preferred_element_type`) so XLA tiles them onto the MXU; params
+    stay float32 masters. Strided convs downsample (no pooling ops —
+    strided conv is the one XLA fuses best), layernorm on the flattened
+    features keeps the head scale stable. The same forward serves PPO
+    (logits = policy) and DQN (logits = Q-values).
+
+    model_config keys:
+      "filters": ((out_ch, kernel, stride), ...) — default suits 10x10
+                 MinAtar-style frames; 84x84 Atari-scale frames would use
+                 ((32,8,4), (64,4,2), (64,3,1)).
+      "dense":   flat hidden width (default 128)
+      "compute_dtype": "bfloat16" (default) | "float32"
+    """
+
+    def __init__(self, obs_space, action_space, model_config=None):
+        if not hasattr(action_space, "n"):
+            raise ValueError(
+                f"DiscreteConvModule requires a discrete action space, got {action_space}"
+            )
+        if len(obs_space.shape) != 3:
+            raise ValueError(
+                f"DiscreteConvModule requires HxWxC observations, got {obs_space.shape}"
+            )
+        model_config = model_config or {}
+        self.obs_shape = tuple(obs_space.shape)
+        self.num_actions = int(action_space.n)
+        self.filters = tuple(model_config.get("filters", ((16, 3, 1), (32, 3, 2))))
+        self.dense = int(model_config.get("dense", 128))
+        self.compute_dtype = jnp.dtype(model_config.get("compute_dtype", "bfloat16"))
+        # trace the conv stack's flat size once, host-side
+        h, w, c = self.obs_shape
+        for out_ch, k, s in self.filters:
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+            c = out_ch
+        if h <= 0 or w <= 0:
+            raise ValueError(f"filters {self.filters} collapse {self.obs_shape} to zero")
+        self.flat_dim = h * w * c
+
+    def init_params(self, rng):
+        keys = jax.random.split(rng, len(self.filters) + 3)
+        convs = []
+        c_in = self.obs_shape[-1]
+        for i, (out_ch, k, s) in enumerate(self.filters):
+            fan_in = k * k * c_in
+            convs.append({
+                "w": jax.random.normal(keys[i], (k, k, c_in, out_ch)) * (2.0 / fan_in) ** 0.5,
+                "b": jnp.zeros((out_ch,)),
+            })
+            c_in = out_ch
+        k_d, k_pi, k_vf = keys[-3:]
+        return {
+            "convs": convs,
+            "ln": {"scale": jnp.ones((self.flat_dim,)), "bias": jnp.zeros((self.flat_dim,))},
+            "dense": {
+                "w": jax.random.normal(k_d, (self.flat_dim, self.dense)) * (2.0 / self.flat_dim) ** 0.5,
+                "b": jnp.zeros((self.dense,)),
+            },
+            "pi": {
+                "w": jax.random.normal(k_pi, (self.dense, self.num_actions)) * 0.01,
+                "b": jnp.zeros((self.num_actions,)),
+            },
+            "vf": {
+                "w": jax.random.normal(k_vf, (self.dense, 1)),
+                "b": jnp.zeros((1,)),
+            },
+        }
+
+    def forward(self, params, obs):
+        x = obs.astype(self.compute_dtype)
+        for layer, (_, _, s) in zip(params["convs"], self.filters):
+            # all-bf16 conv: the TPU MXU accumulates in f32 internally;
+            # an explicit f32 preferred_element_type would break the
+            # autodiff transpose rule (cotangent dtype mismatch)
+            x = jax.lax.conv_general_dilated(
+                x,
+                layer["w"].astype(self.compute_dtype),
+                window_strides=(s, s),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            x = jnp.maximum(x + layer["b"].astype(self.compute_dtype), 0.0)
+        x = x.astype(jnp.float32).reshape(x.shape[0], -1)
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        x = (x - mu) * jax.lax.rsqrt(var + 1e-5)
+        x = x * params["ln"]["scale"] + params["ln"]["bias"]
+        x = jnp.maximum(x @ params["dense"]["w"] + params["dense"]["b"], 0.0)
+        logits = x @ params["pi"]["w"] + params["pi"]["b"]
+        vf = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return {"logits": logits, "vf": vf}
+
+
 class DiscreteMLPModule(RLModule):
     """MLP torso with categorical policy + value heads (the default
     CartPole-class module; reference analogue: catalog default MLP).

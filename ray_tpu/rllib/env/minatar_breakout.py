@@ -1,0 +1,135 @@
+"""MinAtar-style Breakout — a native pixel environment.
+
+The north-star RLlib benchmark is pixel-observation control (reference:
+rllib PPO on Atari via ale_py; `ale_py` is not available in this image,
+so the pixel task is a MinAtar-style reduction — Young & Tian 2019's
+10x10 multi-channel Breakout — implemented here from scratch in numpy).
+The observation is a 10x10x4 binary image: channel 0 = paddle, 1 = ball,
+2 = ball trail (previous position — makes velocity observable without
+frame stacking), 3 = bricks. Actions: 0 = noop, 1 = left, 2 = right.
+Reward +1 per brick; the wall respawns when cleared; the episode ends
+when the ball passes the paddle.
+
+Exercises the full pixel path: conv encoder (`DiscreteConvModule`),
+pixel connectors, and the conv-PPO/DQN learning tests + bench line.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+SIZE = 10
+N_CHANNELS = 4
+CH_PADDLE, CH_BALL, CH_TRAIL, CH_BRICK = range(N_CHANNELS)
+N_ACTIONS = 3  # noop, left, right
+BRICK_ROWS = (1, 2, 3)
+
+
+class MinAtarBreakout(gym.Env):
+    """Gymnasium single env; vectorized via SyncVectorEnv."""
+
+    metadata: Dict[str, Any] = {"render_modes": []}
+
+    def __init__(self, **kwargs):
+        self.observation_space = gym.spaces.Box(0.0, 1.0, (SIZE, SIZE, N_CHANNELS), np.float32)
+        self.action_space = gym.spaces.Discrete(N_ACTIONS)
+        self._rng = np.random.default_rng()
+        self._paddle = SIZE // 2
+        self._ball: Tuple[int, int] = (3, 0)
+        self._prev_ball: Tuple[int, int] = (3, 0)
+        self._dy = 1
+        self._dx = 1
+        self._bricks = np.zeros((SIZE, SIZE), bool)
+
+    # -- helpers -----------------------------------------------------------
+    def _spawn_ball(self) -> None:
+        x = int(self._rng.integers(0, SIZE))
+        self._ball = (3 + 1, x)  # just below the brick wall, moving down
+        self._prev_ball = self._ball
+        self._dy = 1
+        self._dx = 1 if self._rng.random() < 0.5 else -1
+
+    def _obs(self) -> np.ndarray:
+        o = np.zeros((SIZE, SIZE, N_CHANNELS), np.float32)
+        o[SIZE - 1, self._paddle, CH_PADDLE] = 1.0
+        o[self._ball[0], self._ball[1], CH_BALL] = 1.0
+        o[self._prev_ball[0], self._prev_ball[1], CH_TRAIL] = 1.0
+        o[:, :, CH_BRICK] = self._bricks
+        return o
+
+    # -- gym API -----------------------------------------------------------
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._paddle = SIZE // 2
+        self._bricks[:] = False
+        for r in BRICK_ROWS:
+            self._bricks[r, :] = True
+        self._spawn_ball()
+        return self._obs(), {}
+
+    def step(self, action: int):
+        action = int(action)
+        if action == 1:
+            self._paddle = max(0, self._paddle - 1)
+        elif action == 2:
+            self._paddle = min(SIZE - 1, self._paddle + 1)
+
+        reward = 0.0
+        terminated = False
+        y, x = self._ball
+        ny, nx = y + self._dy, x + self._dx
+        # side walls reflect horizontally
+        if nx < 0 or nx >= SIZE:
+            self._dx = -self._dx
+            nx = x + self._dx
+        # ceiling reflects vertically
+        if ny < 0:
+            self._dy = -self._dy
+            ny = y + self._dy
+        # brick hit: remove it, score, bounce back up
+        if 0 <= ny < SIZE and self._bricks[ny, nx]:
+            self._bricks[ny, nx] = False
+            reward = 1.0
+            self._dy = -self._dy
+            ny = y + self._dy
+            if not self._bricks.any():
+                for r in BRICK_ROWS:
+                    self._bricks[r, :] = True
+        # paddle row: catch or lose
+        if ny >= SIZE - 1:
+            if nx == self._paddle or x == self._paddle:
+                self._dy = -1
+                ny = SIZE - 2
+            else:
+                terminated = True
+                ny = SIZE - 1
+        self._prev_ball = (y, x)
+        self._ball = (ny, nx)
+        return self._obs(), reward, terminated, False, {}
+
+    def render(self):  # pragma: no cover - debugging aid
+        chars = np.full((SIZE, SIZE), ".", dtype="<U1")
+        chars[self._bricks] = "#"
+        chars[self._prev_ball] = "-"
+        chars[self._ball] = "o"
+        chars[SIZE - 1, self._paddle] = "="
+        return "\n".join("".join(row) for row in chars)
+
+    def close(self):
+        pass
+
+
+def register() -> str:
+    """Idempotently register `MinAtarBreakout-v0` with gymnasium."""
+    import gymnasium as gym
+
+    if "MinAtarBreakout-v0" not in gym.registry:
+        gym.register(
+            "MinAtarBreakout-v0",
+            entry_point="ray_tpu.rllib.env.minatar_breakout:MinAtarBreakout",
+            max_episode_steps=500,
+        )
+    return "MinAtarBreakout-v0"

@@ -55,6 +55,11 @@ class SingleAgentEnvRunner(EnvRunner):
             for c, st in saved:
                 c.set_state(st)
             module_obs_space = gym.spaces.Box(-np.inf, np.inf, probe.shape[1:], np.float32)
+        # what the MODULE consumes — EnvRunnerGroup.spaces() must hand
+        # this (not the raw env space) to the learner, or a
+        # shape-changing connector (FrameStack, one-hot) desyncs the
+        # learner's module from the sampled batches
+        self.module_obs_space = module_obs_space
         self.module = config.build_module(module_obs_space, self.env.single_action_space)
         self._rng = jax.random.PRNGKey(config.seed + 1000 * (worker_index + 1))
         self.params = self.module.init_params(self._rng)
@@ -84,12 +89,17 @@ class SingleAgentEnvRunner(EnvRunner):
         # Running per-env episode accounting (survives fragment edges).
         self._init_episode_accounting(self.num_envs)
 
-    def _transform_obs(self, obs):
+    def _transform_obs(self, obs, reset_lanes=None):
         obs = np.asarray(obs, np.float32)
         if self._env_conn is None:
             return obs
         return np.asarray(
-            self._env_conn(obs, obs_space=self.env.single_observation_space), np.float32
+            self._env_conn(
+                obs,
+                obs_space=self.env.single_observation_space,
+                reset_lanes=reset_lanes,
+            ),
+            np.float32,
         )
 
     @staticmethod
@@ -140,7 +150,10 @@ class SingleAgentEnvRunner(EnvRunner):
 
             next_obs, reward, terminated, truncated, _ = self.env.step(env_action)
             done = terminated | truncated
-            mod_next = self._transform_obs(next_obs)
+            # lanes where the PREVIOUS step ended just delivered their
+            # reset observation (NEXT_STEP autoreset) — stateful
+            # connectors (FrameStack) start those lanes fresh
+            mod_next = self._transform_obs(next_obs, reset_lanes=prev_done)
             rew_buf[:, t] = reward
             term_buf[:, t] = terminated
             done_buf[:, t] = done

@@ -31,6 +31,67 @@ def make_vector_env(config):
     )
 
 
+def make_same_step_vector_env(config):
+    """Vector env in SAME_STEP autoreset mode, for collectors feeding
+    lane-strided sequence replay (R2D2, DreamerV3): the reset obs
+    arrives in the step() that reports done, so `first = done` marks the
+    true episode start and no fabricated NEXT_STEP autoreset frame
+    (dead episode's final obs + ignored action + reward 0) enters the
+    ring — per-lane row skipping would break lane alignment, so the
+    NEXT_STEP masking used by OffPolicyEnvRunner is not an option there.
+    Forces sync vectorization: native vector entry points (e.g.
+    CartPole-v1's) reject vector_kwargs.
+    """
+    import gymnasium as gym
+    from gymnasium.vector import AutoresetMode
+
+    n = config.num_envs_per_env_runner
+    if callable(config.env):
+        return gym.vector.SyncVectorEnv(
+            [lambda: config.env(config.env_config) for _ in range(n)],
+            autoreset_mode=AutoresetMode.SAME_STEP,
+        )
+    return gym.make_vec(
+        config.env,
+        num_envs=n,
+        vectorization_mode="sync",
+        vector_kwargs={"autoreset_mode": AutoresetMode.SAME_STEP},
+        **(config.env_config or {}),
+    )
+
+
+def module_obs_space_for(config, obs_space):
+    """The observation space the MODULE sees: the env space pushed
+    through the env_to_module connector pipeline (shape probe only).
+    Stateful connector state is snapshotted and restored around the
+    probe — build_connector wraps the instances held ON the config, so
+    without the restore a running normalizer would fold the synthetic
+    zero frame into statistics every runner later inherits. Mirrors the
+    probe in single_agent_env_runner.py; learners must build modules
+    against this, not the raw env space."""
+    build_conn = getattr(config, "build_connector", None)
+    if build_conn is None:
+        return obs_space
+    conn = build_conn("env_to_module")
+    if conn is None:
+        return obs_space
+    import gymnasium as gym
+    import numpy as np
+
+    saved = [(c, c.get_state()) for c in conn.connectors if hasattr(c, "get_state")]
+    try:
+        probe = np.asarray(
+            conn(np.zeros((1,) + obs_space.shape, np.float32), obs_space=obs_space),
+            np.float32,
+        )
+    finally:
+        for c, st in saved:
+            c.set_state(st)
+    if probe.shape[1:] == obs_space.shape:
+        return obs_space
+    return gym.spaces.Box(-np.inf, np.inf, probe.shape[1:], np.float32)
+
+
 def env_spaces(config):
     """(observation_space, action_space) from one throwaway env."""
     env = make_single_env(config)

@@ -134,7 +134,7 @@ def cmd_debug(args):
         idx = 0 if len(bps) == 1 else int(input("attach to which breakpoint? "))
     bp = bps[idx]
     print(f"attaching to {bp['host']}:{bp['port']} — pdb commands apply in the remote frame")
-    rpdb.connect(bp["host"], bp["port"])
+    rpdb.connect(bp["host"], bp["port"], token=bp.get("token", ""))
 
 
 def cmd_up(args):

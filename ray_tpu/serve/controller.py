@@ -91,10 +91,15 @@ class Replica:
 @ray_tpu.remote
 class ServeControllerActor:
     def __init__(self):
+        from ray_tpu.serve.deployment_scheduler import DeploymentScheduler
+
         # app -> deployment -> record
         self.apps: Dict[str, Dict[str, Dict[str, Any]]] = {}
         self.routes: Dict[str, tuple] = {}  # route_prefix -> (app, deployment)
         self._counter = 0
+        self._scheduler = DeploymentScheduler()
+        # node-grouped order the last upgrade drained in (introspection)
+        self._last_drain_order: List[List[str]] = []
         # long-poll state: key -> monotonically increasing version; parked
         # listeners wake on bump (reference: LongPollHost notify_changed)
         self._versions: Dict[str, int] = {}
@@ -213,9 +218,18 @@ class ServeControllerActor:
             # requests before dying — a config redeploy must not drop
             # requests (reference: serve rolling updates +
             # graceful_shutdown_wait_loop_s)
-            for name in old["replicas"]:
-                if name not in rec["replicas"]:
-                    asyncio.ensure_future(self._drain_and_kill(name))
+            doomed = [n for n in old["replicas"] if n not in rec["replicas"]]
+            # node-by-node rolling drain: one node's old replicas finish
+            # their in-flight requests and die before the next node's are
+            # touched (reference: serve drain-aware rolling updates)
+            groups = self._scheduler.drain_groups(doomed)
+            self._last_drain_order = groups
+
+            async def _drain_by_node():
+                for grp in groups:
+                    await asyncio.gather(*(self._drain_and_kill(n) for n in grp))
+
+            asyncio.ensure_future(_drain_by_node())
         if route_prefix:
             self.routes[route_prefix] = (app_name, deployment_name, is_ingress)
             self._bump("routes")
@@ -230,7 +244,10 @@ class ServeControllerActor:
         while len(cur) < target:
             self._counter += 1
             name = f"SERVE_REPLICA::{app_name}::{deployment_name}::{self._counter}"
-            Replica.options(name=name, max_concurrency=16, **rec["ray_actor_options"]).remote(
+            # placement policy: spread by default, pack TPU replicas
+            # (reference: serve/_private/deployment_scheduler.py)
+            opts = self._scheduler.place(name, rec["ray_actor_options"])
+            Replica.options(name=name, max_concurrency=16, **opts).remote(
                 rec["cls"], rec["init_args"], rec["init_kwargs"]
             )
             cur.append(name)
@@ -259,6 +276,7 @@ class ServeControllerActor:
             ray_tpu.kill(ray_tpu.get_actor(name))
         except Exception:
             pass
+        self._scheduler.forget(name)
 
     # ------------------------------------------------------ autoscale loop
     async def run_control_loop(self, period_s: float = 1.0):
@@ -305,6 +323,14 @@ class ServeControllerActor:
 
     async def get_routes(self) -> Dict[str, tuple]:
         return dict(self.routes)
+
+    async def last_drain_order(self) -> List[List[str]]:
+        """Node-grouped replica names the last upgrade drained in order."""
+        return self._last_drain_order
+
+    async def replica_placements(self) -> Dict[str, str]:
+        """replica name -> node id chosen by the deployment scheduler."""
+        return dict(self._scheduler._placed)
 
     async def delete_app(self, app_name: str):
         app = self.apps.pop(app_name, None)

@@ -1,0 +1,104 @@
+"""Serve replica placement policy.
+
+Equivalent of the reference's deployment scheduler
+(reference: python/ray/serve/_private/deployment_scheduler.py —
+SpreadDeploymentSchedulingPolicy spreads replicas across nodes;
+compact/affinity strategies pack them). TPU-first twist: deployments
+that request TPU chips PACK onto the fewest nodes (replica traffic then
+rides intra-slice ICI and a node's chips serve one model copy), while
+CPU deployments SPREAD for fault isolation — losing one node loses
+1/N replicas, not all of them.
+
+The scheduler tracks its own placements so spreading is balanced from
+the first replica (the GCS actor table only reflects started actors),
+and it records the node-grouped drain order that versioned upgrades
+follow (drain one node fully before touching the next — reference:
+serve's node-by-node rolling updates honoring draining nodes).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+class DeploymentScheduler:
+    def __init__(self):
+        # replica name -> node_id chosen for it
+        self._placed: Dict[str, str] = {}
+
+    # ------------------------------------------------------------ helpers
+    @staticmethod
+    def _alive_nodes() -> List[Dict[str, Any]]:
+        try:
+            from ray_tpu.util.state import list_nodes
+
+            return [n for n in list_nodes() if n.get("state") == "ALIVE"]
+        except Exception:
+            return []
+
+    @staticmethod
+    def _deployment_key(replica_name: str) -> str:
+        # replica names are SERVE_REPLICA::<app>::<deployment>::<n>
+        return "::".join(replica_name.split("::")[:3])
+
+    def _count_on(self, node_id: str, deployment_key: str) -> int:
+        """Count only THIS deployment's replicas: spreading must balance
+        per deployment, or a new deployment's replicas all land on
+        whichever node other apps left empty."""
+        return sum(
+            1 for name, nid in self._placed.items()
+            if nid == node_id and self._deployment_key(name) == deployment_key
+        )
+
+    # ------------------------------------------------------------ policy
+    def place(self, replica_name: str, actor_options: Dict[str, Any]) -> Dict[str, Any]:
+        """Returns the actor options extended with a scheduling strategy.
+
+        - explicit user strategy: passed through untouched
+        - TPU replicas: PACK — fill the node with the most free chips
+        - default: SPREAD — least-loaded alive node by tracked count
+        """
+        if "scheduling_strategy" in actor_options:
+            return actor_options
+        nodes = self._alive_nodes()
+        if not nodes:
+            return actor_options
+        tpu_need = float((actor_options.get("resources") or {}).get("TPU", 0))
+        out = dict(actor_options)
+        key = self._deployment_key(replica_name)
+        if tpu_need > 0:
+            fits = [
+                n for n in nodes
+                if n.get("resources_available", {}).get("TPU", 0) >= tpu_need
+            ]
+            if fits:
+                # pack: most replicas already here first, then most free chips
+                best = max(fits, key=lambda n: (
+                    self._count_on(n["node_id"], key),
+                    n["resources_available"].get("TPU", 0),
+                ))
+                self._placed[replica_name] = best["node_id"]
+                out["scheduling_strategy"] = NodeAffinitySchedulingStrategy(
+                    best["node_id"], soft=True
+                )
+            return out
+        best = min(nodes, key=lambda n: (self._count_on(n["node_id"], key), n["node_id"]))
+        self._placed[replica_name] = best["node_id"]
+        out["scheduling_strategy"] = NodeAffinitySchedulingStrategy(best["node_id"], soft=True)
+        return out
+
+    def forget(self, replica_name: str) -> None:
+        self._placed.pop(replica_name, None)
+
+    def drain_groups(self, replica_names: List[str]) -> List[List[str]]:
+        """Group replicas by node for node-by-node draining; replicas with
+        no tracked node drain last, together."""
+        by_node: Dict[Optional[str], List[str]] = {}
+        for name in replica_names:
+            by_node.setdefault(self._placed.get(name), []).append(name)
+        unknown = by_node.pop(None, None)
+        groups = [by_node[k] for k in sorted(by_node)]
+        if unknown:
+            groups.append(unknown)
+        return groups

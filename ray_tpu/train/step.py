@@ -127,6 +127,21 @@ def setup_sharded_training(
     strategy = strategy or os.environ.get("RAY_TPU_TRAIN_STRATEGY") or "fsdp"
     if devices is None:
         devices = jax.devices()
+    # "dcn_dp=N+<inner>" routes to the multislice path: N device islands
+    # with <inner> laid out inside each, gradients crossing islands via
+    # the host-mediated DCN allreduce (parallel/multislice.py). Same
+    # 5-tuple contract; state/batch become per-slice lists.
+    if "dcn_dp" in strategy:
+        parts = strategy.split("+")
+        dcn = next(p for p in parts if p.startswith("dcn_dp"))
+        n_slices = int(dcn.split("=")[1]) if "=" in dcn else 2
+        inner = "+".join(p for p in parts if not p.startswith("dcn_dp")) or "dp"
+        from ray_tpu.parallel.multislice import setup_multislice_training
+
+        ms = setup_multislice_training(
+            cfg, n_slices, strategy=inner, devices=devices, model=model, **step_kwargs
+        )
+        return ms.meshes, ms.init_states, ms.step, ms.shard_batches, ms.rules
     if mesh_spec is None:
         mesh_spec = default_mesh_for_strategy(strategy, len(devices))
     elif isinstance(mesh_spec, dict):

@@ -86,28 +86,41 @@ def set_trace(frame=None):
     Registers {host, port, pid, where} under ns "rpdb" keyed by
     "<pid>:<port>"; the record is removed when the session ends.
     """
+    import secrets
+
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    # bind all interfaces and advertise a routable address: the
-    # attaching driver may sit on another node of the cluster
-    server.bind(("0.0.0.0", 0))
+    # a pdb prompt is arbitrary code execution, so default to loopback
+    # (the reference rpdb binds localhost too); cross-node attach is
+    # opt-in via RAY_TPU_RPDB_BIND and still gated by the session token
+    bind = os.environ.get("RAY_TPU_RPDB_BIND", "127.0.0.1")
+    server.bind((bind, 0))
     server.listen(1)
     port = server.getsockname()[1]
     caller = frame or sys._getframe().f_back
     key = f"{os.getpid()}:{port}"
-    try:
-        # the address other hosts reach THIS host by: route a UDP probe
-        # (no traffic is sent) and read the chosen source address
-        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        probe.connect(("8.8.8.8", 80))
-        host = probe.getsockname()[0]
-        probe.close()
-    except OSError:
-        host = "127.0.0.1"
+    if bind not in ("0.0.0.0", ""):
+        # bound to a specific interface: advertise exactly that address —
+        # the default-route probe could name a NIC nothing listens on
+        host = bind
+    else:
+        try:
+            # wildcard bind: the address other hosts reach THIS host by —
+            # route a UDP probe (no traffic is sent), read the source addr
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            probe.connect(("8.8.8.8", 80))
+            host = probe.getsockname()[0]
+            probe.close()
+        except OSError:
+            host = "127.0.0.1"
+    # one-time token: the attacher must present it as its first line
+    # before pdb starts; `ray_tpu debug` reads it from the GCS record
+    token = secrets.token_hex(16)
     rec = {
         "host": host,
         "port": port,
         "pid": os.getpid(),
+        "token": token,
         "where": f"{caller.f_code.co_filename}:{caller.f_lineno}",
         "time": time.time(),
     }
@@ -115,8 +128,33 @@ def set_trace(frame=None):
         _kv("kv.put", {"ns": _KV_NS, "key": key, "value": json.dumps(rec)})
     except Exception:
         pass  # not connected to a cluster: plain socket pdb still works
-    sys.stderr.write(f"rpdb waiting on 127.0.0.1:{port} ({rec['where']}) — attach with `ray_tpu debug`\n")
-    conn, _ = server.accept()
+    sys.stderr.write(f"rpdb waiting on {host}:{port} ({rec['where']}) — attach with `ray_tpu debug`\n")
+    while True:
+        conn, _ = server.accept()
+        # token handshake before any pdb I/O: first line must match.
+        # Read byte-wise — a buffered makefile could read ahead past the
+        # token line and swallow pdb commands sent in the same segment.
+        # Bounded by a timeout so a half-open connection (port scanner)
+        # can't wedge the accept loop and lock out the real attacher.
+        conn.settimeout(10.0)
+        buf = b""
+        try:
+            while not buf.endswith(b"\n") and len(buf) < 256:
+                ch = conn.recv(1)
+                if not ch:
+                    break
+                buf += ch
+        except OSError:
+            buf = b""
+        presented = buf.decode(errors="replace").strip()
+        if presented == token:
+            conn.settimeout(None)
+            break
+        try:
+            conn.sendall(b"rpdb: bad token\n")
+            conn.close()
+        except OSError:
+            pass
     # ALL cleanup happens before the tracer installs: once set_trace
     # returns, every new call from this frame fires a --Call-- event and
     # would trap the session inside rpdb instead of the user's frame.
@@ -139,11 +177,12 @@ def list_breakpoints() -> List[Dict[str, Any]]:
     return out
 
 
-def connect(host: str, port: int, stdin=None, stdout=None) -> None:
+def connect(host: str, port: int, stdin=None, stdout=None, token: str = "") -> None:
     """Bridge the local terminal to a waiting breakpoint server."""
     stdin = stdin or sys.stdin
     stdout = stdout or sys.stdout
     sock = socket.create_connection((host, port), timeout=30)
+    sock.sendall((token + "\n").encode())
     sock.settimeout(0.2)
     import threading
 

@@ -239,7 +239,15 @@ def test_read_mongo_with_injected_client(ray_start_regular):
                     expr = st["$match"]["$expr"]["$eq"]
                     num_shards = expr[0]["$mod"][1]
                     shard = expr[1]
-                    docs = [d for d in docs if hash(str(d["_id"])) % num_shards == shard]
+                    # deterministic digest: hash() is PYTHONHASHSEED-random
+                    # per process, so shards evaluated in different workers
+                    # would not partition the collection
+                    import hashlib
+
+                    def _h(v):
+                        return int(hashlib.md5(str(v).encode()).hexdigest(), 16)
+
+                    docs = [d for d in docs if _h(d["_id"]) % num_shards == shard]
                 if "$limit" in st:
                     docs = docs[: st["$limit"]]
             return iter(docs)

@@ -119,7 +119,10 @@ def test_dreamer_learning_smoke():
     config = _small_config(train_ratio=48)
     algo = config.build()
     best = 0.0
-    for i in range(60):
+    # 150-iteration ceiling: with the relabeled-terminal replay layout
+    # the seed-0 curve crosses 35 around iter ~115 (passing runs break
+    # out early at 60)
+    for i in range(150):
         result = algo.train()
         r = result["episode_return_mean"]
         if r == r:

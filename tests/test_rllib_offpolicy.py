@@ -222,7 +222,10 @@ def test_apex_dqn_learns_cartpole(ray_start_regular):
     config.epsilon_timesteps = 5000
     algo = config.build()
     best = 0.0
-    for i in range(300):
+    # 500-iteration ceiling (passing runs break out long before): actor
+    # interleaving is timing-dependent, so under full-suite load the same
+    # config needs more iterations to hit the same bar
+    for i in range(500):
         result = algo.train()
         r = result.get("episode_return_mean")
         if r == r:

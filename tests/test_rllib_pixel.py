@@ -1,0 +1,211 @@
+"""Pixel RL path: MinAtar-style env, conv module, pixel connectors,
+conv-PPO / conv-DQN learning (reference: rllib's CNN encoder stack,
+core/models/configs.py:637, driven by Atari-class pixel envs; ale_py is
+not in this image so the pixel task is the native MinAtar-style
+Breakout in ray_tpu/rllib/env/minatar_breakout.py)."""
+import numpy as np
+import pytest
+
+
+def test_minatar_breakout_mechanics():
+    """Brick hits score and clear; missing the ball terminates; the
+    observation encodes paddle/ball/trail/bricks in separate channels."""
+    from ray_tpu.rllib.env.minatar_breakout import (
+        CH_BALL, CH_BRICK, CH_PADDLE, CH_TRAIL, MinAtarBreakout,
+    )
+
+    env = MinAtarBreakout()
+    obs, _ = env.reset(seed=3)
+    assert obs.shape == (10, 10, 4)
+    assert obs[..., CH_PADDLE].sum() == 1.0
+    assert obs[..., CH_BALL].sum() == 1.0
+    assert obs[..., CH_BRICK].sum() == 30.0  # 3 rows of 10 bricks
+
+    # run random play until a brick is hit and until a miss terminates;
+    # both must occur within a bounded horizon
+    rng = np.random.default_rng(0)
+    saw_reward = saw_terminal = False
+    for ep in range(50):
+        env.reset(seed=100 + ep)
+        for _ in range(500):
+            obs, r, term, trunc, _ = env.step(int(rng.integers(3)))
+            if r > 0:
+                saw_reward = True
+                # the struck brick is gone
+                assert obs[..., CH_BRICK].sum() < 30.0
+            if term:
+                saw_terminal = True
+                break
+        if saw_reward and saw_terminal:
+            break
+    assert saw_reward and saw_terminal
+
+    # trail channel tracks the previous ball position
+    env.reset(seed=7)
+    o1, *_ = env.step(0)
+    ball_pos = np.argwhere(o1[..., CH_BALL])[0]
+    o2, *_ = env.step(0)
+    trail_pos = np.argwhere(o2[..., CH_TRAIL])[0]
+    np.testing.assert_array_equal(ball_pos, trail_pos)
+
+
+def test_conv_module_shapes_and_grads():
+    """DiscreteConvModule: NHWC conv stack → logits/vf with gradients
+    flowing to every parameter (bf16 compute, f32 masters)."""
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.rl_module import DiscreteConvModule
+
+    obs_space = gym.spaces.Box(0.0, 1.0, (10, 10, 4), np.float32)
+    m = DiscreteConvModule(obs_space, gym.spaces.Discrete(3))
+    params = m.init_params(jax.random.PRNGKey(0))
+    out = jax.jit(m.forward)(params, jnp.zeros((5, 10, 10, 4)))
+    assert out["logits"].shape == (5, 3) and out["vf"].shape == (5,)
+
+    def loss(p, x):
+        o = m.forward(p, x)
+        return jnp.sum(o["logits"] ** 2) + jnp.sum(o["vf"] ** 2)
+
+    x = jnp.asarray(np.random.default_rng(0).random((4, 10, 10, 4)), jnp.float32)
+    grads = jax.grad(loss)(params, x)
+    flat, _ = jax.tree.flatten(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+    assert any(float(jnp.abs(g).max()) > 0 for g in flat)
+    # f32 masters regardless of compute dtype
+    assert all(g.dtype == jnp.float32 for g in flat)
+
+
+def test_conv_module_autoselected_for_image_obs():
+    """build_module picks the conv torso for 3-D observation spaces
+    (reference: catalog CNNEncoderConfig selection)."""
+    import gymnasium as gym
+
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.core.rl_module import DiscreteConvModule, DiscreteMLPModule
+
+    config = PPOConfig()
+    img = config.build_module(
+        gym.spaces.Box(0, 1, (10, 10, 4), np.float32), gym.spaces.Discrete(3)
+    )
+    vec = config.build_module(
+        gym.spaces.Box(-1, 1, (4,), np.float32), gym.spaces.Discrete(2)
+    )
+    assert isinstance(img, DiscreteConvModule)
+    assert isinstance(vec, DiscreteMLPModule)
+
+
+def test_pixel_connectors():
+    """NormalizePixels scales uint8 frames; FrameStack stacks along the
+    channel axis per lane and restarts lanes on episode boundaries."""
+    from ray_tpu.rllib.connectors.env_to_module import FrameStack, NormalizePixels
+
+    norm = NormalizePixels()
+    u8 = (np.ones((2, 4, 4, 1)) * 255).astype(np.uint8)
+    out = norm(u8)
+    assert out.dtype == np.float32 and out.max() == 1.0
+    binary = np.ones((2, 4, 4, 1), np.float32)
+    np.testing.assert_array_equal(norm(binary), binary)  # untouched
+
+    fs = FrameStack(k=3)
+    f1 = np.full((2, 4, 4, 2), 1.0, np.float32)
+    s1 = fs(f1)
+    assert s1.shape == (2, 4, 4, 6)
+    np.testing.assert_array_equal(s1, np.concatenate([f1] * 3, -1))
+    f2 = np.full((2, 4, 4, 2), 2.0, np.float32)
+    s2 = fs(f2, reset_lanes=np.array([False, True]))
+    # lane 0 rolls: [1, 1, 2]; lane 1 restarts: [2, 2, 2]
+    assert s2[0, 0, 0, 0] == 1.0 and s2[0, 0, 0, -1] == 2.0
+    np.testing.assert_array_equal(s2[1], np.full((4, 4, 6), 2.0))
+
+
+def test_framestack_pipeline_end_to_end():
+    """A channel-multiplying connector (FrameStack) must reach the
+    LEARNER too: the learner's module is built from the transformed obs
+    space, so sampled 4k-channel batches fit its conv stack."""
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.connectors.env_to_module import FrameStack
+    from ray_tpu.rllib.env.minatar_breakout import register
+
+    config = (
+        PPOConfig()
+        .environment(register())
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                     rollout_fragment_length=16)
+        .training(lr=1e-3, train_batch_size=64, minibatch_size=32, num_epochs=1)
+        .debugging(seed=0)
+    )
+    config.env_to_module_connector = FrameStack(k=2)
+    algo = config.build()
+    r = algo.train()  # one full sample->learn cycle through 8-channel obs
+    assert "episode_return_mean" in r
+    assert algo.env_runner_group.spaces()[0].shape == (10, 10, 8)
+    algo.stop()
+
+
+def test_conv_ppo_learns_minatar_breakout():
+    """Conv-PPO on the pixel env: the policy must track the ball with
+    the paddle (random play scores ~0.23; the bar is >2.0 — ~10x random,
+    unreachable without reading the pixels)."""
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.env.minatar_breakout import register
+
+    config = (
+        PPOConfig()
+        .environment(register())
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
+                     rollout_fragment_length=128)
+        .training(lr=1e-3, train_batch_size=2048, minibatch_size=256, num_epochs=4)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    best = -np.inf
+    # seed-0 curve: ~3.3 by iter 90, 4.3 by 190 — bar 2.0 with headroom
+    for i in range(150):
+        result = algo.train()
+        r = result["episode_return_mean"]
+        if r == r:
+            best = max(best, r)
+        if best > 2.5:
+            break
+    algo.stop()
+    assert best > 2.0, f"conv-PPO failed on pixel breakout (best {best})"
+
+
+def test_conv_dqn_learns_minatar_breakout():
+    """Conv-DQN end-to-end on pixels: n-step returns (the Apex n-step
+    runner behind DQNConfig.n_step) + prioritized replay. The bar is
+    ~4x random play (0.23) — the conv torso is the only input path, so
+    clearing it proves pixel learning (probe: 1.07 by iter ~750)."""
+    from ray_tpu.rllib import DQNConfig
+    from ray_tpu.rllib.env.minatar_breakout import register
+
+    config = (
+        DQNConfig()
+        .environment(register())
+        .training(
+            lr=1e-3,
+            train_batch_size=64,
+            num_steps_sampled_before_learning_starts=1000,
+            target_network_update_freq=300,
+            training_intensity=4.0,
+        )
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                     rollout_fragment_length=32)
+        .debugging(seed=0)
+    )
+    config.epsilon_timesteps = 20_000
+    config.n_step = 3
+    config.prioritized_replay = True
+    algo = config.build()
+    best = -np.inf
+    for i in range(900):
+        result = algo.train()
+        r = result.get("episode_return_mean")
+        if r is not None and r == r:
+            best = max(best, r)
+        if best > 0.95:
+            break
+    algo.stop()
+    assert best > 0.9, f"conv-DQN failed on pixel breakout (best {best})"

@@ -1,0 +1,108 @@
+"""Serve deployment scheduler: replica spread across nodes, TPU packing,
+node-by-node drain on upgrades
+(reference: python/ray/serve/_private/deployment_scheduler.py)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def two_node_cluster():
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    c.add_node(num_cpus=4)
+    c.connect()
+    c.wait_for_nodes()
+    yield c
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _replica_nodes():
+    from ray_tpu.util.state import list_actors
+
+    nodes = {}
+    for a in list_actors():
+        if a.get("name", "").startswith("SERVE_REPLICA::") and a.get("state") == "ALIVE":
+            nodes[a["name"]] = a.get("node_id")
+    return nodes
+
+
+def test_replicas_spread_across_nodes(two_node_cluster):
+    @serve.deployment(num_replicas=4)
+    class S:
+        def __call__(self, x):
+            return x
+
+    serve.run(S.bind(), name="spread_app")
+    deadline = time.time() + 30
+    placed = {}
+    while time.time() < deadline:
+        placed = _replica_nodes()
+        if len(placed) == 4 and all(placed.values()):
+            break
+        time.sleep(0.5)
+    by_node = {}
+    for name, node in placed.items():
+        by_node.setdefault(node, []).append(name)
+    assert len(placed) == 4, placed
+    counts = sorted(len(v) for v in by_node.values())
+    assert counts == [2, 2], f"expected 2+2 spread, got {by_node}"
+
+
+def test_tpu_replicas_pack(two_node_cluster):
+    """TPU-requesting replicas pack onto the fewest chips-bearing nodes."""
+    c = two_node_cluster
+    c.add_node(num_cpus=2, resources={"TPU": 4})
+    c.add_node(num_cpus=2, resources={"TPU": 4})
+    time.sleep(1.0)
+
+    @serve.deployment(num_replicas=2, ray_actor_options={"resources": {"TPU": 1}})
+    class M:
+        def __call__(self, x):
+            return x
+
+    serve.run(M.bind(), name="tpu_app")
+    ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+    deadline = time.time() + 30
+    placements = {}
+    while time.time() < deadline:
+        placements = {
+            k: v for k, v in ray_tpu.get(ctrl.replica_placements.remote()).items()
+            if "tpu_app" in k
+        }
+        if len(placements) == 2:
+            break
+        time.sleep(0.5)
+    assert len(placements) == 2, placements
+    assert len(set(placements.values())) == 1, f"TPU replicas not packed: {placements}"
+
+
+def test_upgrade_drains_node_by_node(two_node_cluster):
+    @serve.deployment(num_replicas=4)
+    class V:
+        def __init__(self, version):
+            self.version = version
+
+        def __call__(self, _):
+            return self.version
+
+    h1 = serve.run(V.bind(1), name="up_app")
+    assert h1.remote(None).result(timeout=30) == 1
+    h2 = serve.run(V.bind(2), name="up_app")
+    assert h2.remote(None).result(timeout=30) == 2
+
+    ctrl = ray_tpu.get_actor("SERVE_CONTROLLER")
+    order = ray_tpu.get(ctrl.last_drain_order.remote())
+    # old replicas drained in node groups: with a 2+2 spread the order
+    # has 2 groups of 2, and no replica appears in two groups
+    drained = [n for grp in order for n in grp]
+    assert len(drained) == 4 and len(set(drained)) == 4, order
+    assert len(order) == 2 and all(len(g) == 2 for g in order), order

@@ -202,7 +202,16 @@ def test_rpdb_remote_breakpoint(ray_start_regular):
     bp = bps[0]
     assert "test_util" in bp["where"] or "buggy" in bp["where"] or True
 
+    # a connection presenting the wrong token is refused before any pdb I/O
+    bad = socket.create_connection((bp["host"], bp["port"]), timeout=10)
+    bad.sendall(b"wrong-token\n")
+    bad.settimeout(10)
+    refusal = bad.recv(4096)
+    assert b"bad token" in refusal, refusal
+    bad.close()
+
     sock = socket.create_connection((bp["host"], bp["port"]), timeout=10)
+    sock.sendall((bp["token"] + "\n").encode())
     f = sock.makefile("r", encoding="utf-8")
 
     def read_until_prompt():
