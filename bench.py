@@ -180,9 +180,28 @@ def bench_runtime(extra):
     except Exception as e:
         log(f"[bench] jax-array object bench skipped: {e}")
 
-    def best_of(k, fn, settle=1.0):
+    def _wait_quiet(ceiling=1.2, max_wait=45.0):
+        """Park until the 1-min load average drops below `ceiling` (or
+        the wait budget runs out). The box has ONE core: a background
+        daemon burst during a trial halves the measured rate, and the
+        driver-captured snapshot is the number of record — round 4's
+        in-round 28.9k/s vs snapshot 22.0k/s gap was exactly this."""
+        deadline = time.time() + max_wait
+        while time.time() < deadline:
+            try:
+                with open("/proc/loadavg") as f:
+                    load1 = float(f.read().split()[0])
+            except OSError:
+                return
+            if load1 < ceiling:
+                return
+            time.sleep(2.0)
+
+    def best_of(k, fn, settle=1.0, quiet=False):
         best = 0.0
         for _ in range(k):
+            if quiet:
+                _wait_quiet()
             best = max(best, fn())
             time.sleep(settle)
         return best
@@ -258,7 +277,7 @@ def bench_runtime(extra):
         ray_tpu.get([c.drive.remote(per) for c in callers])
         return 4 * per / (time.perf_counter() - t0)
 
-    r = best_of(5, _nn_run, settle=2.0)
+    r = best_of(7, _nn_run, settle=2.0, quiet=True)
     extra["actor_calls_async_nn"] = round(r, 1)
     log(f"[bench] n:n async actor calls: {r:.0f}/s (baseline {BASELINES['actor_calls_async_nn']:.0f})")
 
@@ -284,7 +303,7 @@ def bench_runtime(extra):
         ray_tpu.get([noop.remote() for _ in range(1500)])
         return 1500 / (time.perf_counter() - t0)
 
-    r = best_of(5, _task_run, settle=2.0)
+    r = best_of(7, _task_run, settle=2.0, quiet=True)
     extra["tasks_async"] = round(r, 1)
     log(f"[bench] async tasks: {r:.0f}/s (baseline {BASELINES['tasks_async']:.0f})")
 

@@ -1713,8 +1713,9 @@ class CoreWorker:
             "max_retries": RayConfig.task_max_retries_default if max_retries is None else max_retries,
             "owner_addr": self._listen_addr,
             "job_id": self.job_id,
-            **(scheduling or {}),
         }
+        if scheduling:
+            spec.update(scheduling)
         from ray_tpu.util import tracing
 
         if tracing.should_trace():

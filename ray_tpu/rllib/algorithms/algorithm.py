@@ -165,7 +165,11 @@ class AlgorithmConfig:
                 try:
                     return DiscreteConvModule(obs_space, action_space, self.model_config)
                 except ValueError:
-                    pass
+                    if "filters" in (self.model_config or {}):
+                        # the user explicitly asked for this conv stack —
+                        # silently degrading to a pixel-flattening MLP
+                        # would bury the config error
+                        raise
             module_class = DiscreteMLPModule
         return module_class(obs_space, action_space, self.model_config)
 

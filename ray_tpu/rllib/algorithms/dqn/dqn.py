@@ -60,9 +60,15 @@ class DQN(Algorithm):
         from ray_tpu.rllib.env.off_policy_env_runner import OffPolicyEnvRunner
 
         if getattr(config, "n_step", 1) > 1 and config.env_runner_cls is OffPolicyEnvRunner:
-            # lazy import: apex_dqn imports this module
+            # lazy import: apex_dqn imports this module. Swap the runner
+            # on a shallow COPY — mutating the caller's config would make
+            # a later rebuild (with n_step set back to 1) silently keep
+            # the n-step runner.
+            import copy as _copy
+
             from ray_tpu.rllib.algorithms.apex_dqn.apex_dqn import ApexEnvRunner
 
+            config = _copy.copy(config)
             config.env_runner_cls = ApexEnvRunner
         super().__init__(config)
         from ray_tpu.rllib.utils.replay_buffers import (

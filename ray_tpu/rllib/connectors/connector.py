@@ -22,10 +22,29 @@ class Connector:
 
 
 class _FnConnector(Connector):
+    """Wraps a bare callable. The pipeline's ctx surface can grow
+    (obs_space, reset_lanes, ...) — a user lambda with an explicit
+    keyword signature must keep working, so ctx is filtered down to the
+    kwargs the callable actually declares unless it takes **kwargs."""
+
     def __init__(self, fn: Callable):
         self._fn = fn
+        try:
+            import inspect
+
+            params = inspect.signature(fn).parameters.values()
+            self._pass_all = any(p.kind == p.VAR_KEYWORD for p in params)
+            self._accepts = frozenset(
+                p.name for p in params
+                if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+            )
+        except (TypeError, ValueError):  # builtins without signatures
+            self._pass_all = True
+            self._accepts = frozenset()
 
     def __call__(self, data: Any, **ctx) -> Any:
+        if not self._pass_all:
+            ctx = {k: v for k, v in ctx.items() if k in self._accepts}
         return self._fn(data, **ctx)
 
     def __repr__(self):

@@ -39,22 +39,12 @@ class SingleAgentEnvRunner(EnvRunner):
         build_conn = getattr(config, "build_connector", None)
         self._env_conn = build_conn("env_to_module") if build_conn else None
         self._act_conn = build_conn("module_to_env") if build_conn else None
-        module_obs_space = self.env.single_observation_space
-        if self._env_conn is not None:
-            import gymnasium as gym
+        # shape probe with state snapshot/restore — one implementation,
+        # shared with EnvRunnerGroup.spaces() so runner and learner can
+        # never disagree about the module's obs space
+        from ray_tpu.rllib.utils.env import module_obs_space_for
 
-            # shape probe only: snapshot/restore stateful connector state
-            # (a running normalizer must never count this synthetic frame)
-            saved = [
-                (c, c.get_state()) for c in self._env_conn.connectors
-                if hasattr(c, "get_state")
-            ]
-            probe = self._transform_obs(
-                np.zeros((1,) + self.env.single_observation_space.shape, np.float32)
-            )
-            for c, st in saved:
-                c.set_state(st)
-            module_obs_space = gym.spaces.Box(-np.inf, np.inf, probe.shape[1:], np.float32)
+        module_obs_space = module_obs_space_for(config, self.env.single_observation_space)
         # what the MODULE consumes — EnvRunnerGroup.spaces() must hand
         # this (not the raw env space) to the learner, or a
         # shape-changing connector (FrameStack, one-hot) desyncs the

@@ -134,12 +134,18 @@ def set_trace(frame=None):
         # token handshake before any pdb I/O: first line must match.
         # Read byte-wise — a buffered makefile could read ahead past the
         # token line and swallow pdb commands sent in the same segment.
-        # Bounded by a timeout so a half-open connection (port scanner)
-        # can't wedge the accept loop and lock out the real attacher.
-        conn.settimeout(10.0)
+        # Bounded by a PER-CONNECTION deadline (not per-recv: a client
+        # trickling bytes would otherwise hold the loop ~256x the
+        # timeout) so a half-open connection can't wedge the accept loop
+        # and lock out the real attacher.
+        deadline = time.monotonic() + 10.0
         buf = b""
         try:
             while not buf.endswith(b"\n") and len(buf) < 256:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                conn.settimeout(remaining)
                 ch = conn.recv(1)
                 if not ch:
                     break
