@@ -527,11 +527,19 @@ def bench_tpu_train(extra):
                 return time.perf_counter() - t0
 
             dtm = (runm(8) - runm(2)) / 6
+            # quality bar: MFU over ACTIVE (dense-equivalent) FLOPs — a
+            # routed token computes one expert, so flops_per_token's
+            # active_only param count IS the dense equivalent for top-1;
+            # a throughput regression now moves a visible ratio
+            flm = flops_per_token(cfgm, Tm) * Bm * Tm
+            mfum = flm / dtm / 197e12
             extra["train_moe_ms_per_step"] = round(dtm * 1e3, 1)
             extra["train_moe_tok_per_s_chip"] = round(Bm * Tm / dtm, 0)
+            extra["train_moe_dense_equiv_mfu_pct"] = round(mfum * 100, 1)
             log(
                 f"[bench] llama-nano MoE (8 experts) train: {dtm * 1e3:.1f} ms/step, "
-                f"{Bm * Tm / dtm:,.0f} tok/s/chip"
+                f"{Bm * Tm / dtm:,.0f} tok/s/chip, "
+                f"{mfum * 100:.1f}% dense-equivalent MFU"
             )
             del statem, batchm
         except Exception as e:

@@ -55,9 +55,14 @@ class ScalingConfig:
 
 @dataclasses.dataclass
 class FailureConfig:
-    """reference: air/config.py FailureConfig."""
+    """reference: air/config.py FailureConfig. `elastic` goes BEYOND the
+    reference's restart-the-world semantics: elastic-aware train loops
+    (train.elastic_barrier) recover a single dead rank with the
+    survivors kept warm and state resumed from memory (train/elastic.py);
+    full restart happens only when the whole gang is lost."""
 
     max_failures: int = 0
+    elastic: bool = False
 
 
 @dataclasses.dataclass

@@ -18,7 +18,8 @@ from ray_tpu.air.checkpoint import Checkpoint
 
 class _Session:
     def __init__(self, rank: int, world_size: int, local_rank: int, result_queue, storage_dir: str,
-                 restore_checkpoint: Optional[str] = None):
+                 restore_checkpoint: Optional[str] = None, elastic_coord=None,
+                 elastic_resume=None, elastic_gen: int = 0):
         self.rank = rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -26,6 +27,15 @@ class _Session:
         self.storage_dir = storage_dir
         self.restore_checkpoint = restore_checkpoint
         self.iteration = 0
+        # elastic gang recovery (train/elastic.py): the coordinator
+        # handle, this worker's generation, its latest in-memory state
+        # stamp, and — for a replacement rank — the survivor state to
+        # adopt on the first barrier
+        self.elastic_coord = elastic_coord
+        self.elastic_gen = elastic_gen
+        self.elastic_state = None
+        self.elastic_step = 0
+        self.elastic_resume = elastic_resume
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
         ckpt_path = None

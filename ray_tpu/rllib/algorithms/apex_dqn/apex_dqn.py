@@ -142,9 +142,6 @@ class APEXDQNConfig(DQNConfig):
         self.num_replay_shards = 2
         self.n_step = 3
         self.prioritized_replay = True  # the replay shards are always PER
-        # future escape hatch for remote learners with runner-side
-        # priority refresh; declared so config.training() accepts it
-        self.distributed_per = False
         self.prioritized_replay_alpha = 0.6
         self.prioritized_replay_beta = 0.4
         # the n-step return already spans n transitions: the learner's
@@ -164,17 +161,9 @@ class APEXDQN(DQN):
     def __init__(self, config):
         if config.num_env_runners < 1:
             raise ValueError("APEX requires remote env runners (num_env_runners >= 1)")
-        if getattr(config, "num_learners", 0) and not getattr(
-            config, "distributed_per", False
-        ):
-            # mirrors DQN.__init__'s prioritized-replay validation, which
-            # Algorithm.__init__ below bypasses: without a local learner,
-            # get_td_errors() yields nothing and shard priorities would
-            # silently never refresh past the producer-computed estimates
-            raise ValueError(
-                "APEX-DQN priority refresh requires a local learner "
-                "(num_learners=0) unless distributed_per is enabled"
-            )
+        # remote learners are fine: LearnerGroup.get_td_errors gathers
+        # per-shard TD errors from lockstep workers, so shard priorities
+        # refresh under num_learners > 0 exactly like the local path
         # DQN.__init__ builds a LOCAL replay we don't use; skip straight
         # to Algorithm init then attach shards
         from ray_tpu.rllib.algorithms.algorithm import Algorithm

@@ -50,13 +50,11 @@ class DQN(Algorithm):
     config_class = DQNConfig
 
     def __init__(self, config):
-        if config.prioritized_replay and config.num_learners > 0:
-            # validate BEFORE super().__init__ spawns runner/learner actors
-            raise ValueError(
-                "prioritized_replay requires the local learner (num_learners=0): "
-                "remote lockstep learners do not return per-sample TD errors, so "
-                "priorities would silently never update"
-            )
+        # prioritized replay works with BOTH local and remote learners:
+        # LearnerGroup.get_td_errors gathers per-shard TD errors from the
+        # lockstep workers and reassembles them in batch order
+        # (reference: rllib runs PER under multi-learner setups too,
+        # core/learner/learner_group.py:71)
         from ray_tpu.rllib.env.off_policy_env_runner import OffPolicyEnvRunner
 
         if getattr(config, "n_step", 1) > 1 and config.env_runner_cls is OffPolicyEnvRunner:

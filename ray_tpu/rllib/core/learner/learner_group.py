@@ -19,29 +19,69 @@ import numpy as np
 
 
 class _LearnerActor:
-    """Actor shell around a Learner subclass (runs in a CPU worker)."""
+    """Actor shell around a Learner subclass (runs in a CPU worker).
+    Multi-agent configs get a MultiAgentLearner inside the same shell:
+    batches/plans/grads become {module_id: ...} dicts (reference:
+    learner_group.py:71 — remote learners carry MultiRLModules too)."""
 
     def __init__(self, learner_cls, config, obs_space, action_space):
-        self.learner = learner_cls(config, obs_space, action_space, mesh=config.build_learner_mesh())
+        if getattr(config, "policies", None):
+            self.learner = MultiAgentLearner(config, obs_space)
+        else:
+            self.learner = learner_cls(
+                config, obs_space, action_space, mesh=config.build_learner_mesh()
+            )
         self._batch = None
         self._plan = None
 
+    @property
+    def _multi(self) -> bool:
+        return isinstance(self.learner, MultiAgentLearner)
+
     def set_batch_and_plan(self, batch, num_steps: int):
         self._batch = batch
-        self._plan = self.learner.shuffled_minibatches(batch, num_steps)
+        if self._multi:
+            self._plan = {
+                mid: self.learner.learners[mid].shuffled_minibatches(b, num_steps)
+                for mid, b in batch.items()
+                if mid in self.learner.learners and b
+            }
+        else:
+            self._plan = self.learner.shuffled_minibatches(batch, num_steps)
         return True
 
     def grad_step(self, step: int):
+        if self._multi:
+            out = {}
+            for mid, plan in self._plan.items():
+                idx = plan[step]
+                minibatch = {k: v[idx] for k, v in self._batch[mid].items()}
+                out[mid] = self.learner.learners[mid].compute_grads(minibatch)
+            return out
         idx = self._plan[step]
         minibatch = {k: v[idx] for k, v in self._batch.items()}
         return self.learner.compute_grads(minibatch)
 
     def apply_grads(self, grads):
+        if self._multi:
+            for mid, g in grads.items():
+                self.learner.learners[mid].apply_grads(g)
+            return True
         self.learner.apply_grads(grads)
         return True
 
     def grads_on(self, batch):
-        return self.learner.compute_grads(batch)
+        """Returns ((grads, stats), td_errors) — td rides along so PER
+        priority refresh costs zero extra actor round-trips."""
+        if self._multi:
+            out = {
+                mid: self.learner.learners[mid].compute_grads(b)
+                for mid, b in batch.items()
+                if mid in self.learner.learners and b
+            }
+            return out, None
+        result = self.learner.compute_grads(batch)
+        return result, getattr(self.learner, "td_errors", None)
 
     def update(self, batch):
         return self.learner.update(batch)
@@ -61,6 +101,17 @@ class _LearnerActor:
         return True
 
 
+def _namespace_stats(per_module: Dict[str, Dict[str, float]]) -> Dict[str, Any]:
+    """Per-module stats under "modules", cross-module means flat (a
+    module id can then never collide with a stat key)."""
+    out: Dict[str, Any] = {"modules": per_module}
+    for k in {k for s in per_module.values() for k in s}:
+        vals = [s[k] for s in per_module.values() if k in s]
+        if vals:
+            out[k] = float(np.mean(vals))
+    return out
+
+
 class MultiAgentLearner:
     """Per-module learners updated from per-module batches (reference:
     the Learner's native MultiRLModule support — one loss/optimizer per
@@ -78,15 +129,7 @@ class MultiAgentLearner:
         for mid, b in batches.items():
             if mid in self.learners and b:
                 per_module[mid] = self.learners[mid].update(b)
-        # namespaced: per-module stats under "modules", cross-module means
-        # flat (a module id can then never collide with a stat key)
-        out: Dict[str, Any] = {"modules": per_module}
-        flat_keys = {k for s in per_module.values() for k in s}
-        for k in flat_keys:
-            vals = [s[k] for s in per_module.values() if k in s]
-            if vals:
-                out[k] = float(np.mean(vals))
-        return out
+        return _namespace_stats(per_module)
 
     def get_weights(self):
         return {mid: l.get_weights() for mid, l in self.learners.items()}
@@ -97,10 +140,13 @@ class MultiAgentLearner:
                 self.learners[mid].set_weights(w)
 
     def update_once(self, batches):
-        raise NotImplementedError(
-            "multi-agent training currently supports the on-policy update() "
-            "path only (off-policy update_once per-module is not implemented)"
-        )
+        """One TD/gradient step per module (off-policy multi-agent)."""
+        per_module = {
+            mid: self.learners[mid].update_once(b)
+            for mid, b in batches.items()
+            if mid in self.learners and b
+        }
+        return _namespace_stats(per_module)
 
     def get_state(self):
         return {mid: l.get_state() for mid, l in self.learners.items()}
@@ -118,13 +164,8 @@ class LearnerGroup:
         self._local = None
         self._workers: List[Any] = []
         learner_cls = config.learner_class
-        if getattr(config, "policies", None):
-            if self.num_learners > 0:
-                raise ValueError(
-                    "multi-agent training uses the local learner "
-                    "(num_learners=0); distributed multi-agent learners "
-                    "are not implemented yet"
-                )
+        self._multi = bool(getattr(config, "policies", None))
+        if self._multi and self.num_learners == 0:
             # obs_space/action_space arrive as {module_id: (obs, act)}
             self._local = MultiAgentLearner(config, obs_space)
         elif self.num_learners == 0:
@@ -142,10 +183,20 @@ class LearnerGroup:
             ]
 
     # -- update ---------------------------------------------------------------
-    def _shards(self, batch: Dict[str, np.ndarray]):
+    def _shards(self, batch):
         """Split `batch` row-wise across workers (remainder distributed,
         never an empty shard — empty shards would mean NaN losses averaged
-        into every worker's params). Workers with no rows are skipped."""
+        into every worker's params). Workers with no rows are skipped.
+        Multi-agent batches ({module_id: batch}) shard each module's rows
+        independently — the per-policy analogue of the dp split."""
+        if self._multi:
+            per_worker = [dict() for _ in self._workers]
+            for mid, b in batch.items():
+                n = len(b["actions"])
+                for shard, idx in zip(per_worker, np.array_split(np.arange(n), len(self._workers))):
+                    if len(idx):
+                        shard[mid] = {k: v[idx] for k, v in b.items()}
+            return [(w, s) for w, s in zip(self._workers, per_worker) if s]
         n = len(batch["actions"])
         splits = np.array_split(np.arange(n), len(self._workers))
         out = []
@@ -155,35 +206,57 @@ class LearnerGroup:
         return out
 
     def _average_and_apply(self, results) -> Dict[str, float]:
-        """Average (grads, stats) pytrees from workers, apply in lockstep."""
+        """Average (grads, stats) pytrees from workers, apply in lockstep.
+        Multi-agent results are {module_id: (grads, stats)} — averaged
+        per module across the workers that hold rows for it, applied on
+        every worker so module params never diverge."""
         import jax
         import ray_tpu
 
+        if self._multi:
+            mids = {m for r in results for m in r}
+            avg: Dict[str, Any] = {}
+            per_module_stats: Dict[str, Dict[str, float]] = {}
+            for mid in mids:
+                gs = [r[mid][0] for r in results if mid in r]
+                ss = [r[mid][1] for r in results if mid in r]
+                avg[mid] = jax.tree.map(lambda *g: np.mean(np.stack(g), axis=0), *gs)
+                per_module_stats[mid] = {
+                    k: float(np.mean([s[k] for s in ss])) for k in ss[0]
+                }
+            ray_tpu.get([w.apply_grads.remote(avg) for w in self._workers])
+            return _namespace_stats(per_module_stats)
         grads = [g for g, _ in results]
         stats = [s for _, s in results]
         avg = jax.tree.map(lambda *gs: np.mean(np.stack(gs), axis=0), *grads)
         ray_tpu.get([w.apply_grads.remote(avg) for w in self._workers])
         return {k: float(np.mean([s[k] for s in stats])) for k in stats[0]} if stats else {}
 
-    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+    def update(self, batch) -> Dict[str, float]:
         if self._local is not None:
             return self._local.update(batch)
         import ray_tpu
 
         shards = self._shards(batch)
-        shard_size = min(len(s["actions"]) for _, s in shards)
+        if self._multi:
+            sizes = [len(b["actions"]) for _, s in shards for b in s.values()]
+        else:
+            sizes = [len(s["actions"]) for _, s in shards]
+        shard_size = min(sizes)
         mb = min(self.config.minibatch_size, shard_size)
         num_steps = self.config.num_epochs * max(1, shard_size // mb)
         ray_tpu.get([w.set_batch_and_plan.remote(s, num_steps) for w, s in shards])
         all_stats = {}
         for step in range(num_steps):
             results = ray_tpu.get([w.grad_step.remote(step) for w, _ in shards])
-            step_stats = self._average_and_apply(results)
-            for k, v in step_stats.items():
+            for k, v in self._average_and_apply(results).items():
                 all_stats.setdefault(k, []).append(v)
-        return {k: float(np.mean(v)) for k, v in all_stats.items()}
+        return {
+            k: (v[-1] if k == "modules" else float(np.mean(v)))
+            for k, v in all_stats.items()
+        }
 
-    def update_once(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+    def update_once(self, batch) -> Dict[str, float]:
         """ONE lockstep gradient step on `batch` (off-policy algos call this
         once per replay sample, vs update()'s epochs of minibatch SGD)."""
         if self._local is not None:
@@ -191,14 +264,27 @@ class LearnerGroup:
         import ray_tpu
 
         shards = self._shards(batch)
-        results = ray_tpu.get([w.grads_on.remote(s) for w, s in shards])
+        replies = ray_tpu.get([w.grads_on.remote(s) for w, s in shards])
+        results = [r for r, _td in replies]
+        # td errors rode along with the grads; shards are contiguous row
+        # splits, so concatenation restores the original batch order
+        tds = [td for _r, td in replies]
+        self._last_td = (
+            np.concatenate([np.asarray(t) for t in tds])
+            if tds and not any(t is None for t in tds)
+            else None
+        )
         return self._average_and_apply(results)
 
     def get_td_errors(self):
-        """Per-sample TD errors from the last update (PER; local learner only)."""
+        """Per-sample TD errors from the last update_once, in the original
+        batch row order. With remote learners they rode along with the
+        grads_on replies (no extra RPC), so distributed DQN+PER refreshes
+        priorities exactly like the local path
+        (reference: learner_group.py:71 remote learners + PER)."""
         if self._local is not None:
             return getattr(self._local, "td_errors", None)
-        return None
+        return getattr(self, "_last_td", None)
 
     # -- weights / state --------------------------------------------------------
     def get_weights(self):

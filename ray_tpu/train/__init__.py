@@ -7,6 +7,7 @@ from ray_tpu.air.config import (  # noqa: F401
     ScalingConfig,
 )
 from ray_tpu.air.session import get_checkpoint, get_context, report  # noqa: F401
+from ray_tpu.train.elastic import elastic_barrier  # noqa: F401
 from ray_tpu.train.jax_trainer import DataParallelTrainer, JaxTrainer, Result  # noqa: F401
 from ray_tpu.train.step import (  # noqa: F401
     build_sharded_train_step,

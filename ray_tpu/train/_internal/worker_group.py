@@ -25,7 +25,8 @@ class TrainWorker:
         for k, v in (env or {}).items():
             os.environ[k] = v
 
-    def setup_session(self, result_queue, storage_dir: str, restore_checkpoint: Optional[str]):
+    def setup_session(self, result_queue, storage_dir: str, restore_checkpoint: Optional[str],
+                      elastic_coord=None, elastic_resume=None, elastic_gen: int = 0):
         from ray_tpu.air.session import _Session, _set_session
 
         self._session = _Session(
@@ -35,9 +36,18 @@ class TrainWorker:
             result_queue=result_queue,
             storage_dir=storage_dir,
             restore_checkpoint=restore_checkpoint,
+            elastic_coord=elastic_coord,
+            elastic_resume=elastic_resume,
+            elastic_gen=elastic_gen,
         )
         _set_session(self._session)
         return True
+
+    def get_elastic_state(self):
+        """(latest in-memory state stamp, its step) — served on a second
+        concurrency slot while the train loop is parked in the barrier."""
+        s = self._session
+        return s.elastic_state, s.elastic_step
 
     def run(self, fn: Callable, config: Optional[Dict[str, Any]] = None):
         from ray_tpu.air.session import _set_session
@@ -63,8 +73,12 @@ class WorkerGroup:
         resources_per_worker: Dict[str, float],
         placement_strategy: str = "PACK",
         env: Optional[Dict[str, str]] = None,
+        max_concurrency: int = 1,
     ):
         self.num_workers = num_workers
+        self._resources = dict(resources_per_worker)
+        self._env = env
+        self._max_concurrency = max_concurrency
         bundles = [dict(resources_per_worker) for _ in range(num_workers)]
         self.pg: PlacementGroup = placement_group(bundles, strategy=placement_strategy)
         if not self.pg.wait(120):
@@ -73,16 +87,28 @@ class WorkerGroup:
                 f"could not reserve {num_workers} x {resources_per_worker} "
                 f"(cluster resources: {ray_tpu.cluster_resources()})"
             )
-        self.workers = [
-            TrainWorker.options(
-                scheduling_strategy=PlacementGroupSchedulingStrategy(self.pg, placement_group_bundle_index=i),
-                num_cpus=resources_per_worker.get("CPU", 1),
-                num_tpus=resources_per_worker.get("TPU"),
-                max_restarts=0,
-            ).remote(i, num_workers, env)
-            for i in range(num_workers)
-        ]
+        self.workers = [self._spawn(i) for i in range(num_workers)]
         ray_tpu.get([w.ping.remote() for w in self.workers])
+
+    def _spawn(self, rank: int):
+        return TrainWorker.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(self.pg, placement_group_bundle_index=rank),
+            num_cpus=self._resources.get("CPU", 1),
+            num_tpus=self._resources.get("TPU"),
+            max_restarts=0,
+            max_concurrency=self._max_concurrency,
+        ).remote(rank, self.num_workers, self._env)
+
+    def replace_worker(self, rank: int):
+        """Elastic re-gang: a fresh actor on the dead rank's bundle; the
+        surviving workers are untouched (train/elastic.py)."""
+        try:
+            ray_tpu.kill(self.workers[rank])
+        except Exception:
+            pass
+        self.workers[rank] = self._spawn(rank)
+        ray_tpu.get(self.workers[rank].ping.remote(), timeout=120)
+        return self.workers[rank]
 
     def run_all(self, fn: Callable, config: Optional[Dict[str, Any]] = None) -> List[Any]:
         return [w.run.remote(fn, config) for w in self.workers]

@@ -94,6 +94,7 @@ class JaxTrainer:
     def _fit_once(self, run_dir: str, restore: Optional[str]) -> Result:
         sc = self.scaling_config
         cc: CheckpointConfig = self.run_config.checkpoint_config
+        elastic = getattr(self.run_config.failure_config, "elastic", False)
         results_q = Queue()
         env = {}
         if sc.use_tpu:
@@ -103,11 +104,19 @@ class JaxTrainer:
             resources_per_worker=sc.worker_resources(),
             placement_strategy=sc.placement_strategy,
             env=env,
+            # a second slot lets get_elastic_state answer while the
+            # train loop is parked inside the barrier call
+            max_concurrency=2 if elastic else 1,
         )
+        coord = None
+        if elastic:
+            from ray_tpu.train.elastic import ElasticCoordinator
+
+            coord = ElasticCoordinator.remote(sc.num_workers)
         try:
             ray_tpu.get(
                 [
-                    w.setup_session.remote(results_q, run_dir, restore)
+                    w.setup_session.remote(results_q, run_dir, restore, coord)
                     for w in group.workers
                 ]
             )
@@ -118,12 +127,34 @@ class JaxTrainer:
 
             last_metrics: Dict[str, Any] = {}
             last_ckpt: Optional[str] = None
-            pending = list(done_refs)
+            # rank per pending ref so elastic recovery can identify the
+            # dead rank from its failed run() ref
+            pending: Dict[Any, int] = {ref: i for i, ref in enumerate(done_refs)}
+            gen = 0
             while pending:
-                ready, pending = ray_tpu.wait(pending, num_returns=len(pending), timeout=0.25)
-                if ready:
-                    # surface worker exceptions
-                    ray_tpu.get(ready)
+                ready, _ = ray_tpu.wait(
+                    list(pending), num_returns=len(pending), timeout=0.25
+                )
+                for ref in ready:
+                    # a prior regang's death probe may have removed this
+                    # ref already (two ranks dying in one wait round)
+                    rank = pending.pop(ref, None)
+                    if rank is None:
+                        continue
+                    try:
+                        ray_tpu.get(ref)  # surface worker exceptions
+                    except (ray_tpu.exceptions.ActorError,
+                            ray_tpu.exceptions.WorkerCrashedError):
+                        # actor/process DEATH — the elastic case. An
+                        # application exception from the user loop is NOT:
+                        # respawning would just re-raise it forever, so it
+                        # propagates like the non-elastic path.
+                        if not elastic:
+                            raise
+                        gen = self._elastic_regang(
+                            group, coord, results_q, run_dir, restore, rank,
+                            pending, config, gen,
+                        )
                 while True:
                     try:
                         item = results_q.get(block=False)
@@ -153,6 +184,62 @@ class JaxTrainer:
             except Exception:
                 pass
             group.shutdown()
+            if coord is not None:
+                try:
+                    ray_tpu.kill(coord)
+                except Exception:
+                    pass
+
+    def _elastic_regang(self, group, coord, results_q, run_dir, restore, dead_rank,
+                        pending, config, gen) -> int:
+        """Replace ONE dead rank with the survivors kept warm
+        (train/elastic.py; SURVEY §7 hard-part #6 — the bar is better
+        than the reference's restart-the-world)."""
+        # probe the rest of the gang: more ranks may have died with it
+        dead = {dead_rank}
+        for ref, rank in list(pending.items()):
+            try:
+                ray_tpu.get(group.workers[rank].ping.remote(), timeout=10)
+            except Exception:
+                dead.add(rank)
+                pending.pop(ref)
+        if len(dead) >= group.num_workers:
+            raise RuntimeError("entire gang lost — falling back to full restart")
+        # resume point = MAX stamp across survivors (a survivor mid-step
+        # at death time trails by one and catches up through the
+        # coordinator's catch-up lane); state comes from the max-stamp
+        # owner so the replacement joins exactly at the resume point
+        survivors = [i for i in range(group.num_workers) if i not in dead]
+        stamps = ray_tpu.get(
+            [group.workers[i].get_elastic_state.remote() for i in survivors],
+            timeout=60,
+        )
+        best = max(range(len(survivors)), key=lambda j: stamps[j][1])
+        survivor = survivors[best]
+        state, step = stamps[best]
+        if state is None:
+            # the loop never handed state to elastic_barrier: there is no
+            # in-memory checkpoint to resume the replacement from — fall
+            # back to the full-restart path (disk checkpoint)
+            raise RuntimeError(
+                "elastic recovery needs the train loop to pass state= to "
+                "train.elastic_barrier(); falling back to full restart"
+            )
+        logger.warning(
+            "elastic re-gang: rank(s) %s died at step ~%d; survivors stay warm, "
+            "resuming from rank %d's in-memory state", sorted(dead), step, survivor,
+        )
+        gen = ray_tpu.get(coord.regang.remote(step))
+        for r in sorted(dead):
+            w = group.replace_worker(r)
+            ray_tpu.get(
+                w.setup_session.remote(
+                    results_q, run_dir, restore, coord,
+                    (state, step), gen,
+                )
+            )
+            pending[w.run.remote(self._train_loop, config)] = r
+        return gen
 
     @classmethod
     def restore(cls, path: str, train_loop_per_worker: Callable, **kwargs) -> "JaxTrainer":

@@ -1,0 +1,127 @@
+"""Distributed learner coverage the reference has and round-4 guarded
+out (reference: rllib/core/learner/learner_group.py:71 — remote learners
+with MultiRLModules and with prioritized replay):
+- multi-agent PPO across 2 remote lockstep learners, per-policy gradient
+  averaging, weight equality across workers
+- distributed DQN + prioritized replay: per-shard TD errors gathered in
+  batch order so priorities refresh exactly like the local path."""
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    ray_tpu.init(num_cpus=4, object_store_memory=150 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_multi_agent_two_learner_lockstep_weight_equality(ray_start_regular):
+    """2 remote learners, 2 policies: after updates both learner actors
+    hold BIT-IDENTICAL per-policy params (lockstep per-module averaging),
+    and learning still happens."""
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    from ray_tpu.rllib.env.multi_agent_env import TwoAgentTarget
+
+    config = (
+        PPOConfig()
+        .environment(lambda cfg=None: TwoAgentTarget())
+        .multi_agent(
+            policies=["p0", "p1"],
+            policy_mapping_fn=lambda agent_id: {"a0": "p0", "a1": "p1"}[agent_id],
+        )
+        .env_runners(num_env_runners=0, rollout_fragment_length=128)
+        .training(train_batch_size=256, minibatch_size=64, num_epochs=2, lr=3e-3)
+        .learners(num_learners=2)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    for _ in range(3):
+        result = algo.train()
+    assert "modules" in result["learner"]
+    assert set(result["learner"]["modules"]) == {"p0", "p1"}
+
+    group = algo.learner_group
+    assert len(group._workers) == 2
+    w0, w1 = ray_tpu.get([w.get_weights.remote() for w in group._workers])
+    assert set(w0) == {"p0", "p1"}
+    for mid in ("p0", "p1"):
+        import jax
+
+        for a, b in zip(jax.tree.leaves(w0[mid]), jax.tree.leaves(w1[mid])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    algo.stop()
+
+
+def test_multi_agent_two_learner_ppo_learns(ray_start_regular):
+    """The distributed multi-agent path actually LEARNS the cooperative
+    target task (same bar as the local-learner test)."""
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    from ray_tpu.rllib.env.multi_agent_env import TwoAgentTarget
+
+    config = (
+        PPOConfig()
+        .environment(lambda cfg=None: TwoAgentTarget())
+        .multi_agent(
+            policies=["p0", "p1"],
+            policy_mapping_fn=lambda agent_id: {"a0": "p0", "a1": "p1"}[agent_id],
+        )
+        .env_runners(num_env_runners=0, rollout_fragment_length=256)
+        .training(train_batch_size=512, minibatch_size=128, num_epochs=4, lr=3e-3)
+        .learners(num_learners=2)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    best = -1e9
+    for i in range(12):
+        result = algo.train()
+        best = max(best, result["episode_return_mean"])
+        if best > 5.0:
+            break
+    algo.stop()
+    assert best > 5.0, f"distributed multi-agent PPO failed to learn: best={best}"
+
+
+def test_distributed_dqn_per_learns_and_refreshes_priorities(ray_start_regular):
+    """DQN with num_learners=2 AND prioritized replay: priorities must
+    refresh from gathered TD errors (not stay at the add-time values)
+    and CartPole must still be solved to 150."""
+    from ray_tpu.rllib import DQNConfig
+
+    config = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .training(
+            lr=1e-3,
+            train_batch_size=64,
+            num_steps_sampled_before_learning_starts=500,
+            target_network_update_freq=200,
+            training_intensity=2.0,
+        )
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                     rollout_fragment_length=32)
+        .learners(num_learners=2)
+        .debugging(seed=0)
+    )
+    config.epsilon_timesteps = 5000
+    config.prioritized_replay = True
+    algo = config.build()
+
+    best = -np.inf
+    refreshed = False
+    for i in range(400):
+        result = algo.train()
+        r = result.get("episode_return_mean")
+        if r is not None and r == r:
+            best = max(best, r)
+        if not refreshed and len(algo.replay) >= 500:
+            td = algo.learner_group.get_td_errors()
+            if td is not None and len(td) == 64:
+                refreshed = True
+        if best >= 150 and refreshed:
+            break
+    algo.stop()
+    assert refreshed, "remote-learner TD errors never reached the driver"
+    assert best >= 150, f"distributed DQN+PER failed CartPole (best {best})"
