@@ -583,6 +583,73 @@ def bench_tpu_train(extra):
             )
         except Exception as e:
             log(f"[bench] decode bench skipped: {e}")
+
+        # continuous batching vs static batching at MIXED lengths: the
+        # engine admits/evicts per chunk, so short requests stop
+        # occupying lanes the moment they finish; static batching
+        # decodes every sequence to the longest request (SURVEY §7 step
+        # 10 — the reference delegates this to vLLM, green-field here)
+        try:
+            from ray_tpu.models import llama_decode as D
+            from ray_tpu.serve.llm_engine import ContinuousBatchingEngine
+
+            params = state["params"]
+            rngp = np.random.default_rng(0)
+            # skewed generation lengths — the regime continuous batching
+            # exists for (most requests short, a minority long; static
+            # batching decodes every group member to its group max)
+            reqs = [
+                (list(rngp.integers(1, cfg.vocab_size, size=int(plen))), int(gl))
+                for plen, gl in zip(
+                    rngp.choice([64, 128, 256], size=24),
+                    rngp.choice([16, 384], size=24, p=[0.7, 0.3]),
+                )
+            ]
+            total_tokens = sum(g for _, g in reqs)
+
+            # static: group by prompt length, decode EVERY group member
+            # to the group's LONGEST generation (what static batching
+            # does). Two passes — the second is the warm (compile-free)
+            # number of record.
+            groups = {}
+            for p, g in reqs:
+                groups.setdefault(len(p), []).append((p, g))
+
+            def _static_pass():
+                t0 = time.perf_counter()
+                for plen, members in groups.items():
+                    arr = np.asarray([p for p, _ in members], np.int32)
+                    D.generate(params, arr, cfg, max_new_tokens=max(g for _, g in members))
+                return time.perf_counter() - t0
+
+            _static_pass()
+            dt_static = _static_pass()
+
+            engine = ContinuousBatchingEngine(cfg=cfg, params=params, n_slots=8,
+                                              chunk=64, max_len=768)
+            try:
+                def _cont_pass():
+                    t0 = time.perf_counter()
+                    handles = [engine.submit(p, g) for p, g in reqs]
+                    for h in handles:
+                        if not h.done.wait(300):
+                            raise TimeoutError("continuous engine stalled")
+                    return time.perf_counter() - t0
+
+                _cont_pass()
+                dt_cont = _cont_pass()
+            finally:
+                engine.shutdown()
+            extra["llm_static_mixed_tok_per_s"] = round(total_tokens / dt_static, 0)
+            extra["llm_continuous_mixed_tok_per_s"] = round(total_tokens / dt_cont, 0)
+            extra["llm_continuous_vs_static"] = round(dt_static / dt_cont, 2)
+            log(
+                f"[bench] mixed-length LLM serving: static {total_tokens / dt_static:,.0f} "
+                f"tok/s, continuous {total_tokens / dt_cont:,.0f} tok/s "
+                f"({dt_static / dt_cont:.2f}x)"
+            )
+        except Exception as e:
+            log(f"[bench] continuous batching bench skipped: {e}")
         return mfu
     except Exception as e:
         import traceback

@@ -157,6 +157,199 @@ def decode_loop(params, cache, first_token, n_steps: int, cfg: LlamaConfig):
     return jnp.moveaxis(tokens, 0, 1), cache
 
 
+# ---------------------------------------------------------------------------
+# Per-slot decode: the continuous-batching substrate (serve/llm_engine.py).
+# The reference delegates continuous batching to vLLM inside replicas; on
+# TPU the engine is this jitted program — SURVEY §7 step 10 green-field.
+# Design: a fixed pool of B cache SLOTS, each an independent sequence at
+# its own position (`pos` is (B,), not a scalar); decode runs in CHUNKS
+# of C tokens as one device-side lax.scan (a python step loop pays a
+# relay dispatch per token), and the host admits/evicts sequences at
+# chunk boundaries. Finished slots stop advancing via the `remaining`
+# mask; their compute is wasted lanes, which is exactly the waste
+# continuous batching bounds (<= C-1 tokens per sequence).
+# ---------------------------------------------------------------------------
+
+
+def init_slot_cache(cfg: LlamaConfig, n_slots: int, max_len: int) -> Dict[str, Any]:
+    shape = (cfg.n_layers, n_slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((n_slots,), jnp.int32),
+        "remaining": jnp.zeros((n_slots,), jnp.int32),
+    }
+
+
+def _gqa_attend_slots(q, k_cache, v_cache, pos, cfg: LlamaConfig):
+    """Per-slot positions: q (B, 1, h, hd), pos (B,) — slot b attends
+    its own [0, pos_b] prefix."""
+    B, _, h, hd = q.shape
+    S = k_cache.shape[1]
+    qg = q.reshape(B, cfg.n_kv_heads, h // cfg.n_kv_heads, hd)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", qg, k_cache, preferred_element_type=jnp.float32
+    ) * (hd**-0.5)
+    mask = jnp.arange(S)[None, None, None, :] <= pos[:, None, None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgs,bskd->bkgd", probs.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(B, 1, h * hd).astype(cfg.dtype)
+
+
+def decode_step_slots(params, cache, tokens, cfg: LlamaConfig):
+    """One token on every slot at its own position. Slots with
+    remaining == 0 emit garbage (discarded by the engine) and do not
+    advance — their cache cells get overwritten on the next admit."""
+    B = tokens.shape[0]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    pos = cache["pos"]                                  # (B,)
+    active = cache["remaining"] > 0
+    x = params["embed"][tokens][:, None, :].astype(cfg.dtype)
+    cos, sin = rope_frequencies(hd, cache["k"].shape[2], cfg.rope_theta)
+    positions = pos[:, None]
+
+    def body(carry, layer_and_idx):
+        x, k_full, v_full = carry
+        layer, li = layer_and_idx
+        a = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = (a @ layer["wq"]).reshape(B, 1, h, hd)
+        k = (a @ layer["wk"]).reshape(B, 1, kvh, hd)
+        v = (a @ layer["wv"]).reshape(B, 1, kvh, hd)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        # per-slot write at each slot's own pos_b: a fori_loop of tiny
+        # dynamic_update_slices, NOT .at[li, slot_ids, pos].set — that
+        # advanced-index form lowers to an XLA scatter that measured
+        # ~25 ms/step (15x the whole step's compute) on TPU
+        def write_slot(b, kv):
+            kf, vf = kv
+            kb = jax.lax.dynamic_slice_in_dim(k, b, 1, axis=0)[None]
+            vb = jax.lax.dynamic_slice_in_dim(v, b, 1, axis=0)[None]
+            pb = jax.lax.dynamic_index_in_dim(pos, b, keepdims=False)
+            kf = jax.lax.dynamic_update_slice(kf, kb, (li, b, pb, 0, 0))
+            vf = jax.lax.dynamic_update_slice(vf, vb, (li, b, pb, 0, 0))
+            return kf, vf
+
+        k_full, v_full = jax.lax.fori_loop(0, B, write_slot, (k_full, v_full))
+        k_cache = jax.lax.dynamic_index_in_dim(k_full, li, 0, keepdims=False)
+        v_cache = jax.lax.dynamic_index_in_dim(v_full, li, 0, keepdims=False)
+        o = _gqa_attend_slots(q, k_cache, v_cache, pos, cfg) @ layer["wo"]
+        x = x + o
+        m = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu((m @ layer["w_gate"]).astype(jnp.float32)).astype(cfg.dtype)
+        x = x + (gate * (m @ layer["w_up"])) @ layer["w_down"]
+        return (x, k_full, v_full), None
+
+    (x, new_k, new_v), _ = jax.lax.scan(
+        body,
+        (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)),
+        unroll=True,
+    )
+    x = rms_norm(x[:, 0, :], params["final_norm"], cfg.rms_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    new_cache = {
+        "k": new_k,
+        "v": new_v,
+        "pos": pos + active.astype(jnp.int32),
+        "remaining": jnp.maximum(cache["remaining"] - 1, 0),
+    }
+    return logits, new_cache
+
+
+def decode_chunk_slots(params, cache, tokens, chunk: int, cfg: LlamaConfig):
+    """Greedy-decode `chunk` tokens on every slot as ONE device-side
+    scan. Returns (tokens (B, chunk), cache) — the engine discards the
+    tail of slots that finished mid-chunk."""
+
+    def body(carry, _):
+        cache, token = carry
+        logits, cache = decode_step_slots(params, cache, token, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, nxt), nxt
+
+    (cache, _), toks = jax.lax.scan(body, (cache, tokens), None, length=chunk)
+    return jnp.moveaxis(toks, 0, 1), cache
+
+
+def prefill_into_slots(params, prompts, lengths, slots, cache, cfg: LlamaConfig):
+    """BATCHED admission prefill: N right-padded prompts (N, Tb) with
+    true `lengths` (N,) land in cache slots `slots` (N,) in ONE program
+    — over a relay-attached TPU each dispatch costs ~100x its compute,
+    so admission must not pay one prefill per sequence. Right-padding is
+    safe: causal attention keeps pad positions out of real positions'
+    context, and every decode step WRITES its kv at `pos` before
+    attending, so a pad cell is overwritten before it ever becomes
+    visible. Returns (first tokens (N,), cache)."""
+    N, Tb = prompts.shape
+    small = init_cache(cfg, N, Tb)
+    logits_all, filled = _prefill_all_positions(params, prompts, small, cfg)
+    # per-sequence next token comes from each TRUE last position
+    last = jnp.take_along_axis(
+        logits_all, (lengths - 1)[:, None, None], axis=1
+    )[:, 0, :]
+    first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    # ks: (L, N, Tb, kvh, hd) -> big cache rows at the target slots.
+    # Sequential dynamic_update_slice per member, NOT an advanced-index
+    # .at[...].set — the latter lowers to an XLA scatter that measured
+    # ~200ms per call on TPU (it dominated the whole engine); N slice
+    # writes inside one program are plain fast DMAs.
+    ks, vs = filled["k"], filled["v"]
+
+    def write_one(n, kv):
+        k_big, v_big = kv
+        k_big = jax.lax.dynamic_update_slice(
+            k_big, jax.lax.dynamic_slice_in_dim(ks, n, 1, axis=1),
+            (0, slots[n], 0, 0, 0),
+        )
+        v_big = jax.lax.dynamic_update_slice(
+            v_big, jax.lax.dynamic_slice_in_dim(vs, n, 1, axis=1),
+            (0, slots[n], 0, 0, 0),
+        )
+        return k_big, v_big
+
+    new_k, new_v = jax.lax.fori_loop(0, N, write_one, (cache["k"], cache["v"]))
+    pos = cache["pos"].at[slots].set(lengths)
+    return first, {"k": new_k, "v": new_v, "pos": pos, "remaining": cache["remaining"]}
+
+
+def _prefill_all_positions(params, tokens, cache, cfg: LlamaConfig):
+    """prefill() variant returning logits for EVERY position (the
+    batched-admission path needs per-sequence true-last-position
+    logits, not x[:, -1])."""
+    B, T = tokens.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embed"][tokens].astype(cfg.dtype)
+    cos, sin = rope_frequencies(hd, cache["k"].shape[2], cfg.rope_theta)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+
+    from ray_tpu.ops.blockwise_attention import blockwise_attention
+
+    def body(x, layer):
+        a = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = (a @ layer["wq"]).reshape(B, T, h, hd)
+        k = (a @ layer["wk"]).reshape(B, T, kvh, hd)
+        v = (a @ layer["wv"]).reshape(B, T, kvh, hd)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        o = blockwise_attention(q, k, v, True, min(512, T)).reshape(B, T, h * hd)
+        x = x + o @ layer["wo"]
+        m = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu((m @ layer["w_gate"]).astype(jnp.float32)).astype(cfg.dtype)
+        x = x + (gate * (m @ layer["w_up"])) @ layer["w_down"]
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return logits, {"k": ks, "v": vs}
+
+
 @functools.lru_cache(maxsize=64)
 def _jitted_prefill(cfg: LlamaConfig):
     return jax.jit(functools.partial(prefill, cfg=cfg))

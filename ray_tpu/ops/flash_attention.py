@@ -332,11 +332,16 @@ def flash_attention(
 
 
 def _fit_block(seq: int, block: int) -> int:
-    """Largest power-of-two block <= `block` that divides `seq` (>=128),
-    or 0 if none — raising the defaults must not silently push shapes
-    the old defaults handled (e.g. seq 3072 with the 512 block) off the
+    """Block size the kernel should use for this sequence: the whole
+    sequence when it fits one block (seq <= block — short sequences
+    always dispatched this way), else the largest power-of-two block
+    <= `block` that divides `seq` (>=128). 0 = unsupported. Raising the
+    defaults must not silently push shapes the old defaults handled
+    (seq 3072 with the 512 block; seq 64 as a single block) off the
     kernel onto the XLA fallback."""
-    b = min(block, seq)
+    if seq <= block:
+        return seq
+    b = block
     while b >= 128 and seq % b:
         b //= 2
     return b if b >= 128 and seq % b == 0 else 0

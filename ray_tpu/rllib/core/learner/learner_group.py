@@ -140,13 +140,11 @@ class MultiAgentLearner:
                 self.learners[mid].set_weights(w)
 
     def update_once(self, batches):
-        """One TD/gradient step per module (off-policy multi-agent)."""
-        per_module = {
-            mid: self.learners[mid].update_once(b)
-            for mid, b in batches.items()
-            if mid in self.learners and b
-        }
-        return _namespace_stats(per_module)
+        raise NotImplementedError(
+            "multi-agent training supports the on-policy update() path "
+            "only: every off-policy caller samples FLAT replay batches, "
+            "which cannot be routed to per-module learners"
+        )
 
     def get_state(self):
         return {mid: l.get_state() for mid, l in self.learners.items()}
@@ -261,6 +259,11 @@ class LearnerGroup:
         once per replay sample, vs update()'s epochs of minibatch SGD)."""
         if self._local is not None:
             return self._local.update_once(batch)
+        if self._multi:
+            raise NotImplementedError(
+                "multi-agent training supports the on-policy update() path "
+                "only (off-policy replay batches are flat, not per-module)"
+            )
         import ray_tpu
 
         shards = self._shards(batch)

@@ -24,7 +24,8 @@ class _LLMServer:
     `llm_deployment(...)` or subclass for custom param loading."""
 
     def __init__(self, cfg=None, params=None, max_new_tokens: int = 32,
-                 checkpoint_dir: Optional[str] = None, seed: int = 0):
+                 checkpoint_dir: Optional[str] = None, seed: int = 0,
+                 continuous: bool = False, n_slots: int = 8, chunk: int = 8):
         import jax
 
         from ray_tpu.models import llama
@@ -39,6 +40,15 @@ class _LLMServer:
         else:
             self.params = llama.init_params(jax.random.PRNGKey(seed), self.cfg)
         self.max_new_tokens = max_new_tokens
+        self.engine = None
+        if continuous:
+            # continuous batching: requests admit/evict per decode chunk
+            # instead of coalescing into static batches
+            from ray_tpu.serve.llm_engine import ContinuousBatchingEngine
+
+            self.engine = ContinuousBatchingEngine(
+                self.params, self.cfg, n_slots=n_slots, chunk=chunk
+            )
 
     @batch(max_batch_size=32, batch_wait_timeout_s=0.02)
     def _generate(self, prompts: List[List[int]]) -> List[List[int]]:
@@ -60,11 +70,16 @@ class _LLMServer:
         return out
 
     def __call__(self, prompt: List[int]) -> List[int]:
+        if self.engine is not None:
+            return self.engine.generate(
+                [int(t) for t in prompt], self.max_new_tokens
+            )
         return self._generate([int(t) for t in prompt])
 
 
 def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
-                   cfg=None, checkpoint_dir: Optional[str] = None, **deploy_kw):
+                   cfg=None, checkpoint_dir: Optional[str] = None,
+                   continuous: bool = False, **deploy_kw):
     """A ready-to-run LLM generation application:
 
         app = llm_deployment(num_replicas=2, max_new_tokens=16)
@@ -74,4 +89,5 @@ def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
     dep = deployment(
         _LLMServer, name="LLMServer", num_replicas=num_replicas, **deploy_kw
     )
-    return dep.bind(cfg=cfg, max_new_tokens=max_new_tokens, checkpoint_dir=checkpoint_dir)
+    return dep.bind(cfg=cfg, max_new_tokens=max_new_tokens,
+                    checkpoint_dir=checkpoint_dir, continuous=continuous)

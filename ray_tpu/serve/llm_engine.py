@@ -1,0 +1,220 @@
+"""Continuous-batching LLM engine.
+
+The reference's Serve LLM stack delegates the decode loop to vLLM
+inside replicas (continuous batching + paged KV); there is no TPU
+engine to wrap, so this is the green-field TPU-native equivalent
+(SURVEY §7 step 10). Design:
+
+- A fixed pool of KV-cache SLOTS (models/llama_decode.py per-slot
+  machinery): each slot is an independent sequence at its own position.
+- Decode runs in CHUNKS of C tokens as one jitted device-side lax.scan
+  over ALL slots — static shapes, finished slots freeze via the
+  remaining-mask (waste bounded at C-1 lanes per sequence).
+- ASYNC PIPELINE: with greedy decode to a requested length, scheduling
+  never depends on token VALUES — admission and eviction are planned
+  from host-side counters alone. So the loop chains chunks
+  device-to-device (the next chunk feeds on toks[:, -1] without a
+  host fetch), dispatches admission prefills asynchronously, and
+  fetches each chunk's tokens ONE CHUNK BEHIND, overlapped with the
+  next chunk's compute. Over a relay-attached TPU (dispatch ~free,
+  sync ~expensive) this is the difference between losing and winning
+  against static batching at mixed lengths.
+- ADMISSION/EVICTION at chunk boundaries: freed slots take queued
+  requests immediately — short requests no longer wait for the longest
+  sequence in a static batch.
+
+Static batching (llama_decode.generate) remains the one-shot path.
+Honest positioning (bench.py's llm section measures both): per decode
+STEP the per-slot chunk is at parity with the static scan (~3 ms/step
+measured at B=8/S=512 on v5e), and the engine's lane-efficiency win
+grows with generation-length skew — but every chunk/prefill dispatch
+and fetch pays the host-link fixed cost, so on a RELAY-attached chip
+with a nano model the one-scan static path stays ahead; the engine's
+regime is direct-attached chips and models whose step time dwarfs the
+dispatch cost.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "_first_dev",
+                 "_remaining")
+
+    def __init__(self, prompt, max_new_tokens):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.tokens: List[int] = []
+        self.done = threading.Event()
+        self._first_dev = None   # device scalar: prefill's first token
+        self._remaining = 0      # host-side plan counter (decode steps owed)
+
+
+class ContinuousBatchingEngine:
+    def __init__(self, params, cfg, n_slots: int = 8, max_len: int = 0,
+                 chunk: int = 8):
+        import functools
+
+        import jax
+
+        from ray_tpu.models import llama_decode as D
+
+        self._jax = jax
+        self._D = D
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len or cfg.max_seq_len
+        self.chunk = chunk
+        self.cache = D.init_slot_cache(cfg, n_slots, self.max_len)
+        self._prefill_slots = jax.jit(functools.partial(D.prefill_into_slots, cfg=cfg))
+        self._chunk_fn = jax.jit(
+            functools.partial(D.decode_chunk_slots, chunk=chunk, cfg=cfg),
+            donate_argnums=(1,),
+        )
+        self._slots: List[Optional[_Request]] = [None] * n_slots
+        import jax.numpy as jnp
+
+        self._next_dev = jnp.zeros(n_slots, jnp.int32)  # device-side feed tokens
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._wake = threading.Event()
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- public
+    def submit(self, prompt: List[int], max_new_tokens: int) -> _Request:
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt+generation ({len(prompt)}+{max_new_tokens}) exceeds "
+                f"engine max_len {self.max_len}"
+            )
+        req = _Request([int(t) for t in prompt], max_new_tokens)
+        self._queue.put(req)
+        self._wake.set()
+        return req
+
+    def generate(self, prompt: List[int], max_new_tokens: int,
+                 timeout: float = 120.0) -> List[int]:
+        req = self.submit(prompt, max_new_tokens)
+        if not req.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        return req.tokens
+
+    def shutdown(self):
+        self._running = False
+        self._wake.set()
+        self._thread.join(timeout=10)
+
+    # ------------------------------------------------------------ engine
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 16
+        while b < n:
+            b *= 2
+        return b
+
+    def _admit(self) -> None:
+        """Move queued requests into free slots. Admissions are BATCHED:
+        requests bucket by power-of-two padded prompt length and each
+        bucket prefills in ONE dispatch (prefill_into_slots) — over a
+        relay-attached TPU a dispatch costs ~100x its compute, so
+        per-sequence prefills would dominate the whole engine."""
+        import jax.numpy as jnp
+
+        free = [i for i, r in enumerate(self._slots) if r is None]
+        batch: List[tuple] = []
+        while free and not self._queue.empty():
+            batch.append((free.pop(0), self._queue.get()))
+        if not batch:
+            return
+        buckets: Dict[int, List[tuple]] = {}
+        for slot, req in batch:
+            buckets.setdefault(self._bucket(len(req.prompt)), []).append((slot, req))
+        for tb, members in buckets.items():
+            prompts = np.zeros((len(members), tb), np.int32)
+            lengths = np.zeros(len(members), np.int32)
+            slots = np.zeros(len(members), np.int32)
+            for n, (slot, req) in enumerate(members):
+                prompts[n, : len(req.prompt)] = req.prompt
+                lengths[n] = len(req.prompt)
+                slots[n] = slot
+            firsts, self.cache = self._prefill_slots(
+                self.params, jnp.asarray(prompts), jnp.asarray(lengths),
+                jnp.asarray(slots), self.cache,
+            )
+            rem_updates = np.zeros(len(members), np.int32)
+            for n, (slot, req) in enumerate(members):
+                req._first_dev = firsts[n]
+                req._remaining = req.max_new_tokens - 1
+                rem_updates[n] = req._remaining
+                self._slots[slot] = req
+            self.cache["remaining"] = self.cache["remaining"].at[
+                jnp.asarray(slots)
+            ].set(jnp.asarray(rem_updates))
+            live = [n for n, (_s, r) in enumerate(members) if r._remaining > 0]
+            if live:
+                idx = jnp.asarray(slots[live])
+                self._next_dev = self._next_dev.at[idx].set(firsts[jnp.asarray(live)])
+
+    def _resolve(self, entry) -> None:
+        """Fetch one chunk's tokens (the only host sync, one chunk
+        behind the dispatch frontier) and deliver them to requests."""
+        toks_dev, takes = entry
+        toks = np.asarray(toks_dev) if toks_dev is not None else None
+        for slot, req, take in takes:
+            if req._first_dev is not None:
+                req.tokens.append(int(np.asarray(req._first_dev)))
+                req._first_dev = None
+            if take and toks is not None:
+                req.tokens.extend(int(t) for t in toks[slot, :take])
+            if len(req.tokens) >= req.max_new_tokens:
+                req.done.set()
+
+    def _loop(self) -> None:
+        pending: deque = deque()  # fetch frontier: (device toks, takes)
+        while self._running:
+            self._admit()
+            active = [(s, r) for s, r in enumerate(self._slots) if r is not None]
+            if not active:
+                while pending:
+                    self._resolve(pending.popleft())
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            # prefill-only requests resolve without a decode chunk
+            takes = []
+            for slot, req in active:
+                if req._remaining == 0:
+                    takes.append((slot, req, 0))
+                    self._slots[slot] = None
+            if len(takes) == len(active):
+                pending.append((None, takes))
+                continue
+            # dispatch the next chunk fed from device-side tokens (no sync)
+            toks_dev, self.cache = self._chunk_fn(self.params, self.cache, self._next_dev)
+            self._next_dev = toks_dev[:, -1]
+            # deterministic bookkeeping: plan takes + evictions from
+            # host counters — token values never gate scheduling
+            for slot, req in active:
+                if req._remaining == 0:
+                    continue
+                take = min(req._remaining, self.chunk)
+                req._remaining -= take
+                takes.append((slot, req, take))
+                if req._remaining == 0:
+                    self._slots[slot] = None  # evict: freed for next admit
+            pending.append((toks_dev, takes))
+            # fetch one chunk BEHIND: overlaps the chunk just dispatched
+            while len(pending) > 1:
+                self._resolve(pending.popleft())
+        while pending:
+            self._resolve(pending.popleft())

@@ -29,8 +29,11 @@ Protocol (generation-stamped lockstep barrier):
   - The replacement's first barrier consumes the pre-loaded state ->
     {"resync": True, "state": blob, "step": s}: it adopts the max-stamp
     survivor's state and joins at step s. Step count stays monotonic.
-  - Only when EVERY rank is gone does the trainer fall back to the
-    reference-style full restart from the last disk checkpoint.
+  - Only when EVERY rank is gone (or the loop never handed state to
+    the barrier) does the trainer fall back to the reference-style
+    full-restart path — which honors FailureConfig.max_failures, so
+    with max_failures=0 the structural failure surfaces to the caller
+    instead of restarting.
 
 Loop contract (see tests/test_elastic.py)::
 
@@ -57,7 +60,9 @@ class ElasticCoordinator:
     def __init__(self, world_size: int):
         self.world = world_size
         self.gen = 0
-        self.resume_step = 0
+        # -1 so the step-0 barrier parks normally; after a regang this is
+        # the resume point and ranks FREE-RUN through it (see barrier)
+        self.resume_step = -1
         self._waiters: Dict[int, Dict[str, Any]] = {}  # step -> {ranks, event}
 
     async def barrier(self, rank: int, gen: int, step: int) -> Dict[str, Any]:
@@ -66,10 +71,15 @@ class ElasticCoordinator:
         if gen != self.gen:
             # stale generation: resync at the recorded resume step
             return {"gen": self.gen, "step": self.resume_step, "resync": True}
-        if step < self.resume_step:
-            # catch-up lane after a regang: this rank was mid-step when
-            # the gang died, so its stamp trails the resume point —
-            # proceed without parking until it reaches the others
+        if step <= self.resume_step:
+            # free-run lane after a regang: ranks at or behind the resume
+            # point proceed WITHOUT parking and lockstep re-engages at
+            # resume+1. `<=` (not `<`) matters: a survivor that had
+            # already finished the resume step's work rejoins at
+            # resume+1, so a rank parking AT the resume step (the
+            # replacement, or a survivor that hadn't started the work)
+            # could otherwise wait for peers that will never come back
+            # to that step — the all-survivors-mid-step deadlock.
             return {"gen": gen, "step": step, "resync": False}
         w = self._waiters.setdefault(step, {"ranks": set(), "event": asyncio.Event()})
         w["ranks"].add(rank)

@@ -124,6 +124,10 @@ def test_sac_two_learner_lockstep_weights_equal(ray_start_regular):
         for a, b in zip(_leaves(s0["params"]), _leaves(s0["target_params"]))
     )
     assert moved
+    # free the 2 learner actors' CPUs NOW: leaked handles die only at an
+    # arbitrary GC point, and later tests in this module gang-schedule
+    # against the same 4-CPU fixture (this was the APEX "load flake")
+    group.stop()
 
 
 def test_dqn_two_learner_lockstep(ray_start_regular):
@@ -157,6 +161,7 @@ def test_dqn_two_learner_lockstep(ray_start_regular):
     for key in ("params", "target_params"):
         for a, b in zip(_leaves(states[0][key]), _leaves(states[1][key])):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    group.stop()  # see test_sac_two_learner_lockstep_weights_equal
 
 
 def _leaves(tree):
