@@ -11,6 +11,7 @@ for `device_put` without row-wise python.
 """
 from __future__ import annotations
 
+import hashlib
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -77,9 +78,15 @@ def _column_uniques(blk, ops, columns):
     return {c: list(set(blk.column(c).to_pylist())) for c in columns}
 
 
-def _gather_moments(ds, columns) -> Dict[str, Dict[str, float]]:
+def _per_block(ds, task, columns):
+    """One fan-out task per block, results gathered on the driver — the
+    shared scaffolding behind every distributed fit."""
     ops = ray_tpu.put(ds._ops) if ds._ops else None
-    parts = ray_tpu.get([_column_moments.remote(r, ops, columns) for r in ds._forced()])
+    return ray_tpu.get([task.remote(r, ops, columns) for r in ds._forced()])
+
+
+def _gather_moments(ds, columns) -> Dict[str, Dict[str, float]]:
+    parts = _per_block(ds, _column_moments, columns)
     stats = {}
     for c in columns:
         n = sum(p[c][0] for p in parts)
@@ -137,10 +144,7 @@ class LabelEncoder(Preprocessor):
         self.mapping_: Dict[Any, int] = {}
 
     def _fit(self, ds):
-        ops = ray_tpu.put(ds._ops) if ds._ops else None
-        parts = ray_tpu.get(
-            [_column_uniques.remote(r, ops, [self.label_column]) for r in ds._forced()]
-        )
+        parts = _per_block(ds, _column_uniques, [self.label_column])
         values = sorted({v for p in parts for v in p[self.label_column]}, key=str)
         self.mapping_ = {v: i for i, v in enumerate(values)}
 
@@ -159,8 +163,7 @@ class OneHotEncoder(Preprocessor):
         self.categories_: Dict[str, List[Any]] = {}
 
     def _fit(self, ds):
-        ops = ray_tpu.put(ds._ops) if ds._ops else None
-        parts = ray_tpu.get([_column_uniques.remote(r, ops, self.columns) for r in ds._forced()])
+        parts = _per_block(ds, _column_uniques, self.columns)
         for c in self.columns:
             self.categories_[c] = sorted({v for p in parts for v in p[c]}, key=str)
 
@@ -246,4 +249,172 @@ class Chain(Preprocessor):
     def transform_batch(self, batch):
         for p in self.preprocessors:
             batch = p.transform_batch(batch)
+        return batch
+
+
+class Tokenizer(Preprocessor):
+    """String columns → token lists (reference: preprocessors/tokenizer.py
+    Tokenizer — default whitespace split, custom `tokenization_fn`
+    supported). Stateless: no fit."""
+
+    def __init__(self, columns: List[str], tokenization_fn=None):
+        self.columns = columns
+        self.tokenization_fn = tokenization_fn or (lambda s: s.split())
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _transform_batch(self, batch):
+        fn = self.tokenization_fn
+        for c in self.columns:
+            batch[c] = np.asarray(
+                [fn(str(v)) for v in batch[c]], dtype=object
+            )
+        return batch
+
+
+class FeatureHasher(Preprocessor):
+    """Token counts → fixed-width hashed count vectors (reference:
+    preprocessors/hasher.py FeatureHasher — the hashing trick: no
+    vocabulary state, collisions accepted). Input columns hold strings
+    (whitespace-tokenized) or token lists; output column `{col}_hashed`
+    holds float32[num_features] rows. The hash is md5-based so feature
+    indices are stable across processes (PYTHONHASHSEED-proof)."""
+
+    def __init__(self, columns: List[str], num_features: int = 256):
+        self.columns = columns
+        self.num_features = num_features
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _hash(self, token: str) -> int:
+        return int(hashlib.md5(token.encode()).hexdigest()[:8], 16) % self.num_features
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            rows = []
+            for v in batch[c]:
+                toks = v if isinstance(v, (list, np.ndarray)) else str(v).split()
+                row = np.zeros(self.num_features, np.float32)
+                for t in toks:
+                    row[self._hash(str(t))] += 1.0
+                rows.append(row)
+            batch[f"{c}_hashed"] = np.stack(rows) if rows else np.zeros((0, self.num_features), np.float32)
+            del batch[c]
+        return batch
+
+
+@ray_tpu.remote
+def _column_token_counts(blk, ops, columns):
+    from collections import Counter
+
+    from ray_tpu.data.dataset import _apply_ops_local
+
+    blk = _apply_ops_local(blk, ops)
+    out = {}
+    for c in columns:
+        counts: Counter = Counter()
+        for v in blk.column(c).to_pylist():
+            toks = v if isinstance(v, list) else str(v).split()
+            counts.update(str(t) for t in toks)
+        out[c] = dict(counts)
+    return out
+
+
+class CountVectorizer(Preprocessor):
+    """Strings → vocabulary count vectors (reference:
+    preprocessors/vectorizer.py CountVectorizer). Fit builds the
+    vocabulary as a distributed token-count aggregation (one task per
+    block, counts merged on the driver — never rows); `max_features`
+    keeps the most frequent tokens. Output column `{col}_counts` holds
+    float32[|vocab|] rows; the vocabulary order is frequency-descending
+    then lexicographic, deterministic across runs."""
+
+    def __init__(self, columns: List[str], max_features: Optional[int] = None):
+        self.columns = columns
+        self.max_features = max_features
+        self.vocabularies: Dict[str, Dict[str, int]] = {}
+
+    def _fit(self, ds) -> None:
+        from collections import Counter
+
+        parts = _per_block(ds, _column_token_counts, self.columns)
+        for c in self.columns:
+            total: Counter = Counter()
+            for p in parts:
+                total.update(p[c])
+            items = sorted(total.items(), key=lambda kv: (-kv[1], kv[0]))
+            if self.max_features:
+                items = items[: self.max_features]
+            self.vocabularies[c] = {tok: i for i, (tok, _n) in enumerate(items)}
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            vocab = self.vocabularies[c]
+            rows = []
+            for v in batch[c]:
+                toks = v if isinstance(v, (list, np.ndarray)) else str(v).split()
+                row = np.zeros(len(vocab), np.float32)
+                for t in toks:
+                    i = vocab.get(str(t))
+                    if i is not None:
+                        row[i] += 1.0
+                rows.append(row)
+            batch[f"{c}_counts"] = np.stack(rows) if rows else np.zeros((0, len(vocab)), np.float32)
+            del batch[c]
+        return batch
+
+
+class UniformKBinsDiscretizer(Preprocessor):
+    """Numeric columns → equal-width bin indices (reference:
+    preprocessors/discretizer.py UniformKBinsDiscretizer). Fit gathers
+    per-column min/max through the distributed moments pass; transform
+    maps values to int64 bins [0, bins-1] (values at max land in the
+    last bin; NaN stays NaN as a float column would — emitted as -1)."""
+
+    def __init__(self, columns: List[str], bins: int = 10):
+        self.columns = columns
+        self.bins = bins
+        self.ranges: Dict[str, tuple] = {}
+
+    def _fit(self, ds) -> None:
+        stats = _gather_moments(ds, self.columns)
+        self.ranges = {c: (stats[c]["min"], stats[c]["max"]) for c in self.columns}
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            lo, hi = self.ranges[c]
+            width = (hi - lo) / self.bins if hi > lo else 1.0
+            v = np.asarray(batch[c], np.float64)
+            # mask NaN BEFORE the int cast: casting NaN to int64 is
+            # undefined behavior and warns per batch
+            nan = np.isnan(v)
+            idx = np.clip(
+                ((np.where(nan, lo, v) - lo) / width).astype(np.int64),
+                0, self.bins - 1,
+            )
+            batch[c] = np.where(nan, -1, idx).astype(np.int64)
+        return batch
+
+
+class CustomKBinsDiscretizer(Preprocessor):
+    """Numeric columns → bins with EXPLICIT edges (reference:
+    preprocessors/discretizer.py CustomKBinsDiscretizer). No fit:
+    `bin_edges[col]` is the full monotonic edge list; np.digitize
+    semantics, clipped to [0, len(edges)-2]."""
+
+    def __init__(self, columns: List[str], bin_edges: Dict[str, List[float]]):
+        self.columns = columns
+        self.bin_edges = {c: np.asarray(e, np.float64) for c, e in bin_edges.items()}
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _transform_batch(self, batch):
+        for c in self.columns:
+            edges = self.bin_edges[c]
+            v = np.asarray(batch[c], np.float64)
+            idx = np.clip(np.digitize(v, edges) - 1, 0, len(edges) - 2)
+            batch[c] = np.where(np.isnan(v), -1, idx).astype(np.int64)
         return batch

@@ -7,6 +7,8 @@ a crashed driver resumes from the last completed task instead of
 re-running the whole graph).
 """
 from ray_tpu.workflow.api import (  # noqa: F401
+    Continuation,
+    continuation,
     delete,
     get_metadata,
     get_output,
@@ -14,4 +16,9 @@ from ray_tpu.workflow.api import (  # noqa: F401
     list_all,
     resume,
     run,
+)
+from ray_tpu.workflow.event_listener import (  # noqa: F401
+    EventListener,
+    TimerListener,
+    wait_for_event,
 )

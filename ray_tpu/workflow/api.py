@@ -156,11 +156,40 @@ def _execute_memo(node: DAGNode, ids: Dict[int, str], wf_dir: str, memo: Dict[in
         except BaseException as e:
             first_error = first_error or e
             continue
+        if isinstance(value, Continuation) and n is not node:
+            # a dependent already received this task's ref: letting the
+            # raw marker flow downstream would corrupt its arguments
+            first_error = first_error or NotImplementedError(
+                "workflow.continuation() is supported as the workflow's "
+                "continuing value (tail recursion), not as an input to "
+                f"another task (returned by task {ids[id(n)]})"
+            )
+            continue
         _checkpoint(wf_dir, ids[id(n)], value)
+        from ray_tpu.workflow.event_listener import maybe_ack_event
+
+        maybe_ack_event(n, value)
         memo[id(n)] = ("val", value)
     if first_error is not None:
         raise first_error
     return memo[id(node)][1]
+
+
+class Continuation:
+    """Marker a workflow task returns to CONTINUE the workflow with a
+    new DAG (reference: workflow.continuation — dynamic workflows).
+    Supported where the reference's canonical recursion pattern uses it:
+    as the value the workflow would otherwise finish with (tail
+    continuation); a mid-graph dependent consuming a continuation's
+    value is not resolved."""
+
+    def __init__(self, dag: DAGNode):
+        self.dag = dag
+
+
+def continuation(dag: DAGNode) -> Continuation:
+    """reference: ray.workflow.continuation(dag)."""
+    return Continuation(dag)
 
 
 def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
@@ -185,6 +214,20 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
         _set_input(dag, workflow_input)
     try:
         value = _execute_memo(dag, ids, d, {})
+        # dynamic continuations (reference: workflow.continuation — a
+        # task RETURNS the next DAG and the workflow keeps going):
+        # each round's tasks checkpoint under round-namespaced ids, and
+        # the checkpointed Continuation marker itself makes resume()
+        # re-enter the same rounds with checkpoint hits — a resumed
+        # recursive workflow replays no finished work
+        rounds = 0
+        while isinstance(value, Continuation):
+            rounds += 1
+            sub = value.dag
+            sub_ids: Dict[int, str] = {}
+            _assign_ids(sub, sub_ids, [0])
+            sub_ids = {k: f"c{rounds}_{v}" for k, v in sub_ids.items()}
+            value = _execute_memo(sub, sub_ids, d, {})
     except Exception as e:
         _write_status(d, "FAILED", {"error": str(e)})
         raise

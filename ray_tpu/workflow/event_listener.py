@@ -1,0 +1,85 @@
+"""Workflow event listeners — event-driven workflow steps.
+
+Equivalent of the reference's event system
+(reference: python/ray/workflow/event_listener.py EventListener /
+TimerListener; api.py wait_for_event): `wait_for_event(Listener, *a)`
+is a DAG node that completes when the listener's `poll_for_event`
+resolves. The event PAYLOAD checkpoints like any other task value, so
+a resumed workflow does not re-wait for an event it already observed —
+the durability property the reference documents.
+
+The listener runs inside a normal workflow task (a worker), so a
+parked listener never blocks the driver; `poll_for_event` may be sync
+or async (coroutines run on a private event loop).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import cloudpickle
+
+import ray_tpu
+
+
+class EventListener:
+    """Subclass and implement poll_for_event(*args) (sync or async);
+    optionally event_checkpointed(event) as a post-checkpoint ack hook
+    (reference: event_listener.py EventListener.event_checkpointed)."""
+
+    def poll_for_event(self, *args) -> Any:
+        raise NotImplementedError
+
+    def event_checkpointed(self, event: Any) -> None:
+        pass
+
+
+class TimerListener(EventListener):
+    """Resolves after `seconds` (reference: TimerListener)."""
+
+    def poll_for_event(self, seconds: float):
+        time.sleep(float(seconds))
+        return {"fired_at": time.time()}
+
+
+@ray_tpu.remote
+def _wait_for_event_task(listener_blob: bytes, args: tuple):
+    import asyncio
+    import inspect
+
+    listener_type = cloudpickle.loads(listener_blob)
+    listener = listener_type()
+    result = listener.poll_for_event(*args)
+    if inspect.iscoroutine(result):
+        result = asyncio.run(result)
+    return result
+
+
+def maybe_ack_event(node, value) -> None:
+    """Post-checkpoint ack (reference: EventListener.event_checkpointed
+    — e.g. delete the queue message only once the event is DURABLE).
+    Called by the workflow executor after checkpointing a task's value;
+    a no-op for non-event nodes."""
+    fn = getattr(getattr(node, "_remote_fn", None), "_fn", None)
+    if fn is not _wait_for_event_task._fn:
+        return
+    try:
+        listener_type = cloudpickle.loads(node._args[0])
+        listener_type().event_checkpointed(value)
+    except Exception:
+        import logging
+
+        logging.getLogger("ray_tpu.workflow").warning(
+            "event_checkpointed hook failed", exc_info=True
+        )
+
+
+def wait_for_event(event_listener_type, *args):
+    """DAG node resolving to the event payload
+    (reference: workflow/api.py:608 wait_for_event)."""
+    if not (isinstance(event_listener_type, type)
+            and issubclass(event_listener_type, EventListener)):
+        raise TypeError(
+            f"wait_for_event expects an EventListener subclass, got {event_listener_type}"
+        )
+    return _wait_for_event_task.bind(cloudpickle.dumps(event_listener_type), tuple(args))
