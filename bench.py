@@ -590,6 +590,8 @@ def bench_tpu_train(extra):
         # decodes every sequence to the longest request (SURVEY §7 step
         # 10 — the reference delegates this to vLLM, green-field here)
         try:
+            import numpy as np
+
             from ray_tpu.models import llama_decode as D
             from ray_tpu.serve.llm_engine import ContinuousBatchingEngine
 
@@ -672,12 +674,20 @@ def bench_pixel_rl(extra):
         from ray_tpu.rllib.env.minatar_breakout import register
 
         register()
+        # runner actors sample on HOST CPUs; without this pin they would
+        # inherit the machine's JAX_PLATFORMS=axon and pay a TPU-relay
+        # round trip per env step (measured 34 env-steps/s vs ~175).
+        # Restored in the finally below so later worker-spawning
+        # sections can't silently inherit a CPU pin.
+        _prev_pin = os.environ.get("RAY_TPU_WORKER_JAX_PLATFORMS")
+        os.environ["RAY_TPU_WORKER_JAX_PLATFORMS"] = "cpu"
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
         config = (
             PPOConfig()
             .environment("MinAtarBreakout-v0")
             .env_runners(num_env_runners=1, num_envs_per_env_runner=16,
-                         rollout_fragment_length=128)
-            .training(lr=1e-3, train_batch_size=2048, minibatch_size=256, num_epochs=4)
+                         rollout_fragment_length=64)
+            .training(lr=1e-3, train_batch_size=1024, minibatch_size=256, num_epochs=2)
             .debugging(seed=0)
         )
         algo = config.build()
@@ -685,11 +695,9 @@ def bench_pixel_rl(extra):
             algo.train()
         t0 = time.perf_counter()
         steps = 0
-        iters = 0
-        while iters < 3 or time.perf_counter() - t0 < 5.0:
+        for _ in range(2):
             r = algo.train()
-            steps += r.get("num_env_steps_sampled", 2048) or 2048
-            iters += 1
+            steps += r.get("num_env_steps_sampled", 1024) or 1024
         dt = time.perf_counter() - t0
         algo.stop()
         extra["pixel_ppo_env_steps_per_s"] = round(steps / dt, 0)
@@ -697,6 +705,20 @@ def bench_pixel_rl(extra):
             f"(TPU learner + CPU runner actor)")
     except Exception as e:
         log(f"[bench] pixel RL bench skipped: {e}")
+    finally:
+        try:
+            if _prev_pin is None:
+                os.environ.pop("RAY_TPU_WORKER_JAX_PLATFORMS", None)
+            else:
+                os.environ["RAY_TPU_WORKER_JAX_PLATFORMS"] = _prev_pin
+        except NameError:
+            pass
+        try:
+            import ray_tpu
+
+            ray_tpu.shutdown()
+        except Exception:
+            pass
 
 
 def main():

@@ -322,10 +322,13 @@ def flash_attention(
     v,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    # 1024/2048 blocks measured ~15%% faster than 512/1024 at 8k on v5e
-    # (fewer grid steps; k/v and accumulators still fit VMEM at D=128)
+    # 1024/1024 blocks measured ~9%% faster than 512/1024 at 8k on v5e
+    # (fewer grid steps); bk=2048 was faster still in isolation but its
+    # [1024,2048] f32 score tiles overflow VMEM headroom on bigger
+    # models (1B-config remote compile failed) — 1024 keeps every
+    # benched config compiling
     block_q: int = 1024,
-    block_k: int = 2048,
+    block_k: int = 1024,
 ):
     o, _ = _flash_fwd_dispatch(q, k, v, causal, sm_scale, block_q, block_k)
     return o
@@ -347,7 +350,7 @@ def _fit_block(seq: int, block: int) -> int:
     return b if b >= 128 and seq % b == 0 else 0
 
 
-def kernel_supported(seq_q: int, seq_k: int, head_dim: int, block_q: int = 1024, block_k: int = 2048) -> bool:
+def kernel_supported(seq_q: int, seq_k: int, head_dim: int, block_q: int = 1024, block_k: int = 1024) -> bool:
     """True iff these shapes dispatch to the pallas kernel on a TPU backend.
     head_dim 64 (validated on-chip; covers most small models) or a
     128-multiple (MXU-native); seq lengths must be divisible by SOME

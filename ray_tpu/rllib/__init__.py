@@ -19,6 +19,7 @@ from ray_tpu.rllib.algorithms.bandits import (  # noqa: F401
 )
 from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config  # noqa: F401

@@ -1,0 +1,212 @@
+"""CRR — critic-regularized regression (discrete offline RL).
+
+Equivalent of the reference's CRR
+(reference: rllib/algorithms/crr/ — Wang et al. 2020: an actor trained
+by ADVANTAGE-FILTERED behavior cloning against a TD-trained critic, so
+the policy imitates only the dataset actions the critic scores above
+the policy's own expectation; nothing is ever queried outside the data
+support, which is what makes it safe offline).
+
+Jax-native: critic (Q over all actions), target critic and actor are
+explicit pytrees; one jitted update does the expected-SARSA TD step
+(bootstrap under the CURRENT actor's distribution), the advantage
+filter (binary or exp(A/beta)), and the weighted log-likelihood actor
+step. The offline minibatch loop mirrors CQL's (cql.py) — fixed
+transition dataset, no env runners.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+
+_COLS = ("obs", "actions", "next_obs", "rewards", "terminateds")
+
+
+class CRRConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.hidden = (256, 256)
+        # "binary": imitate only positive-advantage actions (1[A>0]);
+        # "exp": softer exp(A/beta) weights clipped at weight_clip
+        self.advantage_mode = "binary"
+        self.beta = 1.0
+        self.weight_clip = 20.0
+        self.target_network_update_freq = 100
+        self.train_batch_size = 256
+        self.updates_per_iteration = 200
+        self.offline_data: Dict[str, Any] = {}
+
+    def offline(self, data=None):
+        """data: transition arrays {obs, actions, next_obs, rewards,
+        terminateds} (actions int) or a ray_tpu.data Dataset."""
+        if data is not None:
+            self.offline_data = data
+        return self
+
+    def copy(self) -> "CRRConfig":
+        data, self.offline_data = self.offline_data, {}
+        try:
+            out = super().copy()
+        finally:
+            self.offline_data = data
+        out.offline_data = data
+        return out
+
+
+class CRR(Algorithm):
+    config_class = CRRConfig
+
+    def __init__(self, config: CRRConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.utils.env import env_spaces
+
+        data = config.offline_data
+        if hasattr(data, "iter_batches"):
+            parts: Dict[str, list] = {c: [] for c in _COLS}
+            for b in data.iter_batches(batch_size=4096, batch_format="numpy"):
+                for c in _COLS:
+                    parts[c].append(np.asarray(b[c]))
+            data = {c: np.concatenate(parts[c]) for c in _COLS}
+        missing = [c for c in _COLS if c not in data]
+        if missing:
+            raise ValueError(f"CRR offline data missing columns {missing}")
+        self.config = config
+        self.env_runner_group = None
+        self.learner_group = None
+        self._iteration = 0
+        self._weights_seq = 0
+        self._env_steps_lifetime = 0
+        self._recent_returns: list = []
+        self._spaces = env_spaces(config)
+        obs_dim = int(np.prod(self._spaces[0].shape))
+        self.n_actions = int(self._spaces[1].n)
+        self._data = {
+            "obs": np.asarray(data["obs"], np.float32),
+            "actions": np.asarray(data["actions"], np.int64),
+            "next_obs": np.asarray(data["next_obs"], np.float32),
+            "rewards": np.asarray(data["rewards"], np.float32),
+            "terminateds": np.asarray(data["terminateds"], np.float32),
+        }
+        self._np_rng = np.random.default_rng(config.seed)
+
+        def mlp_init(key, sizes, out):
+            dims = list(sizes) + [out]
+            keys = jax.random.split(key, len(dims))
+            layers = []
+            d_in = obs_dim
+            for i, d_out in enumerate(dims):
+                scale = 0.01 if i == len(dims) - 1 else (2.0 / d_in) ** 0.5
+                layers.append({
+                    "w": jax.random.normal(keys[i], (d_in, d_out)) * scale,
+                    "b": jnp.zeros((d_out,)),
+                })
+                d_in = d_out
+            return layers
+
+        def mlp(layers, x):
+            for layer in layers[:-1]:
+                x = jax.nn.relu(x @ layer["w"] + layer["b"])
+            return x @ layers[-1]["w"] + layers[-1]["b"]
+
+        cfg = config
+        rng = jax.random.PRNGKey(cfg.seed)
+        k_q, k_pi = jax.random.split(rng)
+        self.params = {
+            "q": mlp_init(k_q, cfg.hidden, self.n_actions),
+            "pi": mlp_init(k_pi, cfg.hidden, self.n_actions),
+        }
+        self.target_q = jax.tree.map(jnp.asarray, self.params["q"])
+        self._opt = optax.adam(cfg.lr)
+        self._opt_state = self._opt.init(self.params)
+        self._updates = 0
+        self._mlp = mlp
+
+        def loss_fn(params, target_q, batch):
+            obs, a = batch["obs"], batch["actions"]
+            q_all = mlp(params["q"], obs)                        # [B, A]
+            q_sa = jnp.take_along_axis(q_all, a[:, None], 1)[:, 0]
+            logits = mlp(params["pi"], obs)
+            logp_all = jax.nn.log_softmax(logits)
+            pi = jnp.exp(logp_all)
+
+            # critic: expected SARSA under the CURRENT actor at s'
+            next_logits = mlp(params["pi"], batch["next_obs"])
+            next_pi = jax.nn.softmax(next_logits)
+            q_next_t = mlp(target_q, batch["next_obs"])
+            v_next = jnp.sum(jax.lax.stop_gradient(next_pi) * q_next_t, -1)
+            target = batch["rewards"] + cfg.gamma * (1.0 - batch["terminateds"]) * v_next
+            critic_loss = jnp.mean((q_sa - jax.lax.stop_gradient(target)) ** 2)
+
+            # actor: advantage-filtered behavior cloning. The advantage
+            # uses the critic detached — the filter must not push Q.
+            q_det = jax.lax.stop_gradient(q_all)
+            adv = jnp.take_along_axis(q_det, a[:, None], 1)[:, 0] - jnp.sum(
+                jax.lax.stop_gradient(pi) * q_det, -1
+            )
+            if cfg.advantage_mode == "binary":
+                w = (adv > 0).astype(jnp.float32)
+            else:
+                w = jnp.clip(jnp.exp(adv / cfg.beta), 0.0, cfg.weight_clip)
+            logp_a = jnp.take_along_axis(logp_all, a[:, None], 1)[:, 0]
+            actor_loss = -jnp.mean(w * logp_a)
+            loss = critic_loss + actor_loss
+            stats = {
+                "critic_loss": critic_loss,
+                "actor_loss": actor_loss,
+                "mean_advantage_weight": jnp.mean(w),
+                "mean_q": jnp.mean(q_sa),
+            }
+            return loss, stats
+
+        def update(params, target_q, opt_state, batch):
+            (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_q, batch
+            )
+            upd, opt_state = self._opt.update(grads, opt_state)
+            return optax.apply_updates(params, upd), opt_state, stats
+
+        self._update = jax.jit(update)
+        self._pi_fn = jax.jit(lambda p, obs: mlp(p["pi"], obs))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        n = len(self._data["actions"])
+        acc: Dict[str, list] = {}
+        for _ in range(cfg.updates_per_iteration):
+            idx = self._np_rng.integers(0, n, size=min(cfg.train_batch_size, n))
+            batch = {k: v[idx] for k, v in self._data.items()}
+            self.params, self._opt_state, stats = self._update(
+                self.params, self.target_q, self._opt_state, batch
+            )
+            self._updates += 1
+            if self._updates % cfg.target_network_update_freq == 0:
+                self.target_q = self.params["q"]
+            # append DEVICE arrays; one conversion at the end — a float()
+            # per update would force a host sync inside the hot loop
+            for k, v in stats.items():
+                acc.setdefault(k, []).append(v)
+        return {
+            "learner": {k: float(np.mean([np.asarray(x) for x in v])) for k, v in acc.items()},
+            "episode_return_mean": float("nan"),
+            "num_offline_samples": n,
+        }
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax.numpy as jnp
+
+        logits = self._pi_fn(self.params, jnp.asarray(obs, jnp.float32).reshape(1, -1))
+        return int(np.asarray(jnp.argmax(logits, -1))[0])
+
+    def stop(self) -> None:
+        pass
+
+
+CRRConfig.algo_class = CRR

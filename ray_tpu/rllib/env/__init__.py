@@ -1,2 +1,18 @@
 from ray_tpu.rllib.env.env_runner import EnvRunner  # noqa: F401
 from ray_tpu.rllib.env.single_agent_env_runner import SingleAgentEnvRunner  # noqa: F401
+
+# Native envs this package ships, keyed by registered id. gymnasium's
+# registry is PER-PROCESS, so env factories call ensure_registered(id)
+# to make driver-registered names resolvable inside remote env-runner
+# actors too. New native envs add a row here, nowhere else.
+_NATIVE_ENVS = {
+    "MinAtarBreakout-v0": "ray_tpu.rllib.env.minatar_breakout",
+}
+
+
+def ensure_registered(env_id) -> None:
+    mod = _NATIVE_ENVS.get(env_id) if isinstance(env_id, str) else None
+    if mod:
+        import importlib
+
+        importlib.import_module(mod).register()

@@ -13,12 +13,18 @@ def make_single_env(config):
 
     if callable(config.env):
         return config.env(config.env_config)
+    from ray_tpu.rllib.env import ensure_registered
+
+    ensure_registered(config.env)
     return gym.make(config.env, **(config.env_config or {}))
 
 
 def make_vector_env(config):
     import gymnasium as gym
 
+    from ray_tpu.rllib.env import ensure_registered
+
+    ensure_registered(config.env)
     if callable(config.env):
         return gym.vector.SyncVectorEnv(
             [lambda: config.env(config.env_config) for _ in range(config.num_envs_per_env_runner)]

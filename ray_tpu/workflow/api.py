@@ -107,8 +107,19 @@ def _submit_memo(node: DAGNode, ids: Dict[int, str], wf_dir: str,
         if kind != "ref":
             return v
         value = ray_tpu.get(v)
+        if isinstance(value, Continuation):
+            # same guard as the collect loop: a dependent must never
+            # receive the raw continuation marker as an argument
+            raise NotImplementedError(
+                "workflow.continuation() is supported as the workflow's "
+                "continuing value (tail recursion), not as an input to "
+                "another task"
+            )
         if isinstance(a, DAGNode) and not isinstance(a, InputNode):
             _checkpoint(wf_dir, ids[id(a)], value)
+            from ray_tpu.workflow.event_listener import maybe_ack_event
+
+            maybe_ack_event(a, value)
             memo[id(a)] = ("val", value)
         return value
 

@@ -1,0 +1,82 @@
+"""CRR — critic-regularized regression, discrete offline RL
+(reference: rllib/algorithms/crr/)."""
+import numpy as np
+import pytest
+
+
+def _offline_dataset(n=6000, seed=0):
+    """Contextual task with a KNOWN optimal action per state: 3 actions;
+    action 0 pays +1 when obs[0] > 0, action 1 pays +1 when obs[0] <= 0,
+    action 2 always pays -1. The behavior policy is uniform, so the
+    dataset is full of bad actions CRR must learn to filter out."""
+    rng = np.random.default_rng(seed)
+    obs = rng.normal(size=(n, 4)).astype(np.float32)
+    actions = rng.integers(0, 3, size=n)
+    good = np.where(obs[:, 0] > 0, 0, 1)
+    rewards = np.where(actions == good, 1.0, np.where(actions == 2, -1.0, 0.0)).astype(np.float32)
+    return {
+        "obs": obs,
+        "actions": actions,
+        "next_obs": rng.normal(size=(n, 4)).astype(np.float32),
+        "rewards": rewards,
+        "terminateds": np.ones(n, np.float32),  # bandit-style transitions
+    }
+
+
+def _env_spaces_config(config):
+    import gymnasium as gym
+
+    # spaces only — no env stepping in offline RL
+    config.environment(lambda cfg=None: _SpacesEnv())
+    return config
+
+
+class _SpacesEnv:
+    def __init__(self):
+        import gymnasium as gym
+
+        self.observation_space = gym.spaces.Box(-np.inf, np.inf, (4,), np.float32)
+        self.action_space = gym.spaces.Discrete(3)
+
+    def close(self):
+        pass
+
+
+def test_crr_learns_offline_policy():
+    from ray_tpu.rllib import CRRConfig
+
+    config = _env_spaces_config(CRRConfig().debugging(seed=0))
+    config.offline(_offline_dataset())
+    config.updates_per_iteration = 300
+    algo = config.build()
+    for _ in range(3):
+        stats = algo.train()["learner"]
+    assert np.isfinite(stats["critic_loss"])
+    # the advantage filter is selective: not all dataset actions imitated
+    assert 0.05 < stats["mean_advantage_weight"] < 0.95
+
+    # the learned policy picks the optimal action per context
+    rng = np.random.default_rng(1)
+    correct = 0
+    for _ in range(200):
+        o = rng.normal(size=4).astype(np.float32)
+        a = algo.compute_single_action(o)
+        if a == (0 if o[0] > 0 else 1):
+            correct += 1
+    assert correct > 160, f"CRR accuracy {correct}/200 (chance is ~67)"
+    algo.stop()
+
+
+def test_crr_exp_mode_weights():
+    from ray_tpu.rllib import CRRConfig
+
+    config = _env_spaces_config(CRRConfig().debugging(seed=0))
+    config.offline(_offline_dataset(n=2000))
+    config.advantage_mode = "exp"
+    config.beta = 0.5
+    config.updates_per_iteration = 50
+    algo = config.build()
+    stats = algo.train()["learner"]
+    assert np.isfinite(stats["actor_loss"])
+    assert stats["mean_advantage_weight"] > 0.0
+    algo.stop()
