@@ -628,7 +628,8 @@ def bench_tpu_train(extra):
             dt_static = _static_pass()
 
             engine = ContinuousBatchingEngine(cfg=cfg, params=params, n_slots=8,
-                                              chunk=64, max_len=768)
+                                              chunk=64, max_len=768,
+                                              macro_phases=8)
             try:
                 def _cont_pass():
                     t0 = time.perf_counter()
@@ -639,16 +640,25 @@ def bench_tpu_train(extra):
                     return time.perf_counter() - t0
 
                 _cont_pass()
+                engine.reset_metrics()  # warm pass covered the compiles
                 dt_cont = _cont_pass()
+                em = engine.metrics()
             finally:
                 engine.shutdown()
             extra["llm_static_mixed_tok_per_s"] = round(total_tokens / dt_static, 0)
             extra["llm_continuous_mixed_tok_per_s"] = round(total_tokens / dt_cont, 0)
             extra["llm_continuous_vs_static"] = round(dt_static / dt_cont, 2)
+            extra["dispatches_per_token"] = em["dispatches_per_token"]
+            extra["lane_occupancy_pct"] = em["lane_occupancy_pct"]
+            if em.get("ttft_ms_p95") is not None:
+                extra["llm_ttft_ms_p95"] = em["ttft_ms_p95"]
             log(
                 f"[bench] mixed-length LLM serving: static {total_tokens / dt_static:,.0f} "
                 f"tok/s, continuous {total_tokens / dt_cont:,.0f} tok/s "
-                f"({dt_static / dt_cont:.2f}x)"
+                f"({dt_static / dt_cont:.2f}x), "
+                f"{em['dispatches']} dispatches "
+                f"({em['dispatches_per_token']:.4f}/token), "
+                f"{em['lane_occupancy_pct']:.0f}% lane occupancy"
             )
         except Exception as e:
             log(f"[bench] continuous batching bench skipped: {e}")

@@ -285,37 +285,15 @@ def prefill_into_slots(params, prompts, lengths, slots, cache, cfg: LlamaConfig)
     safe: causal attention keeps pad positions out of real positions'
     context, and every decode step WRITES its kv at `pos` before
     attending, so a pad cell is overwritten before it ever becomes
-    visible. Returns (first tokens (N,), cache)."""
-    N, Tb = prompts.shape
-    small = init_cache(cfg, N, Tb)
-    logits_all, filled = _prefill_all_positions(params, prompts, small, cfg)
-    # per-sequence next token comes from each TRUE last position
-    last = jnp.take_along_axis(
-        logits_all, (lengths - 1)[:, None, None], axis=1
-    )[:, 0, :]
-    first = jnp.argmax(last, axis=-1).astype(jnp.int32)
-    # ks: (L, N, Tb, kvh, hd) -> big cache rows at the target slots.
-    # Sequential dynamic_update_slice per member, NOT an advanced-index
-    # .at[...].set — the latter lowers to an XLA scatter that measured
-    # ~200ms per call on TPU (it dominated the whole engine); N slice
-    # writes inside one program are plain fast DMAs.
-    ks, vs = filled["k"], filled["v"]
+    visible. Returns (first tokens (N,), cache).
 
-    def write_one(n, kv):
-        k_big, v_big = kv
-        k_big = jax.lax.dynamic_update_slice(
-            k_big, jax.lax.dynamic_slice_in_dim(ks, n, 1, axis=1),
-            (0, slots[n], 0, 0, 0),
-        )
-        v_big = jax.lax.dynamic_update_slice(
-            v_big, jax.lax.dynamic_slice_in_dim(vs, n, 1, axis=1),
-            (0, slots[n], 0, 0, 0),
-        )
-        return k_big, v_big
-
-    new_k, new_v = jax.lax.fori_loop(0, N, write_one, (cache["k"], cache["v"]))
-    pos = cache["pos"].at[slots].set(lengths)
-    return first, {"k": new_k, "v": new_v, "pos": pos, "remaining": cache["remaining"]}
+    Implemented as admit_slots_masked with every row valid and identity
+    rems/feed (the caller manages `remaining` and the feed host-side)."""
+    first, cache, _ = admit_slots_masked(
+        params, prompts, lengths, slots, cache["remaining"][slots], cache,
+        jnp.zeros(cache["pos"].shape[0], jnp.int32), cfg,
+    )
+    return first, cache
 
 
 def _prefill_all_positions(params, tokens, cache, cfg: LlamaConfig):
@@ -348,6 +326,120 @@ def _prefill_all_positions(params, tokens, cache, cfg: LlamaConfig):
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
     return logits, {"k": ks, "v": vs}
+
+
+def admit_slots_masked(params, prompts, lengths, slots, rems, cache, feed,
+                       cfg: LlamaConfig):
+    """Fused masked admission (the macro-step building block): prefill A
+    right-padded prompts (A, P) and land the rows with length > 0 in
+    their target `slots` — cache K/V rows, per-slot `pos`, `remaining`
+    AND the decode feed token all update inside the same program, so an
+    admission costs ZERO extra dispatches when called from
+    macro_step_slots. Rows with length == 0 are plan padding: their
+    forward pass computes garbage that is never written anywhere.
+    Returns (first tokens (A,), cache, feed)."""
+    N, Tb = prompts.shape
+    small = init_cache(cfg, N, Tb)
+    logits_all, filled = _prefill_all_positions(params, prompts, small, cfg)
+    last = jnp.take_along_axis(
+        logits_all, (jnp.maximum(lengths, 1) - 1)[:, None, None], axis=1
+    )[:, 0, :]
+    first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    ks, vs = filled["k"], filled["v"]
+
+    def write_one(n, state):
+        # same sequential-DMA trick as prefill_into_slots (advanced-index
+        # scatter on the full cache rows measured ~200 ms/call on TPU),
+        # with a row-validity cond so plan padding writes nothing
+        def wr(st):
+            k_big, v_big, pos, rem, fd = st
+            s = jax.lax.dynamic_index_in_dim(slots, n, keepdims=False)
+            k_big = jax.lax.dynamic_update_slice(
+                k_big, jax.lax.dynamic_slice_in_dim(ks, n, 1, axis=1),
+                (0, s, 0, 0, 0),
+            )
+            v_big = jax.lax.dynamic_update_slice(
+                v_big, jax.lax.dynamic_slice_in_dim(vs, n, 1, axis=1),
+                (0, s, 0, 0, 0),
+            )
+            pos = pos.at[s].set(lengths[n])
+            rem = rem.at[s].set(rems[n])
+            fd = fd.at[s].set(first[n])
+            return (k_big, v_big, pos, rem, fd)
+
+        return jax.lax.cond(lengths[n] > 0, wr, lambda st: st, state)
+
+    k_big, v_big, pos, rem, feed = jax.lax.fori_loop(
+        0, N, write_one,
+        (cache["k"], cache["v"], cache["pos"], cache["remaining"], feed),
+    )
+    return first, {"k": k_big, "v": v_big, "pos": pos, "remaining": rem}, feed
+
+
+def macro_step_slots(params, cache, feed, steps, has_admit, prompts, lengths,
+                     slots, rems, chunk: int, cfg: LlamaConfig):
+    """Execute a K-phase macro plan as ONE jitted dispatch: a lax.scan
+    over host-planned phases, each phase = cond-guarded fused admission
+    prefill (admit_slots_masked) + up to `chunk` decode steps.
+
+    Greedy decode to a requested length means scheduling never depends
+    on token values, so the host plans K phases of admissions/evictions
+    ahead from counters alone and ships the whole plan (plus the raw
+    prompt tokens) as arguments of this single program — collapsing
+    one-dispatch-per-chunk + one-dispatch-per-prefill-bucket into
+    one dispatch per K chunks.
+
+    Per-phase plan arrays (K = steps.shape[0], A admission lanes, P
+    padded prompt width — both host-bucketed so the jit cache stays
+    small):
+      steps     (K,)       real decode steps this phase (<= chunk);
+                           steps beyond it are skipped via lax.cond, so
+                           an adaptive (shrunk-to-event) phase costs
+                           only its real steps
+      has_admit (K,)  bool phase opens with an admission prefill
+      prompts   (K, A, P)  right-padded admission prompts
+      lengths   (K, A)     true prompt lengths (0 = padding row)
+      slots     (K, A)     target slot per admission row
+      rems      (K, A)     decode tokens owed after the prefill token
+
+    Returns (toks (K, chunk, B), firsts (K, A), feed (B,), cache):
+    toks[k, t] is garbage for t >= steps[k] and for slots whose
+    `remaining` hit zero — the host's plan knows exactly which entries
+    are real, so it never reads the garbage."""
+    A = prompts.shape[1]
+
+    def phase(carry, xs):
+        cache, feed = carry
+        steps_k, admit_k, prompts_k, lengths_k, slots_k, rems_k = xs
+
+        def do_admit(op):
+            c, fd = op
+            return admit_slots_masked(
+                params, prompts_k, lengths_k, slots_k, rems_k, c, fd, cfg
+            )
+
+        def no_admit(op):
+            c, fd = op
+            return jnp.zeros((A,), jnp.int32), c, fd
+
+        first, cache, feed = jax.lax.cond(admit_k, do_admit, no_admit, (cache, feed))
+
+        def step(c, t):
+            def run(op):
+                cc, fd = op
+                logits, cc = decode_step_slots(params, cc, fd, cfg)
+                return cc, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            cc, fd = jax.lax.cond(t < steps_k, run, lambda op: op, c)
+            return (cc, fd), fd
+
+        (cache, feed), toks = jax.lax.scan(step, (cache, feed), jnp.arange(chunk))
+        return (cache, feed), (toks, first)
+
+    (cache, feed), (toks, firsts) = jax.lax.scan(
+        phase, (cache, feed), (steps, has_admit, prompts, lengths, slots, rems)
+    )
+    return toks, firsts, feed, cache
 
 
 @functools.lru_cache(maxsize=64)

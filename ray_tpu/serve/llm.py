@@ -25,7 +25,8 @@ class _LLMServer:
 
     def __init__(self, cfg=None, params=None, max_new_tokens: int = 32,
                  checkpoint_dir: Optional[str] = None, seed: int = 0,
-                 continuous: bool = False, n_slots: int = 8, chunk: int = 8):
+                 continuous: bool = False, n_slots: int = 8, chunk: int = 8,
+                 macro_phases: int = 8):
         import jax
 
         from ray_tpu.models import llama
@@ -42,13 +43,19 @@ class _LLMServer:
         self.max_new_tokens = max_new_tokens
         self.engine = None
         if continuous:
-            # continuous batching: requests admit/evict per decode chunk
-            # instead of coalescing into static batches
+            # continuous batching: requests admit/evict per decode chunk,
+            # with macro-step scheduling batching K chunks per dispatch
             from ray_tpu.serve.llm_engine import ContinuousBatchingEngine
 
             self.engine = ContinuousBatchingEngine(
-                self.params, self.cfg, n_slots=n_slots, chunk=chunk
+                self.params, self.cfg, n_slots=n_slots, chunk=chunk,
+                macro_phases=macro_phases,
             )
+
+    def metrics(self) -> Dict[str, Any]:
+        """Engine serving metrics (dispatches/token, lane occupancy,
+        TTFT/TPOT percentiles); empty for the static-batching path."""
+        return self.engine.metrics() if self.engine is not None else {}
 
     @batch(max_batch_size=32, batch_wait_timeout_s=0.02)
     def _generate(self, prompts: List[List[int]]) -> List[List[int]]:
@@ -79,7 +86,8 @@ class _LLMServer:
 
 def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
                    cfg=None, checkpoint_dir: Optional[str] = None,
-                   continuous: bool = False, **deploy_kw):
+                   continuous: bool = False, n_slots: int = 8,
+                   chunk: int = 8, macro_phases: int = 8, **deploy_kw):
     """A ready-to-run LLM generation application:
 
         app = llm_deployment(num_replicas=2, max_new_tokens=16)
@@ -90,4 +98,5 @@ def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
         _LLMServer, name="LLMServer", num_replicas=num_replicas, **deploy_kw
     )
     return dep.bind(cfg=cfg, max_new_tokens=max_new_tokens,
-                    checkpoint_dir=checkpoint_dir, continuous=continuous)
+                    checkpoint_dir=checkpoint_dir, continuous=continuous,
+                    n_slots=n_slots, chunk=chunk, macro_phases=macro_phases)

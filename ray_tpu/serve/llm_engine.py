@@ -1,4 +1,4 @@
-"""Continuous-batching LLM engine.
+"""Continuous-batching LLM engine with macro-step scheduling.
 
 The reference's Serve LLM stack delegates the decode loop to vLLM
 inside replicas (continuous batching + paged KV); there is no TPU
@@ -7,58 +7,77 @@ engine to wrap, so this is the green-field TPU-native equivalent
 
 - A fixed pool of KV-cache SLOTS (models/llama_decode.py per-slot
   machinery): each slot is an independent sequence at its own position.
-- Decode runs in CHUNKS of C tokens as one jitted device-side lax.scan
-  over ALL slots — static shapes, finished slots freeze via the
-  remaining-mask (waste bounded at C-1 lanes per sequence).
-- ASYNC PIPELINE: with greedy decode to a requested length, scheduling
-  never depends on token VALUES — admission and eviction are planned
-  from host-side counters alone. So the loop chains chunks
-  device-to-device (the next chunk feeds on toks[:, -1] without a
-  host fetch), dispatches admission prefills asynchronously, and
-  fetches each chunk's tokens ONE CHUNK BEHIND, overlapped with the
-  next chunk's compute. Over a relay-attached TPU (dispatch ~free,
-  sync ~expensive) this is the difference between losing and winning
-  against static batching at mixed lengths.
-- ADMISSION/EVICTION at chunk boundaries: freed slots take queued
-  requests immediately — short requests no longer wait for the longest
-  sequence in a static batch.
+- KEY INVARIANT: greedy decode to a requested length means scheduling
+  never depends on token VALUES — admission, eviction and chunk sizing
+  are all decidable from host-side counters alone.
+- MACRO-STEP SCHEDULING exploits that invariant to collapse dispatch
+  count: the host plans K phases of admissions/evictions ahead, then
+  executes the WHOLE plan as one jitted dispatch
+  (llama_decode.macro_step_slots — a lax.scan over the plan whose
+  phases run a fused admission prefill + a decode chunk device-side).
+  Prompts ride along as program arguments, so admission costs zero
+  extra dispatches.
+- ADAPTIVE CHUNKS: each phase decodes exactly to the next scheduling
+  event — min(chunk, min remaining over live slots) — so a freed slot
+  is re-admitted at the very next phase instead of idling to a fixed
+  chunk boundary; phases beyond their planned steps are skipped via
+  lax.cond, so a shrunk phase costs only its real steps.
+- ASYNC PIPELINE: tokens are fetched ONE MACRO-STEP BEHIND the
+  dispatch frontier — while macro-step N executes, the host plans and
+  dispatches N+1 from counters, then resolves N's tokens overlapped
+  with N+1's compute.
 
-Static batching (llama_decode.generate) remains the one-shot path.
-Honest positioning (bench.py's llm section measures both): per decode
-STEP the per-slot chunk is at parity with the static scan (~3 ms/step
-measured at B=8/S=512 on v5e), and the engine's lane-efficiency win
-grows with generation-length skew — but every chunk/prefill dispatch
-and fetch pays the host-link fixed cost, so on a RELAY-attached chip
-with a nano model the one-scan static path stays ahead; the engine's
-regime is direct-attached chips and models whose step time dwarfs the
-dispatch cost.
+Dispatch-cost math (why macro-stepping wins): with per-chunk
+dispatching, serving G tokens through B slots at chunk C costs
+~G/(B*C) chunk dispatches + one prefill dispatch per admission bucket;
+every dispatch pays the host-link fixed cost D, so relay-attached
+chips (D >> step time) lose to static batching's one-scan-per-group
+even though continuous batching wastes far fewer lanes at mixed
+lengths (round-5 bench: 0.31x). Macro-stepping divides the chunk
+dispatches by K and folds the prefill dispatches into the same
+program, so total dispatch overhead drops ~K*(1 + prefills/chunks)x —
+an order of magnitude at K=8 — while the lane-efficiency win of
+iteration-level scheduling is kept (and sharpened by adaptive chunks).
+`metrics()` reports dispatches/token, lane occupancy and TTFT/TPOT
+percentiles so bench.py can track the regime per round.
+
+Static batching (llama_decode.generate) remains the one-shot path; the
+legacy per-chunk loop survives behind macro_phases=0 for A/B testing.
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+logger = logging.getLogger(__name__)
+
 
 class _Request:
-    __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "_first_dev",
-                 "_remaining")
+    __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
+                 "_first_dev", "_remaining", "_t_submit", "_t_first", "_t_done")
 
     def __init__(self, prompt, max_new_tokens):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.tokens: List[int] = []
         self.done = threading.Event()
-        self._first_dev = None   # device scalar: prefill's first token
+        self.error: Optional[str] = None
+        self._first_dev = None   # device scalar: prefill's first token (legacy path)
         self._remaining = 0      # host-side plan counter (decode steps owed)
+        self._t_submit = time.perf_counter()
+        self._t_first: Optional[float] = None
+        self._t_done: Optional[float] = None
 
 
 class ContinuousBatchingEngine:
     def __init__(self, params, cfg, n_slots: int = 8, max_len: int = 0,
-                 chunk: int = 8):
+                 chunk: int = 8, macro_phases: int = 8):
         import functools
 
         import jax
@@ -72,10 +91,15 @@ class ContinuousBatchingEngine:
         self.n_slots = n_slots
         self.max_len = max_len or cfg.max_seq_len
         self.chunk = chunk
+        self.macro_phases = macro_phases  # 0 => legacy per-chunk dispatching
         self.cache = D.init_slot_cache(cfg, n_slots, self.max_len)
         self._prefill_slots = jax.jit(functools.partial(D.prefill_into_slots, cfg=cfg))
         self._chunk_fn = jax.jit(
             functools.partial(D.decode_chunk_slots, chunk=chunk, cfg=cfg),
+            donate_argnums=(1,),
+        )
+        self._macro_fn = jax.jit(
+            functools.partial(D.macro_step_slots, chunk=chunk, cfg=cfg),
             donate_argnums=(1,),
         )
         self._slots: List[Optional[_Request]] = [None] * n_slots
@@ -83,6 +107,16 @@ class ContinuousBatchingEngine:
 
         self._next_dev = jnp.zeros(n_slots, jnp.int32)  # device-side feed tokens
         self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._waiting: deque = deque()       # planner-side FIFO (loop thread only)
+        self._pending: deque = deque()       # fetch frontier: tagged entries
+        self._dead: Optional[str] = None
+        # serving metrics (monotonic counters + latency samples)
+        self._m = {"dispatches": 0, "tokens_out": 0, "slot_steps": 0,
+                   "useful_slot_steps": 0}
+        # bounded latency windows: a long-lived replica must not grow a
+        # sample per request forever (percentiles stay recent-weighted)
+        self._ttft: deque = deque(maxlen=2048)
+        self._tpot: deque = deque(maxlen=2048)
         self._wake = threading.Event()
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -90,6 +124,12 @@ class ContinuousBatchingEngine:
 
     # ------------------------------------------------------------- public
     def submit(self, prompt: List[int], max_new_tokens: int) -> _Request:
+        if self._dead is not None:
+            raise RuntimeError(f"engine is dead: {self._dead}")
+        if len(prompt) == 0:
+            # length 0 is the macro plan's padding-row sentinel (and the
+            # legacy prefill's last-position logits would be garbage)
+            raise ValueError("prompt must be non-empty")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if len(prompt) + max_new_tokens > self.max_len:
@@ -99,6 +139,13 @@ class ContinuousBatchingEngine:
             )
         req = _Request([int(t) for t in prompt], max_new_tokens)
         self._queue.put(req)
+        if self._dead is not None:
+            # lost the race with the loop dying: the dead loop will never
+            # drain the queue, so fail the request here instead of letting
+            # the caller eat a generic timeout
+            req.error = f"engine is dead: {self._dead}"
+            req.done.set()
+            raise RuntimeError(req.error)
         self._wake.set()
         return req
 
@@ -107,6 +154,8 @@ class ContinuousBatchingEngine:
         req = self.submit(prompt, max_new_tokens)
         if not req.done.wait(timeout):
             raise TimeoutError("generation timed out")
+        if req.error is not None:
+            raise RuntimeError(f"generation failed: {req.error}")
         return req.tokens
 
     def shutdown(self):
@@ -114,14 +163,144 @@ class ContinuousBatchingEngine:
         self._wake.set()
         self._thread.join(timeout=10)
 
+    def metrics(self) -> Dict[str, Any]:
+        """Serving metrics since construction (or reset_metrics()):
+        dispatch counts, dispatches/token, lane occupancy %, TTFT/TPOT
+        percentiles. Tokens count at DELIVERY, so read after requests
+        complete for exact ratios."""
+        m = dict(self._m)
+        toks = max(1, m["tokens_out"])
+        m["dispatches_per_token"] = round(m["dispatches"] / toks, 4)
+        m["lane_occupancy_pct"] = round(
+            100.0 * m["useful_slot_steps"] / max(1, m["slot_steps"]), 1
+        )
+
+        def pct(xs, q):
+            if not xs:
+                return None
+            s = sorted(xs)
+            return round(s[min(len(s) - 1, int(q * len(s)))] * 1e3, 2)
+
+        m["ttft_ms_p50"] = pct(self._ttft, 0.50)
+        m["ttft_ms_p95"] = pct(self._ttft, 0.95)
+        m["tpot_ms_p50"] = pct(self._tpot, 0.50)
+        m["tpot_ms_p95"] = pct(self._tpot, 0.95)
+        return m
+
+    def reset_metrics(self) -> None:
+        self._m = {k: 0 for k in self._m}
+        self._ttft, self._tpot = deque(maxlen=2048), deque(maxlen=2048)
+
     # ------------------------------------------------------------ engine
-    @staticmethod
-    def _bucket(n: int) -> int:
+    def _bucket(self, n: int) -> int:
+        """Power-of-two padded prompt width, clamped to max_len: with a
+        non-power-of-two max_len (e.g. 768) the raw bucket can exceed
+        the cache depth and crash prefill at trace time; submit()
+        already guarantees the prompt itself fits."""
         b = 16
         while b < n:
             b *= 2
-        return b
+        return min(b, self.max_len)
 
+    # ---- macro-step scheduling ----------------------------------------
+    def _plan(self) -> Optional[List[Dict[str, Any]]]:
+        """Plan up to macro_phases phases of admissions + adaptive decode
+        chunks purely from host counters (the scheduling-never-depends-
+        on-token-values invariant). Mutates engine bookkeeping to the
+        post-macro-step state: slot assignments, per-request remaining
+        counters, evictions."""
+        phases = []
+        while len(phases) < self.macro_phases:
+            admissions = []
+            free = [i for i, r in enumerate(self._slots) if r is None]
+            while free and self._waiting:
+                slot = free.pop(0)
+                req = self._waiting.popleft()
+                req._remaining = req.max_new_tokens - 1
+                self._slots[slot] = req
+                admissions.append((slot, req))
+            live = [(s, r) for s, r in enumerate(self._slots)
+                    if r is not None and r._remaining > 0]
+            if not live and not admissions:
+                break
+            # adaptive chunk: decode exactly to the next scheduling event
+            # (a slot finishing) so the freed lane re-admits immediately
+            steps = min([self.chunk] + [r._remaining for _, r in live]) if live else 0
+            # invariant: steps <= every live remaining, so each live slot
+            # takes exactly `steps` real tokens this phase
+            takes = []
+            for s, r in live:
+                r._remaining -= steps
+                takes.append((s, r, steps))
+            for s, r in enumerate(self._slots):
+                if r is not None and r._remaining == 0:
+                    self._slots[s] = None  # evict: freed for the next phase
+            phases.append({"steps": steps, "admissions": admissions,
+                           "takes": takes})
+        return phases or None
+
+    def _dispatch_macro(self, phases: List[Dict[str, Any]]) -> None:
+        """Ship the plan as ONE jitted dispatch and append the result to
+        the fetch frontier (resolved one macro-step behind)."""
+        import jax.numpy as jnp
+
+        K = self.macro_phases
+        max_admit = max((len(p["admissions"]) for p in phases), default=0)
+        A = 1
+        while A < max(1, max_admit):
+            A *= 2
+        P = self._bucket(max(
+            (len(r.prompt) for p in phases for _, r in p["admissions"]), default=1
+        ))
+        steps = np.zeros(K, np.int32)
+        has_admit = np.zeros(K, bool)
+        prompts = np.zeros((K, A, P), np.int32)
+        lengths = np.zeros((K, A), np.int32)
+        slots = np.zeros((K, A), np.int32)
+        rems = np.zeros((K, A), np.int32)
+        for k, ph in enumerate(phases):
+            steps[k] = ph["steps"]
+            for a, (slot, req) in enumerate(ph["admissions"]):
+                has_admit[k] = True
+                prompts[k, a, : len(req.prompt)] = req.prompt
+                lengths[k, a] = len(req.prompt)
+                slots[k, a] = slot
+                rems[k, a] = req.max_new_tokens - 1
+        try:
+            toks_dev, firsts_dev, self._next_dev, self.cache = self._macro_fn(
+                self.params, self.cache, self._next_dev,
+                jnp.asarray(steps), jnp.asarray(has_admit), jnp.asarray(prompts),
+                jnp.asarray(lengths), jnp.asarray(slots), jnp.asarray(rems),
+            )
+        except Exception:
+            # park the plan so _die can fail requests whose ONLY remaining
+            # reference is this plan (admitted AND fully planned-out slots
+            # are already evicted from the host bookkeeping)
+            self._pending.append(("macro", None, None, phases))
+            raise
+        self._m["dispatches"] += 1
+        for ph in phases:
+            self._m["slot_steps"] += ph["steps"] * self.n_slots
+            self._m["useful_slot_steps"] += sum(t for _, _, t in ph["takes"])
+        self._pending.append(("macro", toks_dev, firsts_dev, phases))
+
+    def _loop_macro(self) -> None:
+        while self._running:
+            self._drain_queue()
+            if not self._waiting and not any(r is not None for r in self._slots):
+                while self._pending:
+                    self._resolve(self._pending.popleft())
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            phases = self._plan()
+            if phases:
+                self._dispatch_macro(phases)
+            # fetch one macro-step BEHIND: overlaps the one just dispatched
+            while len(self._pending) > 1:
+                self._resolve(self._pending.popleft())
+
+    # ---- legacy per-chunk path (macro_phases=0): kept for A/B tests ----
     def _admit(self) -> None:
         """Move queued requests into free slots. Admissions are BATCHED:
         requests bucket by power-of-two padded prompt length and each
@@ -132,8 +311,12 @@ class ContinuousBatchingEngine:
 
         free = [i for i, r in enumerate(self._slots) if r is None]
         batch: List[tuple] = []
-        while free and not self._queue.empty():
-            batch.append((free.pop(0), self._queue.get()))
+        while free and self._waiting:
+            slot, req = free.pop(0), self._waiting.popleft()
+            # claim the slot BEFORE the prefill dispatch so a failed
+            # dispatch still leaves the request reachable by _die
+            self._slots[slot] = req
+            batch.append((slot, req))
         if not batch:
             return
         buckets: Dict[int, List[tuple]] = {}
@@ -151,12 +334,12 @@ class ContinuousBatchingEngine:
                 self.params, jnp.asarray(prompts), jnp.asarray(lengths),
                 jnp.asarray(slots), self.cache,
             )
+            self._m["dispatches"] += 1
             rem_updates = np.zeros(len(members), np.int32)
-            for n, (slot, req) in enumerate(members):
+            for n, (_slot, req) in enumerate(members):
                 req._first_dev = firsts[n]
                 req._remaining = req.max_new_tokens - 1
                 rem_updates[n] = req._remaining
-                self._slots[slot] = req
             self.cache["remaining"] = self.cache["remaining"].at[
                 jnp.asarray(slots)
             ].set(jnp.asarray(rem_updates))
@@ -165,28 +348,14 @@ class ContinuousBatchingEngine:
                 idx = jnp.asarray(slots[live])
                 self._next_dev = self._next_dev.at[idx].set(firsts[jnp.asarray(live)])
 
-    def _resolve(self, entry) -> None:
-        """Fetch one chunk's tokens (the only host sync, one chunk
-        behind the dispatch frontier) and deliver them to requests."""
-        toks_dev, takes = entry
-        toks = np.asarray(toks_dev) if toks_dev is not None else None
-        for slot, req, take in takes:
-            if req._first_dev is not None:
-                req.tokens.append(int(np.asarray(req._first_dev)))
-                req._first_dev = None
-            if take and toks is not None:
-                req.tokens.extend(int(t) for t in toks[slot, :take])
-            if len(req.tokens) >= req.max_new_tokens:
-                req.done.set()
-
-    def _loop(self) -> None:
-        pending: deque = deque()  # fetch frontier: (device toks, takes)
+    def _loop_chunked(self) -> None:
         while self._running:
+            self._drain_queue()
             self._admit()
             active = [(s, r) for s, r in enumerate(self._slots) if r is not None]
             if not active:
-                while pending:
-                    self._resolve(pending.popleft())
+                while self._pending:
+                    self._resolve(self._pending.popleft())
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
@@ -197,11 +366,13 @@ class ContinuousBatchingEngine:
                     takes.append((slot, req, 0))
                     self._slots[slot] = None
             if len(takes) == len(active):
-                pending.append((None, takes))
+                self._pending.append(("chunk", None, takes))
                 continue
             # dispatch the next chunk fed from device-side tokens (no sync)
             toks_dev, self.cache = self._chunk_fn(self.params, self.cache, self._next_dev)
             self._next_dev = toks_dev[:, -1]
+            self._m["dispatches"] += 1
+            self._m["slot_steps"] += self.chunk * self.n_slots
             # deterministic bookkeeping: plan takes + evictions from
             # host counters — token values never gate scheduling
             for slot, req in active:
@@ -209,12 +380,107 @@ class ContinuousBatchingEngine:
                     continue
                 take = min(req._remaining, self.chunk)
                 req._remaining -= take
+                self._m["useful_slot_steps"] += take
                 takes.append((slot, req, take))
                 if req._remaining == 0:
                     self._slots[slot] = None  # evict: freed for next admit
-            pending.append((toks_dev, takes))
+            self._pending.append(("chunk", toks_dev, takes))
             # fetch one chunk BEHIND: overlaps the chunk just dispatched
-            while len(pending) > 1:
-                self._resolve(pending.popleft())
-        while pending:
-            self._resolve(pending.popleft())
+            while len(self._pending) > 1:
+                self._resolve(self._pending.popleft())
+
+    # ---- shared plumbing ----------------------------------------------
+    def _drain_queue(self) -> None:
+        while True:
+            try:
+                self._waiting.append(self._queue.get_nowait())
+            except queue.Empty:
+                return
+
+    def _deliver(self, req: _Request, toks) -> None:
+        if req._t_first is None and (req.tokens or toks):
+            req._t_first = time.perf_counter()
+            self._ttft.append(req._t_first - req._t_submit)
+        req.tokens.extend(toks)
+        self._m["tokens_out"] += len(toks)
+        if len(req.tokens) >= req.max_new_tokens and not req.done.is_set():
+            req._t_done = time.perf_counter()
+            if req._t_first is not None and len(req.tokens) > 1:
+                self._tpot.append(
+                    (req._t_done - req._t_first) / (len(req.tokens) - 1)
+                )
+            req.done.set()
+
+    def _resolve(self, entry) -> None:
+        """Fetch one macro-step's (or legacy chunk's) tokens — the only
+        host sync, one dispatch behind the frontier — and deliver them
+        to requests according to the plan. Dispatch is async, so a
+        poisoned device program often surfaces HERE (at the blocking
+        fetch), after the entry already left _pending — re-park it so
+        _die can still reach its requests."""
+        try:
+            self._resolve_inner(entry)
+        except Exception:
+            self._pending.appendleft(entry)
+            raise
+
+    def _resolve_inner(self, entry) -> None:
+        if entry[0] == "macro":
+            _, toks_dev, firsts_dev, phases = entry
+            toks = np.asarray(toks_dev)
+            firsts = np.asarray(firsts_dev)
+            for k, ph in enumerate(phases):
+                for a, (_slot, req) in enumerate(ph["admissions"]):
+                    self._deliver(req, [int(firsts[k, a])])
+                for slot, req, take in ph["takes"]:
+                    if take:
+                        self._deliver(req, [int(t) for t in toks[k, :take, slot]])
+            return
+        _, toks_dev, takes = entry
+        toks = np.asarray(toks_dev) if toks_dev is not None else None
+        for slot, req, take in takes:
+            if req._first_dev is not None:
+                self._deliver(req, [int(np.asarray(req._first_dev))])
+                req._first_dev = None
+            if take and toks is not None:
+                self._deliver(req, [int(t) for t in toks[slot, :take]])
+
+    def _die(self, msg: str) -> None:
+        """Fail every in-flight and queued request with a diagnostic and
+        mark the engine dead so submit() raises immediately — a poisoned
+        device program must not surface as N generic timeouts."""
+        self._dead = msg
+        doomed = set()
+        for entry in self._pending:
+            if entry[0] == "macro":
+                for ph in entry[3]:
+                    doomed.update(r for _, r in ph["admissions"])
+                    doomed.update(r for _, r, _ in ph["takes"])
+            else:
+                doomed.update(r for _, r, _ in entry[2])
+        self._pending.clear()
+        doomed.update(r for r in self._slots if r is not None)
+        self._slots = [None] * self.n_slots
+        doomed.update(self._waiting)
+        self._waiting.clear()
+        while True:
+            try:
+                doomed.add(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for req in doomed:
+            req.error = msg
+            req.done.set()
+
+    def _loop(self) -> None:
+        try:
+            if self.macro_phases > 0:
+                self._loop_macro()
+            else:
+                self._loop_chunked()
+            while self._pending:  # clean shutdown: drain the frontier
+                self._resolve(self._pending.popleft())
+        except Exception as e:  # noqa: BLE001 — anything device-side
+            msg = f"{type(e).__name__}: {e}"
+            logger.exception("continuous-batching engine loop died: %s", msg)
+            self._die(msg)

@@ -89,15 +89,23 @@ class ElasticCoordinator:
             self._waiters.pop(step, None)
             return {"gen": gen, "step": step, "resync": False}
         my_gen = gen
-        while not w["event"].is_set():
+        # ONE wait task per barrier call, cancelled on every exit path —
+        # shielding a fresh wait() every 0.2s leaked a pending task per
+        # poll forever after regang() cleared the waiters (the event of a
+        # cleared waiter is never set, so those tasks could never finish)
+        waiter = asyncio.ensure_future(w["event"].wait())
+        try:
+            while not waiter.done():
+                if self.gen != my_gen:
+                    # regang happened while parked: the step never completed
+                    return {"gen": self.gen, "step": self.resume_step, "resync": True}
+                await asyncio.wait({waiter}, timeout=0.2)
             if self.gen != my_gen:
-                # regang happened while parked: the step never completed
                 return {"gen": self.gen, "step": self.resume_step, "resync": True}
-            try:
-                await asyncio.wait_for(asyncio.shield(w["event"].wait()), timeout=0.2)
-            except asyncio.TimeoutError:
-                pass
-        return {"gen": my_gen, "step": step, "resync": False}
+            return {"gen": my_gen, "step": step, "resync": False}
+        finally:
+            if not waiter.done():
+                waiter.cancel()
 
     def regang(self, resume_step: int) -> int:
         """New generation resuming at `resume_step`; parked barriers wake

@@ -152,3 +152,50 @@ def test_elastic_survivors_not_restarted(ray_start_regular, tmp_path):
         except Exception:
             pass
         group.shutdown()
+
+
+def test_barrier_no_pending_task_leak():
+    """A barrier parked across a regang must not leak pending event-wait
+    tasks: the old shield-a-fresh-wait-every-0.2s pattern left one
+    never-completing task per poll after regang() cleared the waiters."""
+    import asyncio
+
+    from ray_tpu.train.elastic import ElasticCoordinator
+
+    Coord = ElasticCoordinator.__wrapped__  # undecorated actor class
+
+    async def run():
+        c = Coord(world_size=2)
+        base = len(asyncio.all_tasks())
+        parked = asyncio.ensure_future(c.barrier(rank=0, gen=0, step=1))
+        await asyncio.sleep(0.7)  # several poll intervals while parked
+        c.regang(resume_step=1)
+        resp = await parked
+        assert resp["resync"] is True
+        await asyncio.sleep(0.3)  # let the cancelled waiter be reaped
+        return len(asyncio.all_tasks()) - base
+
+    leaked = asyncio.run(run())
+    assert leaked <= 0, f"{leaked} pending barrier tasks leaked across regang"
+
+
+def test_barrier_releases_when_all_ranks_arrive():
+    """Plain completion path still works with the single-waiter barrier:
+    both ranks arrive, both get a non-resync release at the step."""
+    import asyncio
+
+    from ray_tpu.train.elastic import ElasticCoordinator
+
+    Coord = ElasticCoordinator.__wrapped__
+
+    async def run():
+        c = Coord(world_size=2)
+        a = asyncio.ensure_future(c.barrier(rank=0, gen=0, step=3))
+        await asyncio.sleep(0.05)
+        b = asyncio.ensure_future(c.barrier(rank=1, gen=0, step=3))
+        ra, rb = await asyncio.gather(a, b)
+        assert ra == {"gen": 0, "step": 3, "resync": False}
+        assert rb == {"gen": 0, "step": 3, "resync": False}
+        return True
+
+    assert asyncio.run(run())
