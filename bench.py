@@ -670,6 +670,90 @@ def bench_tpu_train(extra):
         return None
 
 
+def bench_data_pipeline(extra):
+    """Data-execution subsystem: rows/s through a FUSED map+filter chain
+    (one task per block for the whole run — the logical-plan optimizer's
+    work), and the arena high-water mark while streaming a dataset ~6x
+    the arena-usage budget under the arena backpressure policy."""
+    try:
+        import numpy as np
+
+        import ray_tpu
+        import ray_tpu.data
+        from ray_tpu._private.worker import get_global_core
+        from ray_tpu.data.context import DataContext
+        from ray_tpu.data.dataset import LazyBlock
+
+        ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+        _settle(2.0)
+
+        # fused-chain throughput: 32 blocks x 64k rows through
+        # map_batches+filter+map_batches, collapsed to one task per block
+        n_blocks, rows_per = 32, 65_536
+        ds = ray_tpu.data.range(n_blocks, parallelism=n_blocks).map_batches(
+            lambda b: {"x": np.arange(rows_per, dtype=np.float64)}
+        ).filter(lambda r: r["x"] % 2 == 0).map_batches(lambda b: {"x": b["x"] * 2.0})
+        t0 = time.perf_counter()
+        rows = 0
+        for batch in ds.iter_batches(batch_size=rows_per, prefetch_blocks=4):
+            rows += len(batch["x"])
+        dt = time.perf_counter() - t0
+        st = ds.stats().to_dict()
+        fused_tasks = max(
+            (m["tasks"] for k, m in st["operators"].items() if "->" in k), default=0
+        )
+        extra["data_pipeline_rows_per_s"] = round(rows / dt, 0)
+        extra["data_fused_tasks_per_block"] = round(fused_tasks / n_blocks, 2)
+        log(f"[bench] data pipeline (fused map+filter chain): {rows / dt:,.0f} rows/s, "
+            f"{fused_tasks / n_blocks:.2f} transform tasks/block")
+
+        # arena-bounded streaming: 96 MiB of lazy blocks against a
+        # 16 MiB usage budget — report the high-water mark vs budget
+        ctx = DataContext.get_current()
+        prev_budget = ctx.arena_usage_budget_bytes
+        budget = 16 * 1024 * 1024
+        ctx.arena_usage_budget_bytes = budget
+        block_bytes = 2 * 1024 * 1024
+        nb = 48
+
+        @ray_tpu.remote
+        def make_block(i):
+            import pyarrow as pa
+
+            return pa.table({"x": np.full(block_bytes // 8, float(i))})
+
+        try:
+            refs = [LazyBlock(lambda i=i: make_block.remote(i)) for i in range(nb)]
+            dsb = ray_tpu.data.Dataset(refs).map_batches(lambda b: {"x": b["x"] * 2.0})
+            core = get_global_core()
+            peak = 0
+            t0 = time.perf_counter()
+            for batch in dsb.iter_batches(batch_size=block_bytes // 8, prefetch_blocks=9):
+                peak = max(peak, core._shm.usage()["used_bytes"])
+            dtb = time.perf_counter() - t0
+            thr = dsb.stats().to_dict()["backpressure_throttles"].get("arena_usage", 0)
+            extra["data_arena_hwm_mib"] = round(peak / (1 << 20), 1)
+            extra["data_arena_hwm_over_budget"] = round(peak / budget, 2)
+            extra["data_backpressured_gib_per_s"] = round(
+                nb * block_bytes / (1 << 30) / dtb, 2
+            )
+            log(f"[bench] arena-backpressured stream ({nb * block_bytes >> 20} MiB through "
+                f"{budget >> 20} MiB budget): high-water {peak / (1 << 20):.1f} MiB "
+                f"({peak / budget:.2f}x budget), {thr} throttles, "
+                f"{nb * block_bytes / (1 << 30) / dtb:.2f} GiB/s")
+        finally:
+            ctx.arena_usage_budget_bytes = prev_budget
+        ray_tpu.shutdown()
+    except Exception as e:
+        log(f"[bench] data pipeline bench failed: {e}")
+        try:
+            import ray_tpu
+
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+
+
 def bench_pixel_rl(extra):
     """Pixel-RL throughput: conv-PPO on the native MinAtar-style
     Breakout (BASELINE.json north star #2 — "RLlib PPO Atari"; ale_py is
@@ -735,6 +819,7 @@ def main():
     extra = {}
     bench_runtime(extra)
     bench_broadcast(extra)
+    bench_data_pipeline(extra)
     bench_pixel_rl(extra)
     mfu = bench_tpu_train(extra)
     if mfu is not None:

@@ -5,6 +5,7 @@ the shared-memory object store, streaming iteration with bounded
 in-flight blocks (reference: data/_internal/execution/streaming_executor.py).
 """
 from ray_tpu.data.dataset import DataIterator, Dataset  # noqa: F401
+from ray_tpu.data.context import DataContext  # noqa: F401
 from ray_tpu.data import preprocessors  # noqa: F401
 from ray_tpu.data.grouped import (  # noqa: F401
     AggregateFn,

@@ -1,45 +1,77 @@
-"""Streaming operator executor.
+"""Streaming operator executor over the logical plan.
 
 Equivalent of the reference's pull-based StreamingExecutor + operator
 model (reference: data/_internal/execution/streaming_executor.py:55,
 operators/map_operator.py + actor_pool_map_operator.py,
-backpressure_policy/ — there a thread pipelines blocks through a DAG of
-operators with per-operator resource caps; here the pipeline is a chain
-of generator stages, each with a bounded in-flight window, driven by
-consumer demand: nothing downstream pulls → nothing upstream launches —
-the natural pull-based backpressure).
+backpressure_policy/). The Dataset's chain of typed logical operators
+(`_internal/logical_ops.py`) is optimized (`_internal/optimizer.py`:
+limit pushdown, projection merges, operator FUSION — one task per block
+per fused run instead of one per operator) and lowered to a chain of
+generator stages driven by consumer demand: nothing downstream pulls →
+nothing upstream launches.
 
-Stage planning: contiguous runs of task-compatible narrow ops FUSE into
-one task per block (better than the reference's per-operator tasks — one
-scheduling round trip per block per fused run). An op with
-compute="actors" becomes its own actor-pool stage: a fixed pool of
-stateful workers (the TPU-host preprocessing shape: tokenizers, encoders,
-models that are expensive to construct per task).
+Launch admission is delegated to the backpressure-policy framework
+(`_internal/backpressure_policy.py`): before every task launch the
+stage asks each installed policy `can_launch(stage, usage)`; a refusal
+makes the stage drain an in-flight block to the consumer instead (or
+sleep, when its window is empty) and is counted into `Dataset.stats()`.
+The default policy set is a per-stage concurrency cap (the previous
+executor's global in-flight budget, split across stages) plus an
+arena-occupancy throttle, so a pipeline over a dataset far larger than
+the shm arena holds bounded occupancy.
+
+Every fused task and actor call also returns a small meta dict
+(rows/bytes in/out, task time, per-operator breakdown) as a second
+return value; the driver-side StatsBuilder assembles them into
+`Dataset.stats()` without ever pulling block data.
 """
 from __future__ import annotations
 
 import collections
-from typing import Any, Callable, Dict, Iterator, List, Optional
+import time
+from typing import Any, Dict, Iterator, List, Optional
 
 import ray_tpu
+from ray_tpu.data._internal import backpressure_policy as bp
+from ray_tpu.data._internal.optimizer import (
+    ActorStage,
+    LimitStage,
+    Stage,
+    TaskStage,
+    build_plan,
+)
+from ray_tpu.data._internal.stats import StatsBuilder
+from ray_tpu.data.context import DataContext
+
+_INPUT = "Input"
 
 
-def plan_stages(ops: Optional[List]) -> List[Dict[str, Any]]:
-    """Split an ops chain into executable stages at actor boundaries."""
-    stages: List[Dict[str, Any]] = []
-    run: List = []
+def _apply_fused_local(blk, ops):
+    """Run a fused operator run over one block, timing each operator.
+    Returns (block, meta) — shipped back as TWO objects so the meta
+    (ints/floats only) reaches the driver without the block."""
+    from ray_tpu.data._internal.logical_ops import as_op
+
+    rows_in, bytes_in = blk.num_rows, blk.nbytes
+    per_op: Dict[str, float] = {}
+    t0 = time.perf_counter()
     for op in ops or []:
-        kind, fn, kw = op
-        if kind == "map_batches" and kw.get("compute") == "actors":
-            if run:
-                stages.append({"kind": "tasks", "ops": run})
-                run = []
-            stages.append({"kind": "actors", "op": op})
-        else:
-            run.append(op)
-    if run:
-        stages.append({"kind": "tasks", "ops": run})
-    return stages
+        o = as_op(op)
+        ta = time.perf_counter()
+        blk = o.apply_block(blk)
+        per_op[o.name] = per_op.get(o.name, 0.0) + time.perf_counter() - ta
+    meta = {
+        "rows_in": rows_in,
+        "rows_out": blk.num_rows,
+        "bytes_in": bytes_in,
+        "bytes_out": blk.nbytes,
+        "task_s": time.perf_counter() - t0,
+        "per_op_s": per_op,
+    }
+    return blk, meta
+
+
+_apply_fused = ray_tpu.remote(_apply_fused_local)
 
 
 @ray_tpu.remote
@@ -59,40 +91,236 @@ class _MapWorker:
     def apply(self, blk, batch_format: str):
         from ray_tpu.data import block as B
 
-        out = self._fn(B.block_to_batch(blk, batch_format))
-        return B.batch_to_block(out)
+        rows_in, bytes_in = blk.num_rows, blk.nbytes
+        t0 = time.perf_counter()
+        out = B.batch_to_block(self._fn(B.block_to_batch(blk, batch_format)))
+        meta = {
+            "rows_in": rows_in,
+            "rows_out": out.num_rows,
+            "bytes_in": bytes_in,
+            "bytes_out": out.nbytes,
+            "task_s": time.perf_counter() - t0,
+            "per_op_s": {},
+        }
+        return out, meta
 
 
-def _task_stage(upstream: Iterator, ops: List, max_in_flight: int) -> Iterator:
-    """Fused narrow ops as one task per block, ≤ max_in_flight unconsumed
-    launches ahead of the consumer."""
-    from ray_tpu.data.dataset import _apply_ops
+def _gated(state: "_ExecState", name: str, buf, extra_full=None) -> Iterator:
+    """Shared admission gate: drain blocks to the consumer (or sleep on
+    an empty window) until the stage may launch again. `extra_full`
+    is an additional stage-local fullness predicate checked BEFORE
+    admission (e.g. the actor pool's per-actor cap — its refusals are
+    window mechanics, not policy throttles)."""
+    while (extra_full is not None and extra_full()) or not state.admit(name):
+        if buf:
+            state.consumed(name)
+            yield buf.popleft()
+        else:
+            time.sleep(state.poll_interval)
 
-    ops_ref = ray_tpu.put(ops)
-    inflight: collections.deque = collections.deque()
+
+class _ExecState:
+    """Shared per-execution state: policies, stats, in-flight counts,
+    the arena-usage probe and per-stage output-size estimates.
+
+    Size estimates: launched task metas are sampled nonblockingly
+    (`wait(timeout=0)`) as admission runs; a resolved meta teaches the
+    stage its output size (`bytes_out`) AND its predecessor the size of
+    the blocks it emits (`bytes_in`) — so the Input stage learns read
+    sizes without ever fetching a block. Unresolved metas charge
+    `pending_bytes` at the learned estimate, closing the launch-vs-seal
+    race that would otherwise let a burst overshoot the arena budget
+    before any sealed byte is visible (reference: streaming executor's
+    per-operator output-size estimates in resource budgeting)."""
+
+    def __init__(self, policies: List[bp.BackpressurePolicy], stats: StatsBuilder,
+                 poll_interval: float, stage_order: List[str],
+                 meta_stages: Optional[List[str]] = None):
+        self.policies = policies
+        self.stats = stats
+        self.poll_interval = poll_interval
+        self.inflight: Dict[str, int] = {}
+        self._order = list(stage_order)
+        # which stages return task metas (Task/Actor — not Input/Limit)
+        self._meta_stages = set(meta_stages if meta_stages is not None else stage_order[1:])
+        # a meta's bytes_in teaches the nearest upstream stage that OWNS
+        # launches (Input or another meta stage) — Limit stages pass refs
+        # through and must not swallow the lesson
+        self._pred: Dict[str, Optional[str]] = {}
+        for i, n in enumerate(self._order):
+            pred = None
+            for j in range(i - 1, -1, -1):
+                if j == 0 or self._order[j] in self._meta_stages:
+                    pred = self._order[j]
+                    break
+            self._pred[n] = pred
+        # slow-start only applies to stages whose size estimate CAN ever
+        # resolve: meta stages teach themselves; Input is taught by the
+        # first downstream meta. A plan with no meta stage (pure read,
+        # read+limit) would gate its reads at the slow-start cap forever.
+        self._teachable = set(self._meta_stages)
+        if self._meta_stages and self._order:
+            self._teachable.add(self._order[0])
+        self._pending_meta: Dict[str, List[Any]] = {}
+        # input-stage refs launched but not yet observed sealed — charged
+        # as pending; once a ref resolves its bytes show up in used_bytes
+        # and charging it again would double-count (throttling the source
+        # at half the configured budget)
+        self._pending_input: List[Any] = []
+        self._est: Dict[str, float] = {}
+        self._last_sample = 0.0
+        self._shm = None
+        try:
+            from ray_tpu._private.worker import get_global_core
+
+            core = get_global_core()
+            self._shm = getattr(core, "_shm", None)
+        except Exception:
+            self._shm = None
+
+    def _sample_metas(self):
+        # rate-limit: each unresolved ref costs a readiness probe, and
+        # admission spins call usage() every poll interval
+        now = time.perf_counter()
+        if now - self._last_sample < self.poll_interval:
+            return
+        self._last_sample = now
+        if self._pending_input:
+            try:
+                _, self._pending_input = ray_tpu.wait(
+                    self._pending_input, num_returns=len(self._pending_input), timeout=0
+                )
+            except Exception:
+                self._pending_input = []
+        for stage, refs in self._pending_meta.items():
+            if not refs:
+                continue
+            try:
+                ready, rest = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+            except Exception:
+                self._pending_meta[stage] = []
+                continue
+            self._pending_meta[stage] = rest
+            for ref in ready:
+                # per-ref get: one poisoned task must not discard the
+                # healthy metas fetched alongside it
+                try:
+                    m = ray_tpu.get(ref)
+                except Exception:
+                    continue
+                if not isinstance(m, dict):
+                    continue
+                self._est[stage] = max(self._est.get(stage, 0.0), float(m["bytes_out"]))
+                pred = self._pred.get(stage)
+                if pred is not None:
+                    self._est[pred] = max(self._est.get(pred, 0.0), float(m["bytes_in"]))
+
+    def usage(self) -> bp.ExecUsage:
+        used = cap = None
+        if self._shm is not None:
+            try:
+                u = self._shm.usage()
+                used, cap = u["used_bytes"], u["capacity_bytes"]
+            except Exception:
+                used = cap = None
+        self._sample_metas()
+        pending = 0.0
+        unsized: Dict[str, int] = {}
+        for stage, refs in self._pending_meta.items():
+            if stage in self._est:
+                pending += len(refs) * self._est[stage]
+            elif refs:
+                unsized[stage] = len(refs)
+        # input-stage launches have no task meta; the UNSEALED ones are
+        # charged at the learned read-block size (sealed reads already
+        # show up in used_bytes — charging them again would throttle the
+        # source at half the configured budget). Size unknown until the
+        # first downstream meta resolves → slow-started like the rest,
+        # but ONLY when a teacher exists: a plan with no task/actor
+        # stage would otherwise pin read concurrency at the slow-start
+        # cap for the whole run.
+        first = self._order[0] if self._order else None
+        if first is not None and self._pending_input:
+            if first in self._est:
+                pending += len(self._pending_input) * self._est[first]
+            elif first in self._teachable:
+                unsized[first] = len(self._pending_input)
+        unsized = {s: n for s, n in unsized.items() if s in self._teachable}
+        return bp.ExecUsage(self.inflight, used, cap, pending_bytes=int(pending),
+                            unsized_inflight=unsized)
+
+    def admit(self, stage: str) -> bool:
+        """One admission round; counts the refusing policy on failure."""
+        u = self.usage()
+        for p in self.policies:
+            if not p.can_launch(stage, u):
+                self.stats.throttled(stage, p.name)
+                return False
+        return True
+
+    def launched(self, stage: str, meta_ref=None, input_ref=None):
+        self.inflight[stage] = self.inflight.get(stage, 0) + 1
+        self.stats.task_launched(stage)
+        if meta_ref is not None:
+            self._pending_meta.setdefault(stage, []).append(meta_ref)
+        if input_ref is not None:
+            self._pending_input.append(input_ref)
+
+    def consumed(self, stage: str):
+        self.inflight[stage] = self.inflight.get(stage, 0) - 1
+
+
+def _input_stage(block_refs: List[Any], state: _ExecState, input_name: str) -> Iterator:
+    """Source stage: launches lazy reads inside its policy-gated window.
+    Transient force: read refs die once consumed downstream (a cached
+    force would pin every source block for the dataset's lifetime)."""
+    from ray_tpu.data.dataset import LazyBlock
+
+    buf: collections.deque = collections.deque()
+    for r in block_refs:
+        yield from _gated(state, input_name, buf)
+        ref = r.force_transient() if isinstance(r, LazyBlock) else r
+        buf.append(ref)
+        state.launched(input_name, input_ref=ref)
+    while buf:
+        state.consumed(input_name)
+        yield buf.popleft()
+
+
+def _task_stage(upstream: Iterator, stage: TaskStage, state: _ExecState) -> Iterator:
+    """Fused narrow ops as one task per block, policy-gated launches."""
+    ops_ref = ray_tpu.put(stage.ops)
+    # bind options once: per-block .options() would rebuild a wrapper
+    # (and its normalized resources) on every launch
+    fused = _apply_fused.options(num_returns=2)
+    buf: collections.deque = collections.deque()
     for ref in upstream:
-        while len(inflight) >= max_in_flight:
-            yield inflight.popleft()
-        inflight.append(_apply_ops.remote(ref, ops_ref))
-    while inflight:
-        yield inflight.popleft()
+        yield from _gated(state, stage.name, buf)
+        out, meta = fused.remote(ref, ops_ref)
+        state.launched(stage.name, meta)
+        state.stats.add_meta(stage.name, meta)
+        buf.append(out)
+    while buf:
+        state.consumed(stage.name)
+        yield buf.popleft()
 
 
-def _actor_stage(upstream: Iterator, op, max_in_flight_per_actor: int = 2) -> Iterator:
+def _actor_stage(upstream: Iterator, stage: ActorStage, state: _ExecState,
+                 max_in_flight_per_actor: int) -> Iterator:
     """Actor-pool map stage: blocks round-robin over a fixed pool of
     stateful workers; output order preserved (deterministic pipelines)."""
-    kind, fn, kw = op
-    n = int(kw.get("num_actors", 2))
-    actor_options = kw.get("ray_actor_options") or {}
+    op = stage.op
+    n = int(op.num_actors)
+    actor_options = op.ray_actor_options or {}
     actors = [
         _MapWorker.options(**actor_options).remote(
-            fn, kw.get("fn_constructor_args"), kw.get("fn_constructor_kwargs")
+            op.fn, op.fn_constructor_args, op.fn_constructor_kwargs
         )
         for _ in range(n)
     ]
-    batch_format = kw.get("batch_format", "numpy")
     cap = n * max_in_flight_per_actor
-    inflight: collections.deque = collections.deque()
+    applies = [a.apply.options(num_returns=2) for a in actors]
+    buf: collections.deque = collections.deque()
     # teardown barrier: per-actor calls execute IN ORDER, so the LAST
     # output of each actor completing implies all its earlier ones have.
     # (Holding every output ref alive for the barrier would pin the whole
@@ -101,14 +329,16 @@ def _actor_stage(upstream: Iterator, op, max_in_flight_per_actor: int = 2) -> It
     i = 0
     try:
         for ref in upstream:
-            while len(inflight) >= cap:
-                yield inflight.popleft()
-            out = actors[i % n].apply.remote(ref, batch_format)
+            yield from _gated(state, stage.name, buf, extra_full=lambda: len(buf) >= cap)
+            out, meta = applies[i % n].remote(ref, op.batch_format)
+            state.launched(stage.name, meta)
+            state.stats.add_meta(stage.name, meta)
             last_per_actor[i % n] = out
-            inflight.append(out)
+            buf.append(out)
             i += 1
-        while inflight:
-            yield inflight.popleft()
+        while buf:
+            state.consumed(stage.name)
+            yield buf.popleft()
     finally:
         # kill only after in-flight work drains — yielded refs may still
         # be executing on the pool when the generator is exhausted (or
@@ -126,36 +356,147 @@ def _actor_stage(upstream: Iterator, op, max_in_flight_per_actor: int = 2) -> It
                 pass
 
 
-def execute_streaming(
-    block_refs: List[Any], ops: Optional[List], *, max_in_flight: int = 8
-) -> Iterator[Any]:
-    """Pull-based execution of the whole chain: an iterator of output
-    block refs. `max_in_flight` is a GLOBAL in-flight-block budget split
-    across the stage windows (reference: backpressure_policy caps total
-    streaming-executor resources, not per-operator) — per-stage windows
-    would compose additively and overshoot the arena on deep chains."""
-    stages = plan_stages(ops)
-    n_windows = 1 + sum(1 for s in stages if s["kind"] == "tasks")
-    per = max(1, max_in_flight // max(1, n_windows))
+def _limit_stage(upstream: Iterator, stage: LimitStage, state: _ExecState) -> Iterator:
+    """Global first-n-rows: stops pulling upstream once the budget is
+    met (closing upstream generators → no further launches, actor pools
+    torn down) and slices the boundary block in a task. Only row COUNTS
+    cross to the driver — at the price of one synchronous count
+    round-trip per block, acceptable because a limit bounds the block
+    count by construction. The budget is checked BEFORE each pull so no
+    upstream task runs beyond the needed prefix."""
+    from ray_tpu.data.dataset import _block_num_rows, _slice_rows
 
-    def _sources() -> Iterator:
-        from ray_tpu.data.dataset import LazyBlock
-
-        buf: collections.deque = collections.deque()
-        for r in block_refs:
-            # transient force: lazy reads launch here, inside the window,
-            # and their refs die once consumed (a cached force would pin
-            # every source block for the dataset's lifetime)
-            buf.append(r.force_transient() if isinstance(r, LazyBlock) else r)
-            if len(buf) >= per:
-                yield buf.popleft()
-        while buf:
-            yield buf.popleft()
-
-    it: Iterator = _sources()
-    for stage in stages:
-        if stage["kind"] == "tasks":
-            it = _task_stage(it, stage["ops"], per)
+    remaining = stage.n
+    it = iter(upstream)
+    while remaining > 0:
+        ref = next(it, None)
+        if ref is None:
+            return
+        nrows = ray_tpu.get(_block_num_rows.remote(ref))
+        if nrows <= remaining:
+            remaining -= nrows
+            state.stats.add_driver_counts(stage.name, rows_out=nrows)
+            yield ref
         else:
-            it = _actor_stage(it, stage["op"], max_in_flight_per_actor=1)
-    return it
+            state.stats.task_launched(stage.name)
+            state.stats.add_driver_counts(stage.name, rows_out=remaining)
+            yield _slice_rows.remote(ref, 0, remaining)
+            remaining = 0
+
+
+def _default_policies(ctx: DataContext, plan: List[Stage], per_stage_window: int,
+                      input_name: str) -> List[bp.BackpressurePolicy]:
+    caps = {input_name: per_stage_window}
+    for s in plan:
+        if isinstance(s, TaskStage):
+            caps[s.name] = per_stage_window
+        elif isinstance(s, ActorStage):
+            # the actor stage's own n*per_actor cap is enforced in-stage;
+            # this cap only keeps the shared policy view consistent
+            caps[s.name] = int(s.op.num_actors) * ctx.actor_max_tasks_in_flight
+    policies: List[bp.BackpressurePolicy] = [
+        bp.ConcurrencyCapPolicy(caps, default_cap=per_stage_window)
+    ]
+    if ctx.arena_usage_fraction is not None or ctx.arena_usage_budget_bytes is not None:
+        policies.append(
+            bp.ArenaUsagePolicy(
+                # explicit None check: fraction=0.0 must mean "throttle
+                # above zero occupancy", not silently disable
+                fraction=1.0 if ctx.arena_usage_fraction is None else ctx.arena_usage_fraction,
+                budget_bytes=ctx.arena_usage_budget_bytes,
+            )
+        )
+    policies.extend(ctx.extra_backpressure_policies)
+    return policies
+
+
+def execute_streaming(
+    block_refs: List[Any],
+    ops: Optional[List],
+    *,
+    max_in_flight: Optional[int] = None,
+    owner=None,
+    input_name: str = _INPUT,
+) -> Iterator[Any]:
+    """Pull-based execution of the whole plan: an iterator of output
+    block refs. `max_in_flight` (default: DataContext.max_in_flight_blocks)
+    is a GLOBAL in-flight-block budget split across the stage windows
+    (reference: backpressure_policy caps total streaming-executor
+    resources, not per-operator) — per-stage windows would compose
+    additively and overshoot the arena on deep chains. `owner` (a
+    Dataset) receives the StatsBuilder for `stats()`."""
+    ctx = DataContext.get_current()
+    if max_in_flight is None:
+        max_in_flight = ctx.max_in_flight_blocks
+    plan = build_plan(ops, fusion=ctx.operator_fusion,
+                      limit_pushdown=ctx.limit_pushdown)
+    n_windows = 1 + sum(1 for s in plan if isinstance(s, TaskStage))
+    per = max(1, max_in_flight // max(1, n_windows))
+    stats = StatsBuilder([input_name] + [s.name for s in plan])
+    state = _ExecState(
+        _default_policies(ctx, plan, per, input_name),
+        stats,
+        ctx.backpressure_poll_interval_s,
+        [input_name] + [s.name for s in plan],
+        meta_stages=[s.name for s in plan if isinstance(s, (TaskStage, ActorStage))],
+    )
+    if owner is not None:
+        owner._stats_builder = stats
+
+    def _run() -> Iterator:
+        it: Iterator = _input_stage(block_refs, state, input_name)
+        for stage in plan:
+            if isinstance(stage, TaskStage):
+                it = _task_stage(it, stage, state)
+            elif isinstance(stage, ActorStage):
+                it = _actor_stage(it, stage, state, ctx.actor_max_tasks_in_flight)
+            else:
+                it = _limit_stage(it, stage, state)
+        try:
+            for ref in it:
+                yield ref
+        finally:
+            stats.finalize()
+
+    return _run()
+
+
+def execute_eager(
+    block_refs: List[Any],
+    ops: Optional[List],
+    *,
+    owner=None,
+    input_name: str = _INPUT,
+) -> List[Any]:
+    """Launch the whole plan at max parallelism; returns transformed
+    block refs without waiting. Plans needing pipelined stages (actor
+    pools, limits) fall back to a wide streaming window."""
+    from ray_tpu.data.dataset import _force
+
+    ctx = DataContext.get_current()
+    plan = build_plan(ops, fusion=ctx.operator_fusion,
+                      limit_pushdown=ctx.limit_pushdown)
+    if not plan:
+        return [_force(r) for r in block_refs]
+    if len(plan) == 1 and isinstance(plan[0], TaskStage):
+        stage = plan[0]
+        stats = StatsBuilder([input_name, stage.name])
+        if owner is not None:
+            owner._stats_builder = stats
+        ops_ref = ray_tpu.put(stage.ops)
+        fused = _apply_fused.options(num_returns=2)
+        out = []
+        for r in block_refs:
+            ref, meta = fused.remote(_force(r), ops_ref)
+            stats.task_launched(input_name)
+            stats.task_launched(stage.name)
+            stats.add_meta(stage.name, meta)
+            out.append(ref)
+        stats.mark_launches_complete()
+        return out
+    return list(
+        execute_streaming(
+            block_refs, ops, max_in_flight=ctx.eager_max_in_flight,
+            owner=owner, input_name=input_name,
+        )
+    )

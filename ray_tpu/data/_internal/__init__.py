@@ -1,0 +1,9 @@
+"""ray_tpu.data._internal — data-execution internals.
+
+Equivalent of the reference's `python/ray/data/_internal/`: the logical
+plan (`logical_ops.py`), the plan optimizer (`optimizer.py` — operator
+fusion + limit/projection pushdown), the backpressure-policy framework
+(`backpressure_policy.py`) and execution stats (`stats.py`). The
+streaming executor itself lives in `ray_tpu/data/_executor.py` and
+plans over these pieces.
+"""

@@ -1,0 +1,120 @@
+"""Backpressure policies — pluggable launch admission for the executor.
+
+Equivalent of the reference's backpressure-policy framework (reference:
+python/ray/data/_internal/execution/backpressure_policy/ —
+ConcurrencyCapBackpressurePolicy + ResourceBudgetBackpressurePolicy,
+each answering `can_add_input(op)` from shared resource state). Before
+launching a task for a stage, the executor asks EVERY installed policy
+`can_launch(stage, usage)`; any refusal defers the launch (the executor
+drains an in-flight block to the consumer instead, or sleeps) and is
+counted per stage per policy into `Dataset.stats()`.
+
+Two concrete policies:
+
+- `ConcurrencyCapPolicy` — per-stage in-flight window (the previous
+  executor's single global budget, split across stages, reframed as a
+  policy).
+- `ArenaUsagePolicy` — polls shm-arena occupancy
+  (`ShmStore.usage()`) and refuses launches while used bytes exceed a
+  budget fraction of capacity. Consumption releases blocks (refcount GC),
+  usage falls, launches resume — so a pipeline over a dataset far larger
+  than the arena holds bounded occupancy instead of racing the LRU
+  evictor. A stage with ZERO in-flight tasks is always admitted (progress
+  guarantee: occupancy from foreign objects can never wedge the pipeline).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class ExecUsage:
+    """Point-in-time resource snapshot handed to policies.
+
+    `pending_bytes` is the executor's conservative estimate of output
+    bytes from launched-but-not-yet-sealed tasks (learned from completed
+    task metas) — admission must charge them or a launch burst races
+    ahead of what `arena_used_bytes` can see. `unsized_inflight` counts
+    a stage's outstanding launches whose output size is still UNKNOWN
+    (no completed task has taught the estimate yet): the arena policy
+    slow-starts those, since they are invisible to both accounts.
+    """
+
+    __slots__ = ("inflight", "arena_used_bytes", "arena_capacity_bytes",
+                 "pending_bytes", "unsized_inflight")
+
+    def __init__(
+        self,
+        inflight: Dict[str, int],
+        arena_used_bytes: Optional[int] = None,
+        arena_capacity_bytes: Optional[int] = None,
+        pending_bytes: int = 0,
+        unsized_inflight: Optional[Dict[str, int]] = None,
+    ):
+        self.inflight = inflight
+        self.arena_used_bytes = arena_used_bytes
+        self.arena_capacity_bytes = arena_capacity_bytes
+        self.pending_bytes = pending_bytes
+        self.unsized_inflight = unsized_inflight or {}
+
+    def stage_inflight(self, stage: str) -> int:
+        return self.inflight.get(stage, 0)
+
+
+class BackpressurePolicy:
+    """Interface: refuse launches for a stage given current usage."""
+
+    name = "backpressure"
+
+    def can_launch(self, stage: str, usage: ExecUsage) -> bool:
+        raise NotImplementedError
+
+
+class ConcurrencyCapPolicy(BackpressurePolicy):
+    """Cap a stage's unconsumed in-flight launches at its window."""
+
+    name = "concurrency_cap"
+
+    def __init__(self, caps: Dict[str, int], default_cap: int = 8):
+        self._caps = dict(caps)
+        self._default = default_cap
+
+    def cap(self, stage: str) -> int:
+        return self._caps.get(stage, self._default)
+
+    def can_launch(self, stage: str, usage: ExecUsage) -> bool:
+        return usage.stage_inflight(stage) < self.cap(stage)
+
+
+class ArenaUsagePolicy(BackpressurePolicy):
+    """Throttle launches while shm-arena occupancy exceeds the budget.
+
+    budget = `budget_bytes` if given, else `fraction` x arena capacity.
+    Admission charges sealed bytes PLUS the executor's pending-output
+    estimate, and slow-starts a stage (≤ `slow_start` outstanding
+    launches) until a completed task has taught its output size — both
+    guards close the launch-vs-seal race in which a full window of
+    launches overshoots the budget before any sealed byte is visible.
+    """
+
+    name = "arena_usage"
+
+    def __init__(self, fraction: float = 0.75, budget_bytes: Optional[int] = None,
+                 slow_start: int = 2):
+        self.fraction = fraction
+        self.budget_bytes = budget_bytes
+        self.slow_start = slow_start
+
+    def budget(self, capacity: int) -> int:
+        if self.budget_bytes is not None:
+            return self.budget_bytes
+        return int(self.fraction * capacity)
+
+    def can_launch(self, stage: str, usage: ExecUsage) -> bool:
+        if usage.arena_capacity_bytes is None:
+            return True  # no arena visible from this process: stand down
+        if usage.stage_inflight(stage) == 0:
+            return True  # progress guarantee
+        if usage.unsized_inflight.get(stage, 0) >= self.slow_start:
+            return False  # unknown output size: probe before bursting
+        committed = usage.arena_used_bytes + usage.pending_bytes
+        return committed <= self.budget(usage.arena_capacity_bytes)

@@ -1,0 +1,269 @@
+"""Typed logical operators — the nodes of a Dataset's logical plan.
+
+Equivalent of the reference's logical operator tree (reference:
+python/ray/data/_internal/logical/operators/map_operator.py etc. — there
+transformations build `LogicalOperator` nodes that the planner lowers to
+physical operators). Here each Dataset holds a linear chain of these
+objects; the optimizer (`optimizer.py`) rewrites the chain (pushdown,
+fusion) and the executor lowers it to task / actor-pool stages.
+
+Every operator knows how to apply itself to one Arrow block
+(`apply_block`), so a fused run of operators executes as ONE remote task
+per block — the single dispatch point shared by the streaming executor,
+the shuffle map stages and the preprocessor fit tasks. Operators are
+cloudpickled into the object store once per execution and fanned out to
+tasks by ref.
+
+Legacy `(kind, fn, kw)` tuples (the pre-plan representation, still a
+valid input to `_apply_ops_local`) are upgraded via `as_op`.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _callable_name(fn) -> str:
+    n = getattr(fn, "__name__", None) or type(fn).__name__
+    return n if n != "<lambda>" else "fn"
+
+
+class LogicalOp:
+    """One node of the logical plan.
+
+    kind: stable string id (matches the legacy tuple kinds).
+    fusable: may join a fused one-task-per-block run.
+    limit_pushdown_safe: a Limit may hop left past this op — requires
+    BOTH that the op preserves row count AND that its fn never sees
+    beyond the row it produces (batch-level aggregates would change
+    under reordering).
+    """
+
+    kind: str = "?"
+    fusable: bool = True
+    limit_pushdown_safe: bool = False
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def apply_block(self, blk):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.name
+
+
+class MapRows(LogicalOp):
+    kind = "map"
+    limit_pushdown_safe = True  # per-row fn
+
+    def __init__(self, fn: Callable[[Dict], Dict]):
+        self.fn = fn
+
+    @property
+    def name(self):
+        return f"Map({_callable_name(self.fn)})"
+
+    def apply_block(self, blk):
+        from ray_tpu.data import block as B
+
+        return B.to_block([self.fn(r) for r in B.block_rows(blk)])
+
+
+class MapBatches(LogicalOp):
+    kind = "map_batches"
+
+    def __init__(self, fn, *, batch_format: str = "numpy",
+                 compute: Optional[str] = None, num_actors: int = 2,
+                 fn_constructor_args=None, fn_constructor_kwargs=None,
+                 ray_actor_options: Optional[Dict] = None):
+        self.fn = fn
+        self.batch_format = batch_format
+        self.compute = compute
+        self.num_actors = num_actors
+        self.fn_constructor_args = fn_constructor_args
+        self.fn_constructor_kwargs = fn_constructor_kwargs
+        self.ray_actor_options = ray_actor_options
+
+    @property
+    def is_actor_pool(self) -> bool:
+        return self.compute == "actors"
+
+    @property
+    def fusable(self) -> bool:  # type: ignore[override]
+        return not self.is_actor_pool
+
+    @property
+    def name(self):
+        tag = "ActorMapBatches" if self.is_actor_pool else "MapBatches"
+        return f"{tag}({_callable_name(self.fn)})"
+
+    def apply_block(self, blk):
+        from ray_tpu.data import block as B
+
+        out = self.fn(B.block_to_batch(blk, self.batch_format))
+        return B.batch_to_block(out)
+
+
+class FlatMap(LogicalOp):
+    kind = "flat_map"
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    @property
+    def name(self):
+        return f"FlatMap({_callable_name(self.fn)})"
+
+    def apply_block(self, blk):
+        from ray_tpu.data import block as B
+
+        rows = []
+        for r in B.block_rows(blk):
+            rows.extend(self.fn(r))
+        return B.to_block(rows)
+
+
+class Filter(LogicalOp):
+    kind = "filter"
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    @property
+    def name(self):
+        return f"Filter({_callable_name(self.fn)})"
+
+    def apply_block(self, blk):
+        from ray_tpu.data import block as B
+
+        return B.to_block([r for r in B.block_rows(blk) if self.fn(r)])
+
+
+class AddColumn(LogicalOp):
+    kind = "add_column"
+    # row count IS preserved, but the column fn receives the whole block
+    # as a pandas batch — a batch-level aggregate (df.x - df.x.mean())
+    # would see only the surviving rows if a Limit hopped past it, so
+    # limit pushdown must not reorder around this op
+
+    def __init__(self, col: str, fn):
+        self.col = col
+        self.fn = fn
+
+    @property
+    def name(self):
+        return f"AddColumn({self.col})"
+
+    def apply_block(self, blk):
+        import pyarrow as pa
+
+        from ray_tpu.data import block as B
+
+        vals = self.fn(B.block_to_batch(blk, "pandas"))
+        return blk.append_column(self.col, pa.array(list(vals)))
+
+
+class DropColumns(LogicalOp):
+    kind = "drop_columns"
+    limit_pushdown_safe = True
+
+    def __init__(self, cols: List[str]):
+        self.cols = list(cols)
+
+    @property
+    def name(self):
+        return f"DropColumns({','.join(self.cols)})"
+
+    def apply_block(self, blk):
+        return blk.drop_columns(self.cols)
+
+
+class SelectColumns(LogicalOp):
+    kind = "select_columns"
+    limit_pushdown_safe = True
+
+    def __init__(self, cols: List[str]):
+        self.cols = list(cols)
+
+    @property
+    def name(self):
+        return f"SelectColumns({','.join(self.cols)})"
+
+    def apply_block(self, blk):
+        return blk.select(self.cols)
+
+
+class RenameColumns(LogicalOp):
+    kind = "rename_columns"
+    limit_pushdown_safe = True
+
+    def __init__(self, mapping: Dict[str, str]):
+        self.mapping = dict(mapping)
+
+    @property
+    def name(self):
+        return "RenameColumns"
+
+    def apply_block(self, blk):
+        return blk.rename_columns([self.mapping.get(c, c) for c in blk.column_names])
+
+
+class Limit(LogicalOp):
+    """Global first-n-rows. NOT fusable: the executor enforces the global
+    budget (stop pulling upstream, slice the boundary block); shuffle
+    paths must resolve it before shipping the chain to per-block map
+    tasks (Dataset._exchange_inputs). apply_block is only the per-block
+    UPPER BOUND n-rows slice, never the whole semantics."""
+
+    kind = "limit"
+    fusable = False
+
+    def __init__(self, n: int):
+        self.n = int(n)
+
+    @property
+    def name(self):
+        return f"Limit[{self.n}]"
+
+    def apply_block(self, blk):
+        return blk.slice(0, min(self.n, blk.num_rows))
+
+
+_LEGACY = {
+    "map": lambda fn, kw: MapRows(fn),
+    "map_batches": lambda fn, kw: MapBatches(
+        fn,
+        batch_format=kw.get("batch_format", "numpy"),
+        compute=kw.get("compute"),
+        num_actors=int(kw.get("num_actors", 2)),
+        fn_constructor_args=kw.get("fn_constructor_args"),
+        fn_constructor_kwargs=kw.get("fn_constructor_kwargs"),
+        ray_actor_options=kw.get("ray_actor_options"),
+    ),
+    "flat_map": lambda fn, kw: FlatMap(fn),
+    "filter": lambda fn, kw: Filter(fn),
+    "add_column": lambda fn, kw: AddColumn(fn[0], fn[1]),
+    "drop_columns": lambda fn, kw: DropColumns(fn),
+    "select_columns": lambda fn, kw: SelectColumns(fn),
+    "rename_columns": lambda fn, kw: RenameColumns(fn),
+}
+
+
+def as_op(op) -> LogicalOp:
+    """Upgrade a legacy (kind, fn, kw) tuple to a LogicalOp; pass typed
+    operators through."""
+    if isinstance(op, LogicalOp):
+        return op
+    kind, fn, kw = op
+    try:
+        return _LEGACY[kind](fn, kw or {})
+    except KeyError:
+        raise ValueError(f"unknown op {kind}") from None
+
+
+def apply_ops(blk, ops) -> Any:
+    """Run a chain of logical ops (or legacy tuples) over one block."""
+    for op in ops or []:
+        blk = as_op(op).apply_block(blk)
+    return blk

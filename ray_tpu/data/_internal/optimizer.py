@@ -1,0 +1,168 @@
+"""Logical-plan optimizer + physical planning.
+
+Equivalent of the reference's logical optimizer rules + planner
+(reference: python/ray/data/_internal/logical/rules/operator_fusion.py
+and .../limit_pushdown.py — there rewrite rules run over the logical DAG
+before physical planning). The chain here is linear, so rules are bubble
+passes over a list:
+
+1. **Limit pushdown** — a `Limit` moves left past any
+   `limit_pushdown_safe` operator (per-row map, projections — NOT
+   add_column, whose fn sees the whole batch and would observe fewer
+   rows after a reorder), and adjacent limits merge to their min. A
+   limit that reaches the front of the chain stops SOURCE READS: the
+   executor pulls no more lazy blocks once the budget is met, so
+   `read_parquet(...).limit(k)` launches only the prefix of read tasks.
+   (Projections are deliberately NOT hopped rightward past limits —
+   the two rules would ping-pong; limit moving left subsumes the win.)
+2. **Projection merges** — adjacent select/select (when provably
+   narrowing) and drop/drop runs collapse. (True projection pushdown
+   INTO reads needs column-aware readers; the read tasks here produce
+   whole files, so the projection stops at the first task stage.)
+3. **Operator fusion** — contiguous runs of fusable narrow operators
+   become ONE `TaskStage`: one task per block for the whole run instead
+   of one task per operator per block (reference: operator_fusion.py
+   fusing Map->Map chains into a single MapOperator).
+
+`build_plan` lowers the optimized chain to physical stages the executor
+walks: `TaskStage` (fused task per block), `ActorStage` (stateful
+actor-pool map) and `LimitStage` (driver-enforced global row budget).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ray_tpu.data._internal.logical_ops import (
+    DropColumns,
+    Limit,
+    LogicalOp,
+    MapBatches,
+    SelectColumns,
+    as_op,
+)
+
+
+class Stage:
+    name: str = "?"
+
+    def __repr__(self):
+        return self.name
+
+
+class TaskStage(Stage):
+    """A fused run of narrow ops: one task per block."""
+
+    def __init__(self, ops: List[LogicalOp]):
+        self.ops = ops
+        self.name = "->".join(op.name for op in ops)
+
+
+class ActorStage(Stage):
+    """A stateful actor-pool map_batches stage."""
+
+    def __init__(self, op: MapBatches):
+        self.op = op
+        self.name = op.name
+
+
+class LimitStage(Stage):
+    """Global first-n-rows, enforced by the executor (stops upstream
+    pulls, slices the boundary block in a task)."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.name = f"Limit[{n}]"
+
+
+def optimize(ops: List[LogicalOp], *, limit_pushdown: bool = True) -> List[LogicalOp]:
+    """Rewrite the logical chain: limit pushdown + merges. Pure —
+    returns a new list, never mutates operators."""
+    out = list(ops)
+    if not limit_pushdown:
+        return out
+    changed = True
+    while changed:
+        changed = False
+        i = 1
+        while i < len(out):
+            cur, prev = out[i], out[i - 1]
+            if isinstance(cur, Limit) and isinstance(prev, Limit):
+                out[i - 1 : i + 1] = [Limit(min(cur.n, prev.n))]
+                changed = True
+                continue
+            if isinstance(cur, Limit) and prev.limit_pushdown_safe:
+                out[i - 1], out[i] = cur, prev
+                changed = True
+                i += 1
+                continue
+            if (
+                isinstance(cur, SelectColumns)
+                and isinstance(prev, SelectColumns)
+                and set(cur.cols) <= set(prev.cols)
+            ):
+                # select(b) after select(a), b ⊆ a — the outer projection
+                # subsumes the inner one (b ⊄ a would have raised anyway,
+                # but only the provably-narrowing case is rewritten)
+                out[i - 1 : i + 1] = [cur]
+                changed = True
+                continue
+            if isinstance(cur, DropColumns) and isinstance(prev, DropColumns):
+                out[i - 1 : i + 1] = [DropColumns(prev.cols + [c for c in cur.cols if c not in prev.cols])]
+                changed = True
+                continue
+            i += 1
+    return out
+
+
+def build_plan(
+    ops: Optional[List],
+    *,
+    fusion: bool = True,
+    limit_pushdown: bool = True,
+) -> List[Stage]:
+    """Lower an ops chain (typed or legacy tuples) to physical stages."""
+    typed = [as_op(op) for op in ops or []]
+    typed = optimize(typed, limit_pushdown=limit_pushdown)
+    stages: List[Stage] = []
+    run: List[LogicalOp] = []
+
+    def flush():
+        nonlocal run
+        if run:
+            if fusion:
+                stages.append(TaskStage(run))
+            else:
+                stages.extend(TaskStage([op]) for op in run)
+            run = []
+
+    for op in typed:
+        if isinstance(op, MapBatches) and op.is_actor_pool:
+            flush()
+            stages.append(ActorStage(op))
+        elif isinstance(op, Limit):
+            flush()
+            stages.append(LimitStage(op.n))
+        else:
+            run.append(op)
+    flush()
+    # stage names key the shared in-flight counters, caps and stats —
+    # two same-shaped stages (e.g. twin lambda map_batches) MUST NOT
+    # alias each other's window or the pipeline deadlocks
+    seen: dict = {}
+    for s in stages:
+        n = seen.get(s.name, 0)
+        seen[s.name] = n + 1
+        if n:
+            s.name = f"{s.name}#{n + 1}"
+    return stages
+
+
+def has_actor_stage(ops: Optional[List]) -> bool:
+    return any(
+        isinstance(o, MapBatches) and o.is_actor_pool
+        for o in (as_op(op) for op in ops or [])
+    )
+
+
+def has_limit(ops: Optional[List]) -> bool:
+    return any(isinstance(as_op(op), Limit) for op in ops or [])

@@ -1,0 +1,202 @@
+"""Execution stats — per-operator timings, task counts and throttles.
+
+Equivalent of the reference's `Dataset.stats()` machinery (reference:
+python/ray/data/_internal/stats.py — DatasetStats aggregating per-block
+metadata from task-side timers into a per-operator summary string). Each
+fused task / actor call returns a second small object (its meta dict:
+rows/bytes in/out, task wall time, a per-operator time breakdown inside
+the fused run) via `num_returns=2`, so only integers and floats ever
+cross back to the driver. The driver-side `StatsBuilder` accumulates
+launch counts and backpressure throttles as the executor runs, then
+`build()` resolves the meta refs into an immutable `DatasetStats` —
+rendered as a human-readable report (str) and a plain dict
+(`to_dict()`) for programmatic assertions.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+class DatasetStats:
+    """Immutable per-execution stats: ordered {stage name: metrics}."""
+
+    def __init__(self, operators: "Dict[str, Dict[str, Any]]",
+                 total_wall_s: float, executed: bool = True):
+        self.operators = operators
+        self.total_wall_s = total_wall_s
+        self.executed = executed
+
+    def to_dict(self) -> Dict[str, Any]:
+        throttles: Dict[str, int] = {}
+        for m in self.operators.values():
+            for pol, n in m.get("throttled", {}).items():
+                throttles[pol] = throttles.get(pol, 0) + n
+        return {
+            "executed": self.executed,
+            "operators": {k: dict(v) for k, v in self.operators.items()},
+            "total_wall_s": self.total_wall_s,
+            "total_tasks": sum(m.get("tasks", 0) for m in self.operators.values()),
+            "backpressure_throttles": throttles,
+        }
+
+    def summary(self) -> str:
+        if not self.executed:
+            return "Dataset stats: not executed yet (iterate or materialize first)"
+        lines = [f"Dataset execution stats ({self.total_wall_s * 1e3:.0f}ms total):"]
+        for i, (name, m) in enumerate(self.operators.items()):
+            parts = [f"{m.get('tasks', 0)} tasks"]
+            if m.get("task_s") is not None:
+                parts.append(f"{m['task_s'] * 1e3:.0f}ms task time")
+            if m.get("rows_in") is not None:
+                parts.append(f"{m['rows_in']}->{m['rows_out']} rows")
+            elif m.get("rows_out") is not None:
+                # limit stages count rows driver-side only (no task meta)
+                parts.append(f"{m['rows_out']} rows out")
+            if m.get("bytes_in") is not None:
+                parts.append(f"{_fmt_bytes(m['bytes_in'])}->{_fmt_bytes(m['bytes_out'])}")
+            if m.get("throttled"):
+                th = ", ".join(f"{k}: {v}" for k, v in m["throttled"].items())
+                parts.append(f"throttled({th})")
+            lines.append(f"  Operator {i} {name}: " + ", ".join(parts))
+            for op_name, s in (m.get("per_op_s") or {}).items():
+                lines.append(f"    - {op_name}: {s * 1e3:.0f}ms")
+        return "\n".join(lines)
+
+    __str__ = summary
+
+    def __repr__(self):
+        return self.summary()
+
+
+EMPTY_STATS = DatasetStats({}, 0.0, executed=False)
+
+
+class StatsBuilder:
+    """Mutable driver-side accumulator: one per execution.
+
+    Meta refs resolve lazily in build() — the executor never blocks the
+    pipeline on stats fetches; `Dataset.stats()` pays the (tiny-object)
+    gets when asked.
+    """
+
+    def __init__(self, stage_names: List[str]):
+        self._order = list(stage_names)
+        self._tasks: Dict[str, int] = {n: 0 for n in self._order}
+        self._throttled: Dict[str, Dict[str, int]] = {n: {} for n in self._order}
+        self._meta_refs: Dict[str, List[Any]] = {n: [] for n in self._order}
+        self._driver_counts: Dict[str, Dict[str, int]] = {}
+        self.t_start = time.perf_counter()
+        self.t_end: Optional[float] = None
+        self._finalized = False
+        self._launches_complete = False
+        self._built: Optional[DatasetStats] = None
+
+    def _ensure(self, stage: str):
+        if stage not in self._tasks:
+            self._order.append(stage)
+            self._tasks[stage] = 0
+            self._throttled[stage] = {}
+            self._meta_refs[stage] = []
+
+    def task_launched(self, stage: str, n: int = 1):
+        self._ensure(stage)
+        self._tasks[stage] += n
+
+    def throttled(self, stage: str, policy: str):
+        self._ensure(stage)
+        t = self._throttled[stage]
+        t[policy] = t.get(policy, 0) + 1
+
+    def add_meta(self, stage: str, meta_ref):
+        self._ensure(stage)
+        self._meta_refs[stage].append(meta_ref)
+
+    def add_driver_counts(self, stage: str, **counts: int):
+        self._ensure(stage)
+        d = self._driver_counts.setdefault(stage, {})
+        for k, v in counts.items():
+            d[k] = d.get(k, 0) + v
+
+    def mark_launches_complete(self):
+        """Eager path: every task has been LAUNCHED (though maybe not
+        finished). Once their metas all resolve, the snapshot is final
+        and may cache."""
+        self._launches_complete = True
+
+    def finalize(self):
+        """Mark the execution complete (called by the executor when the
+        pipeline drains or is closed). Only finalized builders cache
+        their built snapshot."""
+        if self.t_end is None:
+            self.t_end = time.perf_counter()
+        self._finalized = True
+        self._launches_complete = True
+
+    def build(self, *, timeout: float = 120.0) -> DatasetStats:
+        """Resolve task-side metas into a snapshot. A stats() call
+        MID-execution sees the progress so far and must not freeze it:
+        only a finalized execution caches (and skips refetching on
+        repeated calls)."""
+        if self._built is not None:
+            return self._built
+        import ray_tpu
+
+        t_end = self.t_end if self.t_end is not None else time.perf_counter()
+        all_resolved = True
+        operators: Dict[str, Dict[str, Any]] = {}
+        for name in self._order:
+            m: Dict[str, Any] = {
+                "tasks": self._tasks[name],
+                "throttled": dict(self._throttled[name]),
+            }
+            refs = self._meta_refs[name]
+            if refs:
+                try:
+                    ready, not_ready = ray_tpu.wait(refs, num_returns=len(refs), timeout=timeout)
+                except Exception:
+                    ready, not_ready = [], refs
+                if not_ready:
+                    all_resolved = False
+                metas = []
+                for ref in ready:
+                    # per-ref get: a failed task's meta raises its error;
+                    # the healthy tasks' metas must still be counted
+                    try:
+                        meta = ray_tpu.get(ref)
+                    except Exception:
+                        continue
+                    if isinstance(meta, dict):
+                        metas.append(meta)
+                if metas:
+                    m["rows_in"] = sum(x["rows_in"] for x in metas)
+                    m["rows_out"] = sum(x["rows_out"] for x in metas)
+                    m["bytes_in"] = sum(x["bytes_in"] for x in metas)
+                    m["bytes_out"] = sum(x["bytes_out"] for x in metas)
+                    m["task_s"] = sum(x["task_s"] for x in metas)
+                    per: Dict[str, float] = {}
+                    for x in metas:
+                        for k, v in (x.get("per_op_s") or {}).items():
+                            per[k] = per.get(k, 0.0) + v
+                    if per:
+                        m["per_op_s"] = per
+            for k, v in self._driver_counts.get(name, {}).items():
+                m[k] = m.get(k, 0) + v
+            operators[name] = m
+        built = DatasetStats(operators, t_end - self.t_start)
+        # cache a finalized execution's snapshot; an eager execution
+        # (all launches issued, never stream-finalized) caches once
+        # every task meta resolved — repeated stats() calls must not
+        # refetch or drift the wall time. A mid-stream snapshot (more
+        # launches may come) is never cached.
+        if self._finalized or (self._launches_complete and all_resolved):
+            self._built = built
+        return built

@@ -1,0 +1,49 @@
+"""DataContext — process-wide execution knobs for ray_tpu.data.
+
+Equivalent of the reference's DataContext (reference:
+python/ray/data/context.py — a singleton of execution options the
+planner and executor consult). Mutate the singleton to tune a pipeline:
+
+    ctx = ray_tpu.data.DataContext.get_current()
+    ctx.arena_usage_fraction = 0.5   # throttle launches above 50% arena
+    ctx.operator_fusion = False      # debug: one task per operator
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class DataContext:
+    """Singleton of data-execution options."""
+
+    _current: Optional["DataContext"] = None
+
+    def __init__(self):
+        # -- plan optimization ------------------------------------------
+        self.operator_fusion: bool = True     # fuse narrow-op runs into one task/block
+        self.limit_pushdown: bool = True      # move Limit toward the sources
+
+        # -- backpressure ----------------------------------------------
+        # global streaming in-flight budget, split across stage windows
+        # (iter_batches derives its own from prefetch_blocks)
+        self.max_in_flight_blocks: int = 8
+        # eager materialization window when a plan needs streaming stages
+        self.eager_max_in_flight: int = 16
+        # arena-usage policy: throttle launches above this fraction of
+        # shm-arena capacity (None disables the policy)
+        self.arena_usage_fraction: Optional[float] = 0.75
+        # absolute byte budget overriding the fraction (tests / tight SLAs)
+        self.arena_usage_budget_bytes: Optional[int] = None
+        # driver poll interval while a policy refuses launches
+        self.backpressure_poll_interval_s: float = 0.002
+        # extra policies appended to the defaults (BackpressurePolicy)
+        self.extra_backpressure_policies: List = []
+
+        # -- actor-pool stages -----------------------------------------
+        self.actor_max_tasks_in_flight: int = 2
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._current is None:
+            cls._current = DataContext()
+        return cls._current

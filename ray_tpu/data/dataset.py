@@ -1,13 +1,16 @@
 """Dataset — lazy, block-parallel distributed data.
 
 Equivalent of the reference's Dataset (reference:
-python/ray/data/dataset.py:142): transformations append to a logical
-plan; execution fans out per-block tasks; `iter_batches` streams with a
-bounded in-flight window (the role of the pull-based
-StreamingExecutor, reference:
-data/_internal/execution/streaming_executor.py:55 — ours is a windowed
-pipeline over the same task substrate, which on a TPU host's CPU side is
-the data-loading path feeding device_put).
+python/ray/data/dataset.py:142): transformations append typed logical
+operators (`_internal/logical_ops.py`) to a logical plan; the optimizer
+fuses narrow runs and pushes limits toward the sources
+(`_internal/optimizer.py`); execution fans out per-block tasks gated by
+backpressure policies, and `iter_batches` streams with a bounded
+in-flight window (the role of the pull-based StreamingExecutor,
+reference: data/_internal/execution/streaming_executor.py:55 — ours is a
+windowed pipeline over the same task substrate, which on a TPU host's
+CPU side is the data-loading path feeding device_put). Per-operator
+execution stats surface through `Dataset.stats()`.
 """
 from __future__ import annotations
 
@@ -19,45 +22,16 @@ from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
 import ray_tpu
 from ray_tpu.data import block as B
+from ray_tpu.data._internal import logical_ops as L
 
 # remote transforms ---------------------------------------------------------
 
 
 def _apply_ops_local(blk, ops):
-    """Run a chain of (kind, fn) over one block (plain function — shared
-    by the per-block task and the shuffle map stage)."""
-    for kind, fn, kw in ops or []:
-        if kind == "map_batches":
-            fmt = kw.get("batch_format", "numpy")
-            out = fn(B.block_to_batch(blk, fmt))
-            blk = B.batch_to_block(out)
-        elif kind == "map":
-            blk = B.to_block([fn(r) for r in B.block_rows(blk)])
-        elif kind == "flat_map":
-            rows = []
-            for r in B.block_rows(blk):
-                rows.extend(fn(r))
-            blk = B.to_block(rows)
-        elif kind == "filter":
-            blk = B.to_block([r for r in B.block_rows(blk) if fn(r)])
-        elif kind == "add_column":
-            import pyarrow as pa
-
-            col, cfn = fn
-            vals = cfn(B.block_to_batch(blk, "pandas"))
-            blk = blk.append_column(col, pa.array(list(vals)))
-        elif kind == "drop_columns":
-            blk = blk.drop_columns(fn)
-        elif kind == "select_columns":
-            blk = blk.select(fn)
-        elif kind == "rename_columns":
-            blk = blk.rename_columns([fn.get(c, c) for c in blk.column_names])
-        else:
-            raise ValueError(f"unknown op {kind}")
-    return blk
-
-
-_apply_ops = ray_tpu.remote(_apply_ops_local)
+    """Run an op chain (typed LogicalOps or legacy (kind, fn, kw)
+    tuples) over one block — shared by the fused per-block task, the
+    shuffle map stages and the preprocessor fit tasks."""
+    return L.apply_ops(blk, ops)
 
 
 @ray_tpu.remote
@@ -92,6 +66,22 @@ def _sample_block(blk, fraction: float, seed: int):
 
     keep = np.random.default_rng(seed).random(blk.num_rows) < fraction
     return blk.take(np.nonzero(keep)[0])
+
+
+@ray_tpu.remote
+def _write_parquet_block(blk, path: str):
+    import pyarrow.parquet as pq
+
+    pq.write_table(blk, path)
+    return path
+
+
+@ray_tpu.remote
+def _write_csv_block(blk, path: str):
+    import pyarrow.csv as pcsv
+
+    pcsv.write_csv(blk, path)
+    return path
 
 
 @ray_tpu.remote
@@ -158,23 +148,37 @@ def _force(r):
 
 
 class Dataset:
-    """Lazy dataset over block refs + a pending op chain."""
+    """Lazy dataset over block refs + a pending logical-op chain."""
 
-    def __init__(self, block_refs: List[Any], ops: Optional[List] = None):
+    def __init__(self, block_refs: List[Any], ops: Optional[List] = None,
+                 source: Optional[str] = None):
         self._block_refs = block_refs
-        self._ops: List = ops or []
+        self._ops: List = [L.as_op(op) for op in ops or []]
+        self._source = source or "Input"
+        # last execution's StatsBuilder (set by the executor; see stats())
+        self._stats_builder = None
 
     def _forced(self) -> List[Any]:
         """Source refs with any lazy reads launched (the non-streaming
         paths — shuffles, stats — need them all in flight at once)."""
         return [_force(r) for r in self._block_refs]
 
+    def _exchange_inputs(self):
+        """(source refs, ops chain) safe to apply independently per
+        block inside exchange/fit map tasks. A global Limit cannot be
+        applied per block, so chains containing one execute first."""
+        from ray_tpu.data._internal.optimizer import has_limit
+
+        if has_limit(self._ops):
+            return self._execute_refs(), []
+        return self._forced(), self._ops
+
     # ------------------------------------------------------------ transforms
-    def _with_op(self, kind: str, fn, **kw) -> "Dataset":
-        return Dataset(self._block_refs, self._ops + [(kind, fn, kw)])
+    def _with_op(self, op: L.LogicalOp) -> "Dataset":
+        return Dataset(self._block_refs, self._ops + [op], source=self._source)
 
     def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
-        return self._with_op("map", fn)
+        return self._with_op(L.MapRows(fn))
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
                     compute: Optional[str] = None, num_actors: int = 2,
@@ -185,57 +189,71 @@ class Dataset:
         once per worker (reference: actor_pool_map_operator.py; the
         TPU-host shape for tokenizers/encoders too expensive to build per
         task)."""
-        return self._with_op(
-            "map_batches", fn, batch_format=batch_format, compute=compute,
+        return self._with_op(L.MapBatches(
+            fn, batch_format=batch_format, compute=compute,
             num_actors=num_actors, fn_constructor_args=fn_constructor_args,
             fn_constructor_kwargs=fn_constructor_kwargs,
             ray_actor_options=ray_actor_options,
-        )
+        ))
 
     def flat_map(self, fn) -> "Dataset":
-        return self._with_op("flat_map", fn)
+        return self._with_op(L.FlatMap(fn))
 
     def filter(self, fn) -> "Dataset":
-        return self._with_op("filter", fn)
+        return self._with_op(L.Filter(fn))
 
     def add_column(self, name: str, fn) -> "Dataset":
-        return self._with_op("add_column", (name, fn))
+        return self._with_op(L.AddColumn(name, fn))
 
     def drop_columns(self, cols: List[str]) -> "Dataset":
-        return self._with_op("drop_columns", cols)
+        return self._with_op(L.DropColumns(cols))
 
     def select_columns(self, cols: List[str]) -> "Dataset":
-        return self._with_op("select_columns", cols)
+        return self._with_op(L.SelectColumns(cols))
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
-        return self._with_op("rename_columns", mapping)
+        return self._with_op(L.RenameColumns(mapping))
+
+    def limit(self, n: int) -> "Dataset":
+        """First n rows, as a logical op: the optimizer pushes it past
+        row-count-preserving operators and the executor stops pulling
+        sources once the budget is met — `read_*(...).limit(k)` launches
+        only the needed prefix of read tasks."""
+        return self._with_op(L.Limit(n))
 
     # ------------------------------------------------------------- execution
-    def _has_actor_stage(self) -> bool:
-        return any(
-            k == "map_batches" and kw.get("compute") == "actors"
-            for k, _, kw in (self._ops or [])
-        )
-
     def _execute_refs(self) -> List[Any]:
         """Launch per-block pipelines; returns refs of transformed blocks."""
         if not self._ops:
             return self._forced()
-        if self._has_actor_stage():
-            from ray_tpu.data._executor import execute_streaming
+        from ray_tpu.data._executor import execute_eager
 
-            # wide window: materialization wants max parallelism, the
-            # executor handles the actor-stage plumbing
-            return list(
-                execute_streaming(self._block_refs, self._ops, max_in_flight=16)
-            )
-        ops = ray_tpu.put(self._ops)
-        return [_apply_ops.remote(ref, ops) for ref in self._forced()]
+        return execute_eager(
+            self._block_refs, self._ops, owner=self, input_name=self._source
+        )
 
     def materialize(self) -> "Dataset":
         refs = self._execute_refs()
         ray_tpu.wait(refs, num_returns=len(refs), timeout=None)
-        return Dataset(refs)
+        if self._stats_builder is not None:
+            # the eager path launches without waiting; every block is
+            # done HERE, so this is the execution's true end time
+            self._stats_builder.finalize()
+        out = Dataset(refs, source=self._source)
+        out._stats_builder = self._stats_builder
+        return out
+
+    def stats(self):
+        """Per-operator stats of the LAST execution (iterate, take,
+        materialize, ... first): wall time, task counts, rows/bytes
+        in/out and backpressure-throttle counts. Returns a DatasetStats
+        — str() is the human-readable report, `.to_dict()` the
+        programmatic form (reference: Dataset.stats())."""
+        from ray_tpu.data._internal.stats import EMPTY_STATS
+
+        if self._stats_builder is None:
+            return EMPTY_STATS
+        return self._stats_builder.build()
 
     def blocks(self) -> List[Any]:
         return self._execute_refs()
@@ -251,8 +269,9 @@ class Dataset:
 
         if not self._block_refs:
             return Dataset([])
-        ops_ref = ray_tpu.put(self._ops) if self._ops else None
-        counts = ray_tpu.get([_block_count.remote(r, ops_ref) for r in self._forced()])
+        src_refs, ops = self._exchange_inputs()
+        ops_ref = ray_tpu.put(ops) if ops else None
+        counts = ray_tpu.get([_block_count.remote(r, ops_ref) for r in src_refs])
         total = sum(counts)
         per = max(1, (total + num_blocks - 1) // num_blocks)
         offsets = []
@@ -261,7 +280,7 @@ class Dataset:
             offsets.append((acc, per))
             acc += c
         refs = shuffle_exchange(
-            self._forced(), self._ops, "chunk", num_blocks, per_map_args=offsets, ops_ref=ops_ref
+            src_refs, ops, "chunk", num_blocks, per_map_args=offsets, ops_ref=ops_ref
         )
         return Dataset(refs)
 
@@ -270,8 +289,9 @@ class Dataset:
 
         if not self._block_refs:
             return Dataset([])
-        M = max(1, len(self._block_refs))
-        refs = shuffle_exchange(self._forced(), self._ops, "random", M, seed=seed)
+        src_refs, ops = self._exchange_inputs()
+        M = max(1, len(src_refs))
+        refs = shuffle_exchange(src_refs, ops, "random", M, seed=seed)
         return Dataset(refs)
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
@@ -284,10 +304,11 @@ class Dataset:
 
         if not self._block_refs:
             return Dataset([])
-        M = max(1, len(self._block_refs))
-        ops_ref = ray_tpu.put(self._ops) if self._ops else None
+        src_refs, ops = self._exchange_inputs()
+        M = max(1, len(src_refs))
+        ops_ref = ray_tpu.put(ops) if ops else None
         samples = ray_tpu.get(
-            [_sample_keys.remote(r, ops_ref, key, 64, 11 * i) for i, r in enumerate(self._forced())]
+            [_sample_keys.remote(r, ops_ref, key, 64, 11 * i) for i, r in enumerate(src_refs)]
         )
         allkeys = np.sort(np.concatenate([s for s in samples if len(s)]))
         if len(allkeys) == 0 or M == 1:
@@ -296,8 +317,8 @@ class Dataset:
             qs = [len(allkeys) * j // M for j in builtins.range(1, M)]
             boundaries = list(allkeys[qs])
         refs = shuffle_exchange(
-            self._forced(),
-            self._ops,
+            src_refs,
+            ops,
             "range",
             M,
             arg=(key, descending, boundaries),
@@ -365,7 +386,8 @@ class Dataset:
         from ray_tpu.data._executor import execute_streaming
 
         ref_iter = execute_streaming(
-            self._block_refs, self._ops, max_in_flight=2 * (prefetch_blocks + 1)
+            self._block_refs, self._ops, max_in_flight=2 * (prefetch_blocks + 1),
+            owner=self, input_name=self._source,
         )
 
         leftover = None
@@ -493,18 +515,6 @@ class Dataset:
             carry = (ref, off + take, rem - take) if rem > take else None
         return [DataIterator(Dataset(s)) for s in splits]
 
-    def limit(self, n: int) -> "Dataset":
-        """First n rows (materializes only the needed prefix of blocks)."""
-        out, have = [], 0
-        for ref in self._execute_refs():
-            if have >= n:
-                break
-            blk = ray_tpu.get(ref)
-            take = min(blk.num_rows, n - have)
-            out.append(blk.slice(0, take))
-            have += take
-        return Dataset([ray_tpu.put(b) for b in out])
-
     def zip(self, other: "Dataset") -> "Dataset":
         """Column-wise zip of equal-length datasets (reference:
         dataset.zip). Distributed: the right side is re-sliced to the
@@ -562,12 +572,19 @@ class Dataset:
         return list(self.iter_rows())
 
     def count(self) -> int:
-        return sum(B.block_size(ray_tpu.get(r)) for r in self._execute_refs())
+        """Total rows, counted IN TASKS — only integers cross back to
+        the driver, never block data (reference: Dataset.count via
+        per-block metadata)."""
+        refs = self._execute_refs()
+        return sum(ray_tpu.get([_block_num_rows.remote(r) for r in refs]))
 
     def schema(self):
         if not self._block_refs:
             return None
-        return ray_tpu.get(self._execute_refs()[0]).schema
+        refs = self._execute_refs()
+        if not refs:  # e.g. limit(0): sources exist, plan yields nothing
+            return None
+        return ray_tpu.get(refs[0]).schema
 
     def num_blocks(self) -> int:
         return len(self._block_refs)
@@ -584,22 +601,29 @@ class Dataset:
         return B.concat_blocks(ray_tpu.get(self._execute_refs()))
 
     def write_parquet(self, path: str):
+        """One parquet file per block, written IN TASKS — block data
+        never lands on the driver (same shape as write_tfrecords below;
+        reference: Dataset.write_parquet)."""
         import os
 
-        import pyarrow.parquet as pq
-
         os.makedirs(path, exist_ok=True)
-        for i, ref in enumerate(self._execute_refs()):
-            pq.write_table(ray_tpu.get(ref), os.path.join(path, f"part-{i:05d}.parquet"))
+        refs = self._execute_refs()
+        ray_tpu.get([
+            _write_parquet_block.remote(ref, os.path.join(path, f"part-{i:05d}.parquet"))
+            for i, ref in enumerate(refs)
+        ])
 
     def write_csv(self, path: str):
+        """One csv file per block, written in tasks (reference:
+        Dataset.write_csv)."""
         import os
 
-        import pyarrow.csv as pcsv
-
         os.makedirs(path, exist_ok=True)
-        for i, ref in enumerate(self._execute_refs()):
-            pcsv.write_csv(ray_tpu.get(ref), os.path.join(path, f"part-{i:05d}.csv"))
+        refs = self._execute_refs()
+        ray_tpu.get([
+            _write_csv_block.remote(ref, os.path.join(path, f"part-{i:05d}.csv"))
+            for i, ref in enumerate(refs)
+        ])
 
     def write_tfrecords(self, path: str):
         """One .tfrecord file of tf.train.Example records per block —

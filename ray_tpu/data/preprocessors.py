@@ -80,9 +80,12 @@ def _column_uniques(blk, ops, columns):
 
 def _per_block(ds, task, columns):
     """One fan-out task per block, results gathered on the driver — the
-    shared scaffolding behind every distributed fit."""
-    ops = ray_tpu.put(ds._ops) if ds._ops else None
-    return ray_tpu.get([task.remote(r, ops, columns) for r in ds._forced()])
+    shared scaffolding behind every distributed fit. `_exchange_inputs`
+    resolves any global Limit first (a per-block limit inside the fit
+    task would over-count)."""
+    refs, chain = ds._exchange_inputs()
+    ops = ray_tpu.put(chain) if chain else None
+    return ray_tpu.get([task.remote(r, ops, columns) for r in refs])
 
 
 def _gather_moments(ds, columns) -> Dict[str, Dict[str, float]]:

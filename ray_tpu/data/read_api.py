@@ -20,7 +20,7 @@ def _partition(items: List[Any], parallelism: int) -> List[List[Any]]:
 
 def from_items(items: List[Any], parallelism: int = 8) -> Dataset:
     parts = _partition(list(items), parallelism)
-    return Dataset([ray_tpu.put(B.to_block(p)) for p in parts])
+    return Dataset([ray_tpu.put(B.to_block(p)) for p in parts], source="FromItems")
 
 
 def range(n: int, parallelism: int = 8) -> Dataset:
@@ -30,17 +30,17 @@ def range(n: int, parallelism: int = 8) -> Dataset:
 def from_pandas(df) -> Dataset:
     import pyarrow as pa
 
-    return Dataset([ray_tpu.put(pa.Table.from_pandas(df, preserve_index=False))])
+    return Dataset([ray_tpu.put(pa.Table.from_pandas(df, preserve_index=False))], source="FromPandas")
 
 
 def from_arrow(table) -> Dataset:
-    return Dataset([ray_tpu.put(table)])
+    return Dataset([ray_tpu.put(table)], source="FromArrow")
 
 
 def from_numpy(arr) -> Dataset:
     import pyarrow as pa
 
-    return Dataset([ray_tpu.put(pa.table({"data": list(arr)}))])
+    return Dataset([ray_tpu.put(pa.table({"data": list(arr)}))], source="FromNumpy")
 
 
 def _expand(paths) -> List[str]:
@@ -143,27 +143,33 @@ def _read_tfrecords(path, verify: bool):
 
 
 def read_parquet(paths, **kw) -> Dataset:
-    return Dataset([LazyBlock(lambda p=p: _read_parquet.remote(p)) for p in _expand(paths)])
+    return Dataset([LazyBlock(lambda p=p: _read_parquet.remote(p)) for p in _expand(paths)],
+                   source="ReadParquet")
 
 
 def read_csv(paths, **kw) -> Dataset:
-    return Dataset([LazyBlock(lambda p=p: _read_csv.remote(p)) for p in _expand(paths)])
+    return Dataset([LazyBlock(lambda p=p: _read_csv.remote(p)) for p in _expand(paths)],
+                   source="ReadCSV")
 
 
 def read_json(paths, **kw) -> Dataset:
-    return Dataset([LazyBlock(lambda p=p: _read_json.remote(p)) for p in _expand(paths)])
+    return Dataset([LazyBlock(lambda p=p: _read_json.remote(p)) for p in _expand(paths)],
+                   source="ReadJSON")
 
 
 def read_text(paths, **kw) -> Dataset:
-    return Dataset([LazyBlock(lambda p=p: _read_text.remote(p)) for p in _expand(paths)])
+    return Dataset([LazyBlock(lambda p=p: _read_text.remote(p)) for p in _expand(paths)],
+                   source="ReadText")
 
 
 def read_numpy(paths, **kw) -> Dataset:
-    return Dataset([LazyBlock(lambda p=p: _read_numpy.remote(p)) for p in _expand(paths)])
+    return Dataset([LazyBlock(lambda p=p: _read_numpy.remote(p)) for p in _expand(paths)],
+                   source="ReadNumpy")
 
 
 def read_binary_files(paths, **kw) -> Dataset:
-    return Dataset([LazyBlock(lambda p=p: _read_binary.remote(p)) for p in _expand(paths)])
+    return Dataset([LazyBlock(lambda p=p: _read_binary.remote(p)) for p in _expand(paths)],
+                   source="ReadBinary")
 
 
 @ray_tpu.remote
@@ -181,7 +187,7 @@ def read_webdataset(paths, *, decode_images: bool = True, **kw) -> Dataset:
     return Dataset([
         LazyBlock(lambda p=p: _read_webdataset.remote(p, decode_images))
         for p in _expand(paths)
-    ])
+    ], source="ReadWebDataset")
 
 
 @ray_tpu.remote
@@ -224,7 +230,8 @@ def read_sql(sql: str, connection_factory, *, parallelism: int = 1) -> Dataset:
     per-task memory (results stream via fetchmany), NOT database work."""
     n = max(1, parallelism)
     if n == 1:
-        return Dataset([LazyBlock(lambda: _read_sql_shard.remote(connection_factory, sql, None, 1))])
+        return Dataset([LazyBlock(lambda: _read_sql_shard.remote(connection_factory, sql, None, 1))],
+                       source="ReadSQL")
     import re
 
     if not re.search(r"order\s+by", sql, re.IGNORECASE):
@@ -236,7 +243,7 @@ def read_sql(sql: str, connection_factory, *, parallelism: int = 1) -> Dataset:
     return Dataset([
         LazyBlock(lambda i=i: _read_sql_shard.remote(connection_factory, sql, i, n))
         for i in builtins.range(n)
-    ])
+    ], source="ReadSQL")
 
 
 def read_tfrecords(paths, *, verify_crc: bool = False, **kw) -> Dataset:
@@ -245,7 +252,7 @@ def read_tfrecords(paths, *, verify_crc: bool = False, **kw) -> Dataset:
     tensorflow import (ray_tpu/data/tfrecords.py implements the format)."""
     return Dataset([
         LazyBlock(lambda p=p: _read_tfrecords.remote(p, verify_crc)) for p in _expand(paths)
-    ])
+    ], source="ReadTFRecords")
 
 
 def read_mongo(uri: str, database: str, collection: str, *,
@@ -286,7 +293,7 @@ def read_mongo(uri: str, database: str, collection: str, *,
     return Dataset([
         LazyBlock(lambda i=i: _read_shard.remote(i, parallelism))
         for i in builtins.range(parallelism)
-    ])
+    ], source="ReadMongo")
 
 
 def read_bigquery(query: Optional[str] = None, *, project_id: Optional[str] = None,
@@ -319,7 +326,7 @@ def read_bigquery(query: Optional[str] = None, *, project_id: Optional[str] = No
         client = client_factory(project_id)
         return B.to_block([dict(r) for r in client.query(sql).result()])
 
-    ds = Dataset([LazyBlock(lambda: _read_all.remote())])
+    ds = Dataset([LazyBlock(lambda: _read_all.remote())], source="ReadBigQuery")
     return ds.repartition(parallelism) if parallelism > 1 else ds
 
 
@@ -366,7 +373,7 @@ def from_huggingface(hf_dataset, parallelism: int = 8) -> Dataset:
     k = max(1, min(parallelism, n or 1))
     per = (n + k - 1) // k
     blocks = [table.slice(i * per, per) for i in builtins.range(k) if i * per < n]
-    return Dataset([ray_tpu.put(b) for b in blocks or [table]])
+    return Dataset([ray_tpu.put(b) for b in blocks or [table]], source="FromHuggingFace")
 
 
 _IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp", ".tiff")
@@ -402,4 +409,5 @@ def read_images(paths, *, size=None, **kw) -> Dataset:
     the directory are skipped by extension (reference image datasource
     filters the same way)."""
     files = [p for p in _expand(paths) if p.lower().endswith(_IMAGE_EXTENSIONS)]
-    return Dataset([LazyBlock(lambda p=p: _read_image.remote(p, size)) for p in files])
+    return Dataset([LazyBlock(lambda p=p: _read_image.remote(p, size)) for p in files],
+                   source="ReadImages")

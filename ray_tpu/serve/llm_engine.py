@@ -181,10 +181,20 @@ class ContinuousBatchingEngine:
             s = sorted(xs)
             return round(s[min(len(s) - 1, int(q * len(s)))] * 1e3, 2)
 
-        m["ttft_ms_p50"] = pct(self._ttft, 0.50)
-        m["ttft_ms_p95"] = pct(self._ttft, 0.95)
-        m["tpot_ms_p50"] = pct(self._tpot, 0.50)
-        m["tpot_ms_p95"] = pct(self._tpot, 0.95)
+        # snapshot: the engine loop thread appends to these deques while
+        # we sort (deque iteration raises on concurrent mutation; retry
+        # the copy — appends are GIL-atomic so a clean pass converges)
+        ttft, tpot = [], []
+        for _ in range(8):
+            try:
+                ttft, tpot = list(self._ttft), list(self._tpot)
+                break
+            except RuntimeError:
+                continue
+        m["ttft_ms_p50"] = pct(ttft, 0.50)
+        m["ttft_ms_p95"] = pct(ttft, 0.95)
+        m["tpot_ms_p50"] = pct(tpot, 0.50)
+        m["tpot_ms_p95"] = pct(tpot, 0.95)
         return m
 
     def reset_metrics(self) -> None:

@@ -1,0 +1,47 @@
+"""Repo lint: Dataset write_*/count must never funnel blocks through the
+driver.
+
+Guards the regression where `write_parquet`/`write_csv` fetched every
+block with `ray_tpu.get(ref)` to write it driver-side, and `count()`
+pulled whole blocks just to read their length. Each of those paths must
+run per-block REMOTE tasks so only paths/ints cross the wire. Pure
+source lint — no cluster."""
+import inspect
+import re
+
+from ray_tpu.data.dataset import Dataset
+
+
+# `ray_tpu.get(` applied to a single block ref (the driver-funneling
+# shape). Gathering a LIST of small task results (paths, ints) is fine.
+_BLOCK_GET = re.compile(r"ray_tpu\.get\((?:ref|r)\b")
+
+WRITE_METHODS = [
+    n for n in dir(Dataset)
+    if n.startswith("write_") and callable(getattr(Dataset, n))
+]
+
+
+def test_write_methods_exist():
+    # the lint must actually cover the writers it claims to
+    assert {"write_parquet", "write_csv", "write_tfrecords", "write_webdataset"} <= set(WRITE_METHODS)
+
+
+def test_write_methods_run_in_tasks():
+    for name in WRITE_METHODS:
+        src = inspect.getsource(getattr(Dataset, name))
+        assert not _BLOCK_GET.search(src), (
+            f"Dataset.{name} fetches block refs onto the driver — write "
+            f"each block in a remote task (like _write_tfrecords_block)"
+        )
+        assert ".remote(" in src, (
+            f"Dataset.{name} has no remote per-block writer task"
+        )
+
+
+def test_count_moves_only_integers():
+    src = inspect.getsource(Dataset.count)
+    assert not _BLOCK_GET.search(src), "Dataset.count pulls whole blocks to the driver"
+    assert "_block_num_rows" in src, (
+        "Dataset.count must count rows task-side via _block_num_rows"
+    )
