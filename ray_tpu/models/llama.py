@@ -43,10 +43,19 @@ class LlamaConfig:
     attn_impl: str = "auto"  # auto | flash | blockwise | ring
     remat: bool = True
     # MoE: >0 replaces each layer's SwiGLU with moe_experts experts
-    # (top-1 gated, capacity-bounded; experts shard on the `ep` mesh axis)
+    # (top-k gated, capacity-bounded; experts shard on the `ep` mesh axis)
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # routed experts per token (k=2 uses GShard-normalized weights)
+    moe_top_k: int = 1
+    # "grouped": sort-based routing — gather-built queues (EP) / ragged
+    # grouped GEMMs (dense), no [T, E, C] intermediates. "onehot": the
+    # Switch-style einsum reference, kept for A/B.
+    moe_dispatch: str = "grouped"
+    # router z-loss coefficient (0 = off); added to the total loss as
+    # moe_router_z_weight * mean(logsumexp(router_logits)^2)
+    moe_router_z_weight: float = 0.0
     # pipeline parallelism: microbatches for the GPipe schedule when the
     # mesh has a pp axis and the strategy maps the layer stack onto it
     pp_microbatches: int = 4
@@ -191,7 +200,7 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh=None, rules=None):
             # ppermute while each device attends its local Q shard
             import functools as _ft
 
-            from jax import shard_map
+            from ray_tpu.parallel._shard_map import shard_map
             from ray_tpu.parallel.ring_attention import ring_attention
 
             qspec = rules.spec(("batch", "seq", "act_heads", None))
@@ -212,6 +221,18 @@ def _moe_expert_fn(pe, t):
     """One expert's SwiGLU on its token queue [C, D]."""
     gate = jax.nn.silu((t @ pe["w_gate"]).astype(jnp.float32)).astype(t.dtype)
     return (gate * (t @ pe["w_up"])) @ pe["w_down"]
+
+
+def _moe_expert_gemms(pe, sorted_tokens, group_sizes):
+    """All experts' SwiGLU on the expert-sorted token list [S, D] as three
+    ragged grouped GEMMs — same math as _moe_expert_fn, no capacity
+    padding."""
+    from ray_tpu.ops.grouped_matmul import grouped_matmul
+
+    g = grouped_matmul(sorted_tokens, pe["w_gate"], group_sizes)
+    u = grouped_matmul(sorted_tokens, pe["w_up"], group_sizes)
+    h = jax.nn.silu(g.astype(jnp.float32)).astype(sorted_tokens.dtype) * u
+    return grouped_matmul(h, pe["w_down"], group_sizes)
 
 
 def _layer_fn(layer, x, cos_sin, cfg: LlamaConfig, mesh=None, rules=None):
@@ -242,22 +263,38 @@ def _layer_fn(layer, x, cos_sin, cfg: LlamaConfig, mesh=None, rules=None):
     # mlp block: SwiGLU, or top-1-gated MoE when cfg.moe_experts
     m = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
     if cfg.moe_experts:
-        from ray_tpu.parallel.moe import expert_parallel_moe_inline, moe_layer_dense
+        from ray_tpu.parallel.moe import (
+            expert_parallel_moe_inline, moe_layer_dense, moe_layer_grouped,
+        )
 
         moe_params = {
             "w_gate": layer["moe_gate"], "w_up": layer["moe_up"], "w_down": layer["moe_down"],
         }
+        # the gate weights each aux term with its own coefficient
+        # (aux = aw·balance + zw·z) and loss_fn adds the channel unscaled,
+        # so z-regularization works at any moe_aux_weight — including 0
+        moe_kw = dict(
+            capacity_factor=cfg.moe_capacity_factor, top_k=cfg.moe_top_k,
+            router_z_weight=cfg.moe_router_z_weight,
+            aux_weight=cfg.moe_aux_weight,
+        )
         ep_axes = rules.rules.get("expert") if rules is not None else None
         if mesh is not None and ep_axes and all(mesh.shape[a] > 1 for a in ep_axes):
             down, aux = expert_parallel_moe_inline(
                 mesh, m, layer["gate_w"], _moe_expert_fn, moe_params,
-                capacity_factor=cfg.moe_capacity_factor, axis_name=ep_axes[0],
+                axis_name=ep_axes[0],
                 x_spec=rules.spec(("batch", "seq", "act_embed")),
+                dispatch=cfg.moe_dispatch, **moe_kw,
+            )
+        elif cfg.moe_dispatch == "grouped":
+            # no EP axis: ragged grouped GEMMs, no capacity padding at all
+            down, aux = moe_layer_grouped(
+                m, layer["gate_w"], _moe_expert_gemms, moe_params, **moe_kw,
             )
         else:
             down, aux = moe_layer_dense(
                 m, layer["gate_w"], _moe_expert_fn, moe_params,
-                capacity_factor=cfg.moe_capacity_factor,
+                dispatch=cfg.moe_dispatch, **moe_kw,
             )
     else:
         gate = jax.nn.silu((m @ layer["w_gate"]).astype(jnp.float32)).astype(cfg.dtype)
@@ -267,9 +304,47 @@ def _layer_fn(layer, x, cos_sin, cfg: LlamaConfig, mesh=None, rules=None):
     return x + cstr(down, ("batch", "seq", "act_embed")), aux
 
 
+def _unshard_moe_expert_dim(params):
+    """jax<=0.4.x silently miscomputes `ragged_dot` when its rhs GROUP dim
+    is sharded (see ops/grouped_matmul). When the dense/ragged MoE path is
+    about to run on CONCRETE params whose stacked expert weights [L, E, ..]
+    are still ep-sharded (the A/B/eval flow: loss_fn without mesh/rules on
+    a sharded train state), gather the expert dim here — before lax.scan
+    hides the shardings behind tracers. No-op on tracers and unsharded
+    params; the EP shard_map path never needs this (experts are local).
+
+    Limits: only the EAGER flow is guarded (under jax.jit the params are
+    tracers with no visible sharding, so jitting an eval directly over
+    still-ep-sharded params stays exposed to the upstream bug), and the
+    gather re-runs per call — for a many-batch eval loop, device_put the
+    params off the ep axis once and jit over that instead."""
+    from ray_tpu.ops.grouped_matmul import unshard_dim
+
+    layers = params.get("layers") if isinstance(params, dict) else None
+    if not isinstance(layers, dict):
+        return params
+    new_layers = dict(layers)
+    changed = False
+    for name in ("moe_gate", "moe_up", "moe_down"):
+        w = layers.get(name)
+        if w is None:
+            continue
+        new_w = unshard_dim(w, 1)  # stacked [L, E, ...]: dim 1 is experts
+        if new_w is not w:
+            new_layers[name] = new_w
+            changed = True
+    return {**params, "layers": new_layers} if changed else params
+
+
 def forward_with_aux(params, tokens, cfg: LlamaConfig, mesh=None, rules=None):
     """tokens: [B, T] int32 → (logits [B, T, vocab] fp32, moe aux loss)."""
     B, T = tokens.shape
+    if cfg.moe_experts and cfg.moe_dispatch == "grouped":
+        ep_axes = rules.rules.get("expert") if rules is not None else None
+        ep_active = (mesh is not None and ep_axes
+                     and all(mesh.shape[a] > 1 for a in ep_axes))
+        if not ep_active:
+            params = _unshard_moe_expert_dim(params)
     embed = params["embed"]
     if mesh is not None and rules is not None:
         from ray_tpu.parallel.sharding import constraint
@@ -363,14 +438,16 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh=None, rules=None):
     else:
         ce = nll.mean()
     if cfg.moe_experts:
-        return ce + cfg.moe_aux_weight * aux
+        # aux is already weighted per-term (_layer_fn applies
+        # moe_aux_weight and moe_router_z_weight at the layer)
+        return ce + aux
     return ce
 
 
 def num_params(cfg: LlamaConfig, active_only: bool = False) -> int:
     """Total parameter count. `active_only=True` counts the params a
-    TOKEN actually touches — for MoE (top-1 gate) that is ONE expert's
-    MLP plus the gate, which is what FLOPs/MFU accounting needs; for
+    TOKEN actually touches — for MoE (top-k gate) that is k experts'
+    MLPs plus the router, which is what FLOPs/MFU accounting needs; for
     dense configs the two are identical."""
     d, h, kvh, hd, f, L, V = (
         cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers, cfg.vocab_size,
@@ -379,7 +456,8 @@ def num_params(cfg: LlamaConfig, active_only: bool = False) -> int:
     if cfg.moe_experts and not active_only:
         mlp = cfg.moe_experts * 3 * d * f + d * cfg.moe_experts
     elif cfg.moe_experts:
-        mlp = 3 * d * f + d * cfg.moe_experts  # one routed expert + gate
+        # k routed experts + router
+        mlp = cfg.moe_top_k * 3 * d * f + d * cfg.moe_experts
     else:
         mlp = 3 * d * f
     per_layer = attn + mlp + 2 * d
@@ -397,5 +475,32 @@ def flops_per_token(cfg: LlamaConfig, seq_len: int, causal_computed: bool = Fals
     attn = 12 * cfg.n_layers * cfg.d_model * seq_len  # qk^T + pv fwd+bwd
     if causal_computed:
         attn /= 2
-    # MoE: a token's FLOPs touch one routed expert, not every expert
+    # MoE: a token's FLOPs touch k routed experts, not every expert
     return 6 * num_params(cfg, active_only=True) + attn
+
+
+def moe_dispatch_flops_per_token(cfg: LlamaConfig, tokens_per_group: int,
+                                 dispatch: Optional[str] = None) -> float:
+    """Training FLOPs/token the MoE DISPATCH itself executes, summed over
+    layers — add to flops_per_token() for a computed-FLOPs MFU that makes
+    routing overhead visible.
+
+    - "grouped": routing is argsort + gathers (byte moves, ~0 matmul
+      FLOPs); only the combine weighting counts: k multiply-adds per
+      feature, fwd+bwd → 6·k·d per layer. O(T·k·d) total.
+    - "onehot": two [T,E,C]×[T,D] einsums at 2·E·C·d MACs/token each,
+      fwd+bwd → 12·E·C·d per layer, with C = capacity(T) ∝ T/E — i.e.
+      O(cf·T·d) per token, the term that swamped the expert FLOPs.
+
+    `tokens_per_group` is the flattened token count the gate sees per
+    routing group (B·T on one chip)."""
+    from ray_tpu.parallel.moe import compute_capacity
+
+    if not cfg.moe_experts:
+        return 0.0
+    dispatch = dispatch or cfg.moe_dispatch
+    d, E, k, L = cfg.d_model, cfg.moe_experts, cfg.moe_top_k, cfg.n_layers
+    if dispatch == "grouped":
+        return float(6 * k * d * L)
+    C = compute_capacity(tokens_per_group, E, cfg.moe_capacity_factor)
+    return float((12 * E * C * d + 6 * k * d) * L)

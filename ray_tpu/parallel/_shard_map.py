@@ -1,0 +1,31 @@
+"""shard_map compat: `jax.shard_map` (new jax) vs
+`jax.experimental.shard_map.shard_map` (jax <= 0.4.x, where the
+replication-check kwarg is `check_rep` rather than `check_vma`), plus
+`axis_size` (absent from jax.lax <= 0.4.x, where `psum(1, axis)` is the
+idiom — it constant-folds to a static int during tracing).
+
+Every shard_map/axis_size call in the codebase goes through these
+wrappers so the parallel layer runs on both API generations.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def axis_size(axis_name):
+    """Static size of a mapped mesh axis, usable inside shard_map."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_vma=check_vma)
