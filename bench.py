@@ -775,6 +775,127 @@ def bench_data_pipeline(extra):
             pass
 
 
+def bench_telemetry_overhead(extra):
+    """Observability tax: llama step time instrumented vs bare. The
+    step-telemetry wrapper (observability.instrument_step) must cost
+    <1% — it is designed as counters + monotonic timestamps only, no
+    device syncs, zero extra HLO. The wrapper tax is ABSOLUTE (a few
+    µs/call, independent of what the wrapped fn does: two perf_counter
+    reads, a contextvar get, a jit-cache probe, a flops callable, one
+    ring append), so it is measured on a µs-scale jitted probe where
+    thousands of paired samples converge it to ±0.5 µs in seconds, then
+    expressed against the llama-nano step time from the same run. The
+    obvious direct measurement — paired alternation on the 15 ms llama
+    step itself — does NOT converge on this 1-core box: adjacent
+    identical calls differ by ±2 ms (scheduler/cgroup), so the median of
+    300 per-pair diffs still swings ±2% run-to-run on a ~0.05% effect;
+    that end-to-end number is kept as telemetry_overhead_paired_pct for
+    cross-checking, headline-gated on the converging estimator. CPU
+    numbers UPPER-bound the TPU case, where steps are longer."""
+    try:
+        import gc
+        import statistics
+
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu import observability
+        from ray_tpu.models.llama import LlamaConfig
+        from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+        from ray_tpu.train.step import build_sharded_train_step
+
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, attn_impl="blockwise",
+                               remat=False)
+        mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+        B, T = 2, 64
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T + 1), 0,
+                                    cfg.vocab_size)
+        init_fn, step_fn, shard_batch, _ = build_sharded_train_step(
+            cfg, mesh, strategy="dp", telemetry=False)
+        inst_fn = observability.instrument_step(
+            step_fn, name="train_step", flops_per_call=None)
+        batch = shard_batch({"tokens": tokens})
+        s_bare, s_inst = init_fn(jax.random.PRNGKey(0)), init_fn(jax.random.PRNGKey(0))
+        for _ in range(3):  # both compiles (fresh + donated layouts)
+            s_bare, m = step_fn(s_bare, batch)
+            s_inst, mi = inst_fn(s_inst, batch)
+        float(m["loss"]), float(mi["loss"])
+
+        # --- wrapper tax on a µs-scale probe, paired alternation.
+        # flops_per_call is a callable, like the train-step wiring (the
+        # per-call flops lookup is part of the tax being measured).
+        probe = jax.jit(lambda s, x: s + x.sum())
+        probe_inst = observability.instrument_step(
+            probe, name="tax_probe", flops_per_call=lambda a, k: 1e9)
+        px, ps = jnp.ones(64), jnp.float32(0)
+        for _ in range(3):
+            probe(ps, px).block_until_ready()
+            probe_inst(ps, px).block_until_ready()
+        gc.collect()
+        gc.disable()  # gen0 pauses land one-sidedly in µs-scale samples
+        try:
+            pb, pi = [], []
+            for i in range(4000):
+                fb = i % 2 == 0  # alternate order: position bias cancels
+                t0 = time.perf_counter()
+                (probe if fb else probe_inst)(ps, px).block_until_ready()
+                t1 = time.perf_counter()
+                (probe_inst if fb else probe)(ps, px).block_until_ready()
+                t2 = time.perf_counter()
+                pb.append((t1 - t0) if fb else (t2 - t1))
+                pi.append((t2 - t1) if fb else (t1 - t0))
+
+            # --- end-to-end cross-check on the real step (same pairing)
+            bare_times, inst_times = [], []
+            for i in range(150):
+                fb = i % 2 == 0
+                t0 = time.perf_counter()
+                if fb:
+                    s_bare, m = step_fn(s_bare, batch)
+                    float(m["loss"])
+                else:
+                    s_inst, mi = inst_fn(s_inst, batch)
+                    float(mi["loss"])
+                t1 = time.perf_counter()
+                if fb:
+                    s_inst, mi = inst_fn(s_inst, batch)
+                    float(mi["loss"])
+                else:
+                    s_bare, m = step_fn(s_bare, batch)
+                    float(m["loss"])
+                t2 = time.perf_counter()
+                bare_times.append((t1 - t0) if fb else (t2 - t1))
+                inst_times.append((t2 - t1) if fb else (t1 - t0))
+        finally:
+            gc.enable()
+
+        # per-order-subset medians of per-pair differences, averaged:
+        # adjacent-call drift cancels inside each pair, spikes fall to
+        # the median, the first-position penalty cancels across subsets
+        def paired_diff(bs, ins):
+            ds = [b - a for a, b in zip(bs, ins)]
+            return (statistics.median(ds[0::2]) + statistics.median(ds[1::2])) / 2
+
+        tax_s = max(0.0, paired_diff(pb, pi))
+        dt_bare = statistics.median(bare_times)
+        overhead = 100.0 * tax_s / dt_bare
+        extra["telemetry_overhead_pct"] = round(overhead, 3)
+        extra["telemetry_wrapper_tax_us"] = round(tax_s * 1e6, 2)
+        extra["telemetry_overhead_paired_pct"] = round(
+            100.0 * paired_diff(bare_times, inst_times) / dt_bare, 3)
+        tel = observability.get("train_step")
+        if tel is not None:
+            snap = tel.snapshot()
+            if snap.get("goodput_pct") is not None:
+                extra["telemetry_goodput_pct"] = snap["goodput_pct"]
+        log(f"[bench] step-telemetry overhead: wrapper tax "
+            f"{tax_s * 1e6:.2f} µs/call on a {dt_bare * 1e3:.2f} ms/step "
+            f"llama-nano step = {overhead:+.3f}% (budget <1%; end-to-end "
+            f"paired cross-check {extra['telemetry_overhead_paired_pct']:+.2f}%)")
+    except Exception as e:
+        log(f"[bench] telemetry overhead bench skipped: {e}")
+
+
 def bench_pixel_rl(extra):
     """Pixel-RL throughput: conv-PPO on the native MinAtar-style
     Breakout (BASELINE.json north star #2 — "RLlib PPO Atari"; ale_py is
@@ -841,6 +962,7 @@ def main():
     bench_runtime(extra)
     bench_broadcast(extra)
     bench_data_pipeline(extra)
+    bench_telemetry_overhead(extra)
     bench_pixel_rl(extra)
     mfu = bench_tpu_train(extra)
     if mfu is not None:

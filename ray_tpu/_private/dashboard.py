@@ -92,6 +92,18 @@ async def start_dashboard(gcs, port: int) -> Optional[str]:
         text = await gcs._rpc_metrics_text({}, None)
         return web.Response(text=text, content_type="text/plain")
 
+    # ---- device telemetry snapshots (observability/step_telemetry.py →
+    # telemetry.report): the latest per-reporter JSON for each kind,
+    # e.g. {"<reporter>": {"steps": {"train_step": {mfu_pct, ...}}}}
+    async def api_training(request):
+        return await _json(await gcs._rpc_telemetry_get({"kind": "training"}, None))
+
+    async def api_serve(request):
+        return await _json(await gcs._rpc_telemetry_get({"kind": "serve"}, None))
+
+    async def api_data(request):
+        return await _json(await gcs._rpc_telemetry_get({"kind": "data"}, None))
+
     # ---- REST job submission (reference: dashboard/modules/job/job_head.py
     # — POST /api/jobs/, GET /api/jobs/{id}, /logs, POST /stop). The GCS
     # process is not a ray driver, so mutations run through a short-lived
@@ -223,6 +235,9 @@ async def start_dashboard(gcs, port: int) -> Optional[str]:
     app.router.add_get("/api/jobs/{job_id}", api_job_get)
     app.router.add_get("/api/jobs/{job_id}/logs", api_job_logs)
     app.router.add_post("/api/jobs/{job_id}/stop", api_job_stop)
+    app.router.add_get("/api/training", api_training)
+    app.router.add_get("/api/serve", api_serve)
+    app.router.add_get("/api/data", api_data)
     app.router.add_get("/metrics", metrics)
     runner = web.AppRunner(app, access_log=None)
     await runner.setup()

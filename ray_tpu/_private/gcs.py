@@ -1442,6 +1442,35 @@ class GcsServer:
     async def _rpc_spans_list(self, d, conn):
         return list(getattr(self, "trace_spans", ()))
 
+    async def _rpc_telemetry_report(self, d, conn):
+        """Latest device-telemetry snapshot per (kind, reporter) — the
+        JSON the dashboard's /api/training and /api/serve serve. Unlike
+        the metrics table this is last-write-wins per reporter: a
+        snapshot is a state, not a series."""
+        if not hasattr(self, "telemetry"):
+            self.telemetry: Dict[str, Dict[str, Any]] = {}
+        table = self.telemetry.setdefault(d["kind"], {})
+        now = time.time()
+        table[d["reporter"]] = {"time": now, "snapshot": d["snapshot"]}
+        # prune dead reporters here, not just filter them on read:
+        # worker churn mints a fresh reporter id per process, so the
+        # table would otherwise grow one dead snapshot per worker ever
+        # spawned on a long-lived head node
+        cutoff = now - 120
+        for reporter in [r for r, rec in table.items() if rec["time"] < cutoff]:
+            del table[reporter]
+        return True
+
+    async def _rpc_telemetry_get(self, d, conn):
+        """Snapshots for one kind, stale reporters (>120s) dropped."""
+        table = getattr(self, "telemetry", {}).get(d.get("kind", ""), {})
+        cutoff = time.time() - 120
+        return {
+            reporter[:12]: rec["snapshot"]
+            for reporter, rec in table.items()
+            if rec["time"] >= cutoff
+        }
+
     async def _rpc_state_tasks(self, d, conn):
         limit = d.get("limit", 1000)
         return list(self.task_events)[-limit:]
@@ -1543,6 +1572,9 @@ async def _amain(args):
 
 
 def main():
+    from ray_tpu._private.node import arm_pdeathsig
+
+    arm_pdeathsig()  # die with the spawning driver (see node.py)
     parser = argparse.ArgumentParser()
     parser.add_argument("--session-dir", required=True)
     parser.add_argument("--port", type=int, default=0)

@@ -21,11 +21,22 @@ from typing import Any, Dict, List, Optional
 from ray_tpu._private.config import RayConfig
 
 
-def _die_with_parent():
-    """PR_SET_PDEATHSIG: kill the child if the spawning driver dies (even by
-    SIGKILL), so `init()`-local clusters can never outlive their driver.
-    Standalone clusters started via the CLI skip this (they set
-    RAY_TPU_DETACHED=1)."""
+def arm_pdeathsig() -> None:
+    """PR_SET_PDEATHSIG: kill this process if its spawning parent dies
+    (even by SIGKILL), so `init()`-local clusters can never outlive
+    their driver. Called by the CHILD entrypoints (gcs / raylet /
+    worker_proc) at startup, NOT as a Popen preexec_fn: preexec_fn
+    forces the fork path through Python's at-fork handlers, which both
+    risks deadlock when the spawning driver is multithreaded (any
+    import/logging lock held by another thread at fork time stays held
+    forever in the child) and spews JAX's "os.fork() is incompatible
+    with multithreaded code" RuntimeWarning on every node launch. The
+    parent requests the arming via RAY_TPU_DIE_WITH_PARENT=1 and passes
+    its pid so the (tiny) window where the parent dies before prctl runs
+    is closed by a getppid check. Standalone clusters started via the
+    CLI skip this (they set RAY_TPU_DETACHED=1)."""
+    if os.environ.get("RAY_TPU_DIE_WITH_PARENT") != "1":
+        return
     if os.environ.get("RAY_TPU_DETACHED") == "1":
         return
     try:
@@ -35,7 +46,12 @@ def _die_with_parent():
         libc = ctypes.CDLL("libc.so.6", use_errno=True)
         libc.prctl(PR_SET_PDEATHSIG, signal.SIGKILL)
     except Exception:
-        pass
+        return
+    expected = os.environ.get("RAY_TPU_PARENT_PID")
+    if expected and str(os.getppid()) != expected:
+        # parent died in the spawn->prctl window: PDEATHSIG will never
+        # fire (we were already reparented), honor the contract now
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 class NodeProcesses:
@@ -56,14 +72,20 @@ class NodeProcesses:
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         parts = [pkg_root] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
         env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+        # no preexec_fn: the child arms PR_SET_PDEATHSIG itself (see
+        # arm_pdeathsig) so spawning from a multithreaded JAX driver
+        # never runs Python at-fork handlers; close_fds explicit — the
+        # child must not inherit sockets/arena fds it doesn't own
+        env["RAY_TPU_DIE_WITH_PARENT"] = "1"
+        env["RAY_TPU_PARENT_PID"] = str(os.getpid())
         proc = subprocess.Popen(
             [sys.executable, "-u"] + args,
             stdout=subprocess.PIPE,
             stderr=logf,
             text=True,
             start_new_session=True,
+            close_fds=True,
             env=env,
-            preexec_fn=_die_with_parent,
         )
         self.procs.append(proc)
         deadline = time.time() + timeout

@@ -477,23 +477,23 @@ class Raylet:
         log_path = os.path.join(self.session_dir, "logs", f"worker-{worker_id[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
         logf = open(log_path, "ab")
-        def _worker_dies_with_raylet():
-            # unconditional: workers never outlive their raylet
-            try:
-                import ctypes
-
-                libc = ctypes.CDLL("libc.so.6", use_errno=True)
-                libc.prctl(1, signal.SIGKILL)  # PR_SET_PDEATHSIG
-            except Exception:
-                pass
-
+        # workers never outlive their raylet: the worker arms
+        # PR_SET_PDEATHSIG itself at startup (node.arm_pdeathsig) instead
+        # of via preexec_fn — a preexec_fn forces the fork through
+        # Python's at-fork handlers, which can deadlock under a
+        # multithreaded parent and trips JAX's os.fork() RuntimeWarning.
+        # RAY_TPU_DETACHED is dropped: it detaches NODES from the CLI,
+        # never workers from their raylet.
+        env["RAY_TPU_DIE_WITH_PARENT"] = "1"
+        env["RAY_TPU_PARENT_PID"] = str(os.getpid())
+        env.pop("RAY_TPU_DETACHED", None)
         proc = subprocess.Popen(
             [sys.executable, "-u", "-m", "ray_tpu._private.worker_proc"],
             env=env,
             stdout=logf,
             stderr=subprocess.STDOUT,
             start_new_session=True,
-            preexec_fn=_worker_dies_with_raylet,
+            close_fds=True,
         )
         h = WorkerHandle(worker_id, proc, log_path=log_path)
         self.workers[worker_id] = h
@@ -926,6 +926,9 @@ async def _amain(args):
 
 
 def main():
+    from ray_tpu._private.node import arm_pdeathsig
+
+    arm_pdeathsig()  # die with the spawning driver (see node.py)
     parser = argparse.ArgumentParser()
     parser.add_argument("--gcs", required=True)
     parser.add_argument("--session-dir", required=True)

@@ -663,6 +663,9 @@ async def _amain():
 
 
 def main():
+    from ray_tpu._private.node import arm_pdeathsig
+
+    arm_pdeathsig()  # die with the spawning raylet (see node.py)
     logging.basicConfig(level=logging.INFO)
     # fewer forced GIL handoffs between the IO loop and executor threads:
     # on 1-core hosts the default 5ms check interval costs measurable

@@ -17,6 +17,37 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.util.metrics import metric_singletons as _metric_singletons
+
+
+def _data_metrics_factory():
+    """Singletons bridging Dataset.stats() into the metrics pipeline
+    (dashboard /metrics): per-operator task/row/byte counters and
+    per-policy throttle counts."""
+    from ray_tpu.util import metrics
+
+    return dict(
+        tasks=metrics.Counter(
+            "ray_tpu_data_tasks_total", "data tasks launched",
+            tag_keys=("operator",)),
+        rows_out=metrics.Counter(
+            "ray_tpu_data_rows_out_total", "rows produced",
+            tag_keys=("operator",)),
+        bytes_out=metrics.Counter(
+            "ray_tpu_data_bytes_out_total", "bytes produced",
+            tag_keys=("operator",)),
+        task_time=metrics.Counter(
+            "ray_tpu_data_task_time_s_total", "task wall time",
+            tag_keys=("operator",)),
+        throttles=metrics.Counter(
+            "ray_tpu_data_backpressure_throttles_total",
+            "launch refusals by policy",
+            tag_keys=("operator", "policy")),
+    )
+
+
+_data_metrics = _metric_singletons(_data_metrics_factory)
+
 
 def _fmt_bytes(n: int) -> str:
     for unit in ("B", "KB", "MB", "GB"):
@@ -99,6 +130,8 @@ class StatsBuilder:
         self._finalized = False
         self._launches_complete = False
         self._built: Optional[DatasetStats] = None
+        self._published_driver = False  # tasks/throttles (at finalize)
+        self._published_meta = False    # rows/bytes/time (at settled build)
 
     def _ensure(self, stage: str):
         if stage not in self._tasks:
@@ -135,11 +168,25 @@ class StatsBuilder:
     def finalize(self):
         """Mark the execution complete (called by the executor when the
         pipeline drains or is closed). Only finalized builders cache
-        their built snapshot."""
+        their built snapshot. Driver-side counters (launches, throttles)
+        bridge into the metrics pipeline HERE — no ref waits on the
+        drain path; the task-side sums follow when stats() settles."""
         if self.t_end is None:
             self.t_end = time.perf_counter()
         self._finalized = True
         self._launches_complete = True
+        if not self._published_driver:
+            self._published_driver = True
+            try:
+                m = _data_metrics()
+                for name in self._order:
+                    tags = {"operator": name}
+                    if self._tasks.get(name):
+                        m["tasks"].inc(self._tasks[name], tags=tags)
+                    for policy, n in self._throttled.get(name, {}).items():
+                        m["throttles"].inc(n, tags={**tags, "policy": policy})
+            except Exception:
+                pass
 
     def build(self, *, timeout: float = 120.0) -> DatasetStats:
         """Resolve task-side metas into a snapshot. A stats() call
@@ -199,4 +246,43 @@ class StatsBuilder:
         # launches may come) is never cached.
         if self._finalized or (self._launches_complete and all_resolved):
             self._built = built
+            self._publish_metrics(built)
         return built
+
+    def _publish_metrics(self, built: DatasetStats) -> None:
+        """Once per execution, when the snapshot settles: the task-side
+        sums (rows/bytes/time) join the metrics pipeline, and the whole
+        stats dict ships as the "data" telemetry snapshot so the
+        dashboard's /api/data serves the latest execution. Mid-stream
+        snapshots never publish — they would double-count when the
+        final one lands. Launch/throttle counters already published at
+        finalize()."""
+        if self._published_meta:
+            return
+        self._published_meta = True
+        try:
+            from ray_tpu import observability
+
+            observability.publish_snapshot("data", {"dataset": built.to_dict()})
+        except Exception:
+            pass
+        try:
+            m = _data_metrics()
+            publish_driver = not self._published_driver
+            self._published_driver = True
+            for name, op in built.operators.items():
+                tags = {"operator": name}
+                if publish_driver:
+                    # eager path: no finalize() — launches publish here
+                    if op.get("tasks"):
+                        m["tasks"].inc(op["tasks"], tags=tags)
+                    for policy, n in op.get("throttled", {}).items():
+                        m["throttles"].inc(n, tags={**tags, "policy": policy})
+                if op.get("rows_out"):
+                    m["rows_out"].inc(op["rows_out"], tags=tags)
+                if op.get("bytes_out"):
+                    m["bytes_out"].inc(op["bytes_out"], tags=tags)
+                if op.get("task_s"):
+                    m["task_time"].inc(op["task_s"], tags=tags)
+        except Exception:
+            pass

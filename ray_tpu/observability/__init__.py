@@ -1,0 +1,135 @@
+"""ray_tpu.observability — unified TPU observability.
+
+Three layers, one pipeline:
+
+- `instrument_step(fn, flops_per_call=...)` wraps any jitted hot path
+  with near-zero-overhead step telemetry (wall time, goodput, compile
+  events, live MFU, device memory high-water) — `step_telemetry.py`.
+- Telemetry snapshots flush through the existing GCS metrics path and
+  surface as Prometheus gauges on the dashboard `/metrics` plus JSON
+  snapshots on `/api/training`, `/api/serve` and `/api/data`.
+- `export_trace(path)` merges the task timeline, RPC spans and device
+  step/compile events into ONE Chrome/Perfetto trace with parent
+  linkage from driver spans into the device steps they caused —
+  `trace_export.py`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.observability.step_telemetry import (  # noqa: F401
+    StepTelemetry,
+    all_telemetries,
+    get,
+    instrument_step,
+    peak_flops,
+)
+from ray_tpu.observability.trace_export import export_trace  # noqa: F401
+
+__all__ = [
+    "StepTelemetry",
+    "instrument_step",
+    "export_trace",
+    "peak_flops",
+    "get",
+    "all_telemetries",
+    "publish_snapshot",
+    "flush",
+    "flush_async",
+    "snapshot",
+]
+
+# driver-side extras merged into the published snapshot per kind
+# (e.g. the trainer's per-report metrics, an engine's serving counters)
+_extras_lock = threading.Lock()
+_extras: Dict[str, Dict[str, Any]] = {}
+
+# background snapshot flusher: hot paths (the engine decode loop, the
+# instrumented train step) must NEVER block on the GCS round-trip — a
+# stalled GCS would freeze serving/training through a telemetry push.
+# They queue a kind here; one daemon thread drains, coalescing bursts.
+_flush_lock = threading.Lock()
+_flush_dirty: set = set()
+_flush_wake = threading.Event()
+_flush_thread: Optional[threading.Thread] = None
+
+
+def publish_snapshot(kind: str, data: Dict[str, Any]) -> None:
+    """Merge `data` into this process's `kind` ("training" / "serve")
+    snapshot and queue a push to the GCS so the dashboard's /api/<kind>
+    serves it. Values must be JSON-safe. The push happens on a
+    background thread — call flush(kind) to force a synchronous one."""
+    with _extras_lock:
+        _extras.setdefault(kind, {}).update(data)
+    flush_async(kind)
+
+
+def flush_async(kind: Optional[str] = None) -> None:
+    """Queue a GCS snapshot push on the background flusher thread."""
+    global _flush_thread
+    with _flush_lock:
+        _flush_dirty.add(kind)
+        if _flush_thread is None or not _flush_thread.is_alive():
+            _flush_thread = threading.Thread(
+                target=_flush_loop, daemon=True, name="telemetry-flush")
+            _flush_thread.start()
+    _flush_wake.set()
+
+
+def _flush_loop() -> None:
+    while True:
+        _flush_wake.wait()
+        _flush_wake.clear()
+        with _flush_lock:
+            kinds = list(_flush_dirty)
+            _flush_dirty.clear()
+        for k in kinds:
+            try:
+                flush(k)
+            except Exception:
+                pass
+
+
+def snapshot(kind: Optional[str] = None) -> Dict[str, Any]:
+    """This process's current telemetry snapshot: every registered
+    StepTelemetry of `kind` (all kinds when None) plus published
+    extras."""
+    out: Dict[str, Any] = {"time": time.time(), "steps": {}}
+    for tel in all_telemetries():
+        if kind is None or tel.kind == kind:
+            out["steps"][tel.name] = tel.snapshot()
+    with _extras_lock:
+        for k, d in _extras.items():
+            if kind is None or k == kind:
+                out.update(d)
+    return out
+
+
+def flush(kind: Optional[str] = None, *, timeout: float = 5.0) -> bool:
+    """Push the latest snapshot(s) to the GCS synchronously
+    (best-effort; no cluster → False). Hot paths go through
+    flush_async instead; the timeout bounds the RPC so even a direct
+    call can never hang its caller on a wedged GCS. Snapshot time is
+    also when the memory high-water gauge refreshes — sampling device
+    memory can walk live buffers, which must stay off the step path."""
+    try:
+        from ray_tpu._private.worker import get_global_core
+        from ray_tpu.observability.step_telemetry import _refresh_mem_gauges
+
+        core = get_global_core()
+        kinds = [kind] if kind else sorted(
+            {t.kind for t in all_telemetries()} | set(_extras)
+        )
+        for k in kinds:
+            snap = snapshot(k)
+            _refresh_mem_gauges(snap.get("steps", {}))
+            core.gcs_request(
+                "telemetry.report",
+                {"kind": k, "reporter": core.worker_id, "snapshot": snap},
+                timeout=timeout,
+            )
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,128 @@
+"""Unified Chrome/Perfetto trace: tasks + RPC spans + device steps.
+
+Dapper's core lesson is that device events must land in the SAME trace
+as the RPC spans that caused them — a separate per-tool timeline cannot
+answer "which macro-step did this slow request ride?". This exporter
+merges three sources onto one Chrome-trace JSON file (loadable in
+Perfetto / chrome://tracing):
+
+- the task timeline (`util/timeline.py`): one row per worker, a slice
+  per task RUNNING→FINISHED (open-ended for still-RUNNING tasks)
+- RPC spans (`util/tracing.py` — submit/run spans collected by the
+  GCS): one row per trace, nested by parent
+- device step/compile events (`observability.step_telemetry`): one row
+  per (process, device, hot path). Steps recorded under a trace context
+  arrive as DEVICE-kind spans from any process in the cluster; ctx-less
+  steps come from this process's local telemetry rings.
+
+Parent linkage is double-encoded: `args.parent_span_id` on every child
+slice (greppable/assertable), plus Chrome flow arrows (`ph: s/f`) from
+the parent span's slice to the device step so Perfetto draws the
+request → dispatch path.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def _span_events(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """RPC + device spans as Chrome slices. Device-kind spans get
+    per-device rows; RPC spans get one row per trace id so a request's
+    submit/run ladder reads top-to-bottom."""
+    events: List[Dict[str, Any]] = []
+    span_rows: Dict[str, tuple] = {}
+    for s in spans:
+        start = s.get("start", 0.0)
+        end = s.get("end", start)
+        if s.get("kind") == "DEVICE":
+            pid, tid = "device", f"{s.get('device', '?')}/{s.get('step_name', '?')}"
+            cat = "device_step"
+        else:
+            pid, tid = "rpc", (s.get("trace_id") or "?")[:12]
+            cat = "span"
+        span_rows[s.get("span_id", "")] = (pid, tid, start)
+        args = {"trace_id": s.get("trace_id"), "span_id": s.get("span_id")}
+        if s.get("parent_id"):
+            args["parent_span_id"] = s["parent_id"]
+        if s.get("status"):
+            args["status"] = s["status"]
+        if s.get("links"):
+            args["links"] = s["links"]
+        events.append({
+            "name": s.get("name", "span"), "cat": cat, "ph": "X",
+            "ts": start * 1e6, "dur": max(0.0, (end - start)) * 1e6,
+            "pid": pid, "tid": tid, "args": args,
+        })
+    # flow arrows: parent span slice -> device step slice
+    for s in spans:
+        if s.get("kind") != "DEVICE" or not s.get("parent_id"):
+            continue
+        parent = span_rows.get(s["parent_id"])
+        if parent is None:
+            continue
+        ppid, ptid, pstart = parent
+        fid = s.get("span_id", "")
+        events.append({
+            "name": "dispatch", "cat": "ctx", "ph": "s", "id": fid,
+            "ts": max(pstart, s.get("start", pstart)) * 1e6,
+            "pid": ppid, "tid": ptid,
+        })
+        events.append({
+            "name": "dispatch", "cat": "ctx", "ph": "f", "bp": "e", "id": fid,
+            "ts": s.get("start", 0.0) * 1e6,
+            "pid": "device", "tid": f"{s.get('device', '?')}/{s.get('step_name', '?')}",
+        })
+    return events
+
+
+def _local_device_events() -> List[Dict[str, Any]]:
+    from ray_tpu.observability import step_telemetry
+
+    events = []
+    for tel in step_telemetry.all_telemetries():
+        for ev in tel.events():
+            events.append({
+                "name": ev["name"],
+                "cat": "device_step",
+                "ph": "X",
+                "ts": ev["start"] * 1e6,
+                "dur": max(0.0, ev["end"] - ev["start"]) * 1e6,
+                "pid": "device",
+                "tid": f"{ev['device']}/{tel.name}",
+                "args": {"step": ev["step"], "compile": ev["compile"]},
+            })
+    return events
+
+
+def export_trace(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Merge tasks, RPC spans and device step/compile events into one
+    Chrome-trace event list; write it to `path` when given. Works
+    degraded without a cluster (local device events only)."""
+    events: List[Dict[str, Any]] = []
+    try:
+        from ray_tpu.util.timeline import timeline
+
+        events.extend(timeline())
+    except Exception:
+        pass
+    spans: List[Dict[str, Any]] = []
+    try:
+        from ray_tpu.util import tracing
+
+        spans = tracing.get_spans()
+    except Exception:
+        # no cluster: whatever this process buffered locally
+        try:
+            from ray_tpu.util import tracing
+
+            spans = list(tracing._buffer)
+        except Exception:
+            spans = []
+    events.extend(_span_events(spans))
+    events.extend(_local_device_events())
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    if path:
+        with open(path, "w") as f:
+            json.dump(events, f)
+    return events

@@ -55,12 +55,133 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu.util.metrics import metric_singletons as _metric_singletons
+
 logger = logging.getLogger(__name__)
+
+# latency histogram boundaries (seconds): wide enough for relay-attached
+# chips (TTFT can run seconds) and fine enough near the fast end for
+# meaningful p50 interpolation
+_TTFT_BOUNDS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                10.0, 30.0, 60.0)
+_TPOT_BOUNDS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5)
+
+
+def _engine_metrics_factory():
+    """Process-wide serving metrics, tagged per engine — a singleton
+    group because the metrics registry keeps every constructed Metric
+    (two engines must not double-register the same name)."""
+    from ray_tpu.util import metrics
+
+    return dict(
+        ttft=metrics.Histogram(
+            "ray_tpu_llm_ttft_s", "time to first token",
+            boundaries=_TTFT_BOUNDS, tag_keys=("engine",)),
+        tpot=metrics.Histogram(
+            "ray_tpu_llm_tpot_s", "time per output token",
+            boundaries=_TPOT_BOUNDS, tag_keys=("engine",)),
+        tokens=metrics.Counter(
+            "ray_tpu_llm_tokens_out_total", "tokens delivered",
+            tag_keys=("engine",)),
+        dispatches=metrics.Counter(
+            "ray_tpu_llm_dispatches_total", "device dispatches",
+            tag_keys=("engine",)),
+        dpt=metrics.Gauge(
+            "ray_tpu_llm_dispatches_per_token",
+            "dispatch amortization", tag_keys=("engine",)),
+        occupancy=metrics.Gauge(
+            "ray_tpu_llm_lane_occupancy_pct",
+            "useful slot-steps / total slot-steps", tag_keys=("engine",)),
+    )
+
+
+_engine_metrics = _metric_singletons(_engine_metrics_factory)
+
+
+class _LatencyHist:
+    """Engine-local latency histogram, mirrored into the shared
+    Prometheus Histogram. The engine loop thread appends while metrics()
+    reads — all mutation under one lock, so the percentile snapshot is
+    consistent by construction (the PR 2 deque fix, structurally).
+
+    Percentiles stay RECENT-weighted on a long-lived replica (the
+    invariant the PR 2 deque carried): bucket counts rotate through two
+    epochs of `epoch` observations each, and percentiles read the last
+    epoch–2·epoch samples — so a latency regression moves p95 within
+    ~epoch requests instead of needing to outvote the process's whole
+    history. The shared Prometheus histogram stays cumulative (series
+    math like rate() expects monotonic counters); resettable
+    (reset_metrics between bench passes)."""
+
+    def __init__(self, bounds, shared_hist, tags, epoch: int = 2048):
+        import bisect
+
+        self._bisect = bisect.bisect_left
+        self.bounds = list(bounds)
+        self._epoch = epoch
+        self._counts = [0] * (len(self.bounds) + 1)   # current epoch
+        self._prev = [0] * (len(self.bounds) + 1)     # previous epoch
+        self._n = 0       # observations in the current epoch
+        self._n_prev = 0
+        self._sum = 0.0   # current-epoch sum (rotates with the counts)
+        self._lock = threading.Lock()
+        self._shared = shared_hist
+        self._tags = tags
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            if self._n >= self._epoch:
+                self._prev, self._counts = (
+                    self._counts, [0] * (len(self.bounds) + 1))
+                self._n_prev, self._n = self._n, 0
+                self._sum = 0.0
+            self._counts[self._bisect(self.bounds, v)] += 1
+            self._sum += v
+            self._n += 1
+        try:
+            self._shared.observe(v, tags=self._tags)
+        except Exception:
+            pass
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._prev = [0] * (len(self.bounds) + 1)
+            self._sum = 0.0
+            self._n = 0
+            self._n_prev = 0
+
+    def percentiles_ms(self, qs=(0.50, 0.95, 0.99)) -> List[Optional[float]]:
+        """Prometheus-style interpolation inside the target bucket over
+        the rotating window (previous + current epoch); the +Inf bucket
+        clamps to the last finite boundary."""
+        with self._lock:
+            counts = [p + c for p, c in zip(self._prev, self._counts)]
+            n = self._n_prev + self._n
+        if n == 0:
+            return [None] * len(qs)
+        out = []
+        for q in qs:
+            rank = q * n
+            cum = 0
+            val = self.bounds[-1]
+            for i, c in enumerate(counts):
+                prev_cum = cum
+                cum += c
+                if cum >= rank and c > 0:
+                    lo = self.bounds[i - 1] if i > 0 else 0.0
+                    hi = self.bounds[i] if i < len(self.bounds) else self.bounds[-1]
+                    val = lo + (hi - lo) * ((rank - prev_cum) / c)
+                    break
+            out.append(round(val * 1e3, 3))
+        return out
 
 
 class _Request:
     __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
-                 "_first_dev", "_remaining", "_t_submit", "_t_first", "_t_done")
+                 "_first_dev", "_remaining", "_t_submit", "_t_first",
+                 "_t_done", "_trace_ctx")
 
     def __init__(self, prompt, max_new_tokens):
         self.prompt = prompt
@@ -73,11 +194,17 @@ class _Request:
         self._t_submit = time.perf_counter()
         self._t_first: Optional[float] = None
         self._t_done: Optional[float] = None
+        # trace context captured on the SUBMITTING thread (the engine
+        # loop runs in its own thread, where the contextvar is unset):
+        # the dispatches this request rides parent under it, so a slow
+        # serve request is followable proxy span → replica task → the
+        # exact macro-steps that decoded it
+        self._trace_ctx: Optional[Dict[str, str]] = None
 
 
 class ContinuousBatchingEngine:
     def __init__(self, params, cfg, n_slots: int = 8, max_len: int = 0,
-                 chunk: int = 8, macro_phases: int = 8):
+                 chunk: int = 8, macro_phases: int = 8, name: str = "default"):
         import functools
 
         import jax
@@ -110,13 +237,23 @@ class ContinuousBatchingEngine:
         self._waiting: deque = deque()       # planner-side FIFO (loop thread only)
         self._pending: deque = deque()       # fetch frontier: tagged entries
         self._dead: Optional[str] = None
-        # serving metrics (monotonic counters + latency samples)
+        # serving metrics (monotonic counters + latency histograms)
+        self.name = name
         self._m = {"dispatches": 0, "tokens_out": 0, "slot_steps": 0,
                    "useful_slot_steps": 0}
-        # bounded latency windows: a long-lived replica must not grow a
-        # sample per request forever (percentiles stay recent-weighted)
-        self._ttft: deque = deque(maxlen=2048)
-        self._tpot: deque = deque(maxlen=2048)
+        shared = _engine_metrics()
+        self._tags = {"engine": name}
+        self._ttft = _LatencyHist(_TTFT_BOUNDS, shared["ttft"], self._tags)
+        self._tpot = _LatencyHist(_TPOT_BOUNDS, shared["tpot"], self._tags)
+        # device-step telemetry for each dispatch: host dispatch slices
+        # land on the unified trace's device rows, parented under the
+        # trace contexts of the requests each dispatch serves
+        from ray_tpu.observability import StepTelemetry, get as _get_tel
+
+        self._tel = _get_tel(f"llm_dispatch:{name}") or StepTelemetry(
+            f"llm_dispatch:{name}", kind="serve")
+        self._jit_cache_sizes: Dict[int, int] = {}
+        self._t_snapshot = 0.0
         self._wake = threading.Event()
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -138,6 +275,12 @@ class ContinuousBatchingEngine:
                 f"engine max_len {self.max_len}"
             )
         req = _Request([int(t) for t in prompt], max_new_tokens)
+        try:
+            from ray_tpu.util import tracing
+
+            req._trace_ctx = tracing.current_context()
+        except Exception:
+            pass
         self._queue.put(req)
         if self._dead is not None:
             # lost the race with the loop dying: the dead loop will never
@@ -166,40 +309,34 @@ class ContinuousBatchingEngine:
     def metrics(self) -> Dict[str, Any]:
         """Serving metrics since construction (or reset_metrics()):
         dispatch counts, dispatches/token, lane occupancy %, TTFT/TPOT
-        percentiles. Tokens count at DELIVERY, so read after requests
-        complete for exact ratios."""
+        p50/p95/p99 from the latency histograms (bucket-interpolated;
+        the histogram lock makes the snapshot safe against the engine
+        loop's concurrent appends). Tokens count at DELIVERY, so read
+        after requests complete for exact ratios."""
         m = dict(self._m)
         toks = max(1, m["tokens_out"])
         m["dispatches_per_token"] = round(m["dispatches"] / toks, 4)
         m["lane_occupancy_pct"] = round(
             100.0 * m["useful_slot_steps"] / max(1, m["slot_steps"]), 1
         )
-
-        def pct(xs, q):
-            if not xs:
-                return None
-            s = sorted(xs)
-            return round(s[min(len(s) - 1, int(q * len(s)))] * 1e3, 2)
-
-        # snapshot: the engine loop thread appends to these deques while
-        # we sort (deque iteration raises on concurrent mutation; retry
-        # the copy — appends are GIL-atomic so a clean pass converges)
-        ttft, tpot = [], []
-        for _ in range(8):
-            try:
-                ttft, tpot = list(self._ttft), list(self._tpot)
-                break
-            except RuntimeError:
-                continue
-        m["ttft_ms_p50"] = pct(ttft, 0.50)
-        m["ttft_ms_p95"] = pct(ttft, 0.95)
-        m["tpot_ms_p50"] = pct(tpot, 0.50)
-        m["tpot_ms_p95"] = pct(tpot, 0.95)
+        for key, hist in (("ttft", self._ttft), ("tpot", self._tpot)):
+            p50, p95, p99 = hist.percentiles_ms()
+            m[f"{key}_ms_p50"] = p50
+            m[f"{key}_ms_p95"] = p95
+            m[f"{key}_ms_p99"] = p99
+        try:
+            g = _engine_metrics()
+            g["dpt"].set(m["dispatches_per_token"], tags=self._tags)
+            g["occupancy"].set(m["lane_occupancy_pct"], tags=self._tags)
+        except Exception:
+            pass
         return m
 
     def reset_metrics(self) -> None:
         self._m = {k: 0 for k in self._m}
-        self._ttft, self._tpot = deque(maxlen=2048), deque(maxlen=2048)
+        self._ttft.reset()
+        self._tpot.reset()
+        self._tel.reset()
 
     # ------------------------------------------------------------ engine
     def _bucket(self, n: int) -> int:
@@ -276,6 +413,7 @@ class ContinuousBatchingEngine:
                 lengths[k, a] = len(req.prompt)
                 slots[k, a] = slot
                 rems[k, a] = req.max_new_tokens - 1
+        t0 = time.perf_counter()
         try:
             toks_dev, firsts_dev, self._next_dev, self.cache = self._macro_fn(
                 self.params, self.cache, self._next_dev,
@@ -288,6 +426,11 @@ class ContinuousBatchingEngine:
             # are already evicted from the host bookkeeping)
             self._pending.append(("macro", None, None, phases))
             raise
+        self._record_dispatch(
+            t0, time.perf_counter(), self._macro_fn,
+            [r for p in phases for _, r in p["admissions"]]
+            + [r for p in phases for _, r, _ in p["takes"]],
+        )
         self._m["dispatches"] += 1
         for ph in phases:
             self._m["slot_steps"] += ph["steps"] * self.n_slots
@@ -340,10 +483,13 @@ class ContinuousBatchingEngine:
                 prompts[n, : len(req.prompt)] = req.prompt
                 lengths[n] = len(req.prompt)
                 slots[n] = slot
+            t0 = time.perf_counter()
             firsts, self.cache = self._prefill_slots(
                 self.params, jnp.asarray(prompts), jnp.asarray(lengths),
                 jnp.asarray(slots), self.cache,
             )
+            self._record_dispatch(t0, time.perf_counter(), self._prefill_slots,
+                                  [req for _, req in members])
             self._m["dispatches"] += 1
             rem_updates = np.zeros(len(members), np.int32)
             for n, (_slot, req) in enumerate(members):
@@ -379,8 +525,11 @@ class ContinuousBatchingEngine:
                 self._pending.append(("chunk", None, takes))
                 continue
             # dispatch the next chunk fed from device-side tokens (no sync)
+            t0 = time.perf_counter()
             toks_dev, self.cache = self._chunk_fn(self.params, self.cache, self._next_dev)
             self._next_dev = toks_dev[:, -1]
+            self._record_dispatch(t0, time.perf_counter(), self._chunk_fn,
+                                  [r for _, r in active])
             self._m["dispatches"] += 1
             self._m["slot_steps"] += self.chunk * self.n_slots
             # deterministic bookkeeping: plan takes + evictions from
@@ -400,6 +549,44 @@ class ContinuousBatchingEngine:
                 self._resolve(self._pending.popleft())
 
     # ---- shared plumbing ----------------------------------------------
+    def _record_dispatch(self, t0: float, t1: float, jit_fn, reqs) -> None:
+        """Device-step telemetry for ONE dispatch: the host dispatch
+        slice, compile-detected from the jit cache, parented under the
+        trace ctx of the first traced request it serves (the rest ride
+        as links). Counters only — never a device sync."""
+        try:
+            compiled = False
+            cache_size = getattr(jit_fn, "_cache_size", None)
+            if cache_size is not None:
+                n = cache_size()
+                key = id(jit_fn)
+                seen = self._jit_cache_sizes.get(key, 0)
+                compiled = n > seen
+                self._jit_cache_sizes[key] = max(n, seen)
+            ctxs, seen_spans = [], set()
+            for r in reqs:
+                c = r._trace_ctx
+                if c is not None and c["span_id"] not in seen_spans:
+                    seen_spans.add(c["span_id"])
+                    ctxs.append(c)
+            self._tel.record(
+                t0, t1, compiled=compiled,
+                ctx=ctxs[0] if ctxs else None,
+                links=ctxs[1:] or None,
+            )
+            _engine_metrics()["dispatches"].inc(1, tags=self._tags)
+            # throttled /api/serve snapshot push (queued — the GCS RPC
+            # runs on the telemetry flusher thread, never this loop)
+            if t1 - self._t_snapshot >= 2.0:
+                self._t_snapshot = t1
+                from ray_tpu import observability
+
+                observability.publish_snapshot(
+                    "serve", {f"engine:{self.name}": self.metrics()}
+                )
+        except Exception:
+            pass
+
     def _drain_queue(self) -> None:
         while True:
             try:
@@ -410,13 +597,17 @@ class ContinuousBatchingEngine:
     def _deliver(self, req: _Request, toks) -> None:
         if req._t_first is None and (req.tokens or toks):
             req._t_first = time.perf_counter()
-            self._ttft.append(req._t_first - req._t_submit)
+            self._ttft.observe(req._t_first - req._t_submit)
         req.tokens.extend(toks)
         self._m["tokens_out"] += len(toks)
+        try:
+            _engine_metrics()["tokens"].inc(len(toks), tags=self._tags)
+        except Exception:
+            pass
         if len(req.tokens) >= req.max_new_tokens and not req.done.is_set():
             req._t_done = time.perf_counter()
             if req._t_first is not None and len(req.tokens) > 1:
-                self._tpot.append(
+                self._tpot.observe(
                     (req._t_done - req._t_first) / (len(req.tokens) - 1)
                 )
             req.done.set()

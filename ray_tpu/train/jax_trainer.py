@@ -28,9 +28,57 @@ from ray_tpu.air.config import CheckpointConfig, FailureConfig, RunConfig, Scali
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.train._internal import storage
 from ray_tpu.train._internal.worker_group import WorkerGroup
+from ray_tpu.util.metrics import metric_singletons
 from ray_tpu.util.queue import Queue
 
 logger = logging.getLogger("ray_tpu.train")
+
+
+def _train_metrics_factory():
+    from ray_tpu.util import metrics
+
+    return dict(
+        report=metrics.Gauge(
+            "ray_tpu_train_report",
+            "latest rank-0 train.report() metrics", tag_keys=("metric",)),
+    )
+
+
+_train_metrics = metric_singletons(_train_metrics_factory)
+
+
+def _publish_train_report(item: Dict[str, Any]) -> None:
+    """Rank-0 report → live training telemetry: numeric metrics become
+    gauges on /metrics (tagged by name) and the latest report joins the
+    /api/training snapshot, alongside any step-telemetry MFU/goodput the
+    worker's instrumented step_fn already flushes. Best-effort — a
+    telemetry hiccup must never fail training."""
+    try:
+        from ray_tpu import observability
+
+        numeric = {}
+        for k, v in (item.get("metrics") or {}).items():
+            try:
+                numeric[k] = float(v)
+            except (TypeError, ValueError):
+                continue
+        g = _train_metrics()["report"]
+        for k, v in numeric.items():
+            g.set(v, tags={"metric": k})
+        # the GCS push is a sync round-trip: throttle it so a loop
+        # reporting every step can't stall the result-draining loop
+        now = time.monotonic()
+        if now - _publish_train_report._t_last >= 0.5:
+            _publish_train_report._t_last = now
+            observability.publish_snapshot(
+                "training",
+                {"iteration": item.get("iteration"), "report": numeric},
+            )
+    except Exception:
+        pass
+
+
+_publish_train_report._t_last = -1e9
 
 
 class Result:
@@ -162,6 +210,7 @@ class JaxTrainer:
                         break
                     if item["rank"] == 0:
                         last_metrics = item["metrics"]
+                        _publish_train_report(item)
                         if item.get("checkpoint"):
                             last_ckpt = item["checkpoint"]
                             storage.prune_checkpoints(run_dir, cc.num_to_keep)
@@ -173,6 +222,7 @@ class JaxTrainer:
                     break
                 if item["rank"] == 0:
                     last_metrics = item["metrics"]
+                    _publish_train_report(item)
                     if item.get("checkpoint"):
                         last_ckpt = item["checkpoint"]
                         storage.prune_checkpoints(run_dir, cc.num_to_keep)

@@ -31,11 +31,19 @@ def build_sharded_train_step(
     weight_decay: float = 0.1,
     grad_clip: float = 1.0,
     model=None,
+    telemetry: bool = True,
+    telemetry_name: str = "train_step",
 ) -> Tuple[Callable, Callable, Any, "LogicalAxisRules"]:
     """Returns (init_fn, step_fn, tx, rules).
 
     init_fn(rng, batch_shape) -> sharded train state on the mesh.
     step_fn(state, batch) -> (state, metrics) — fully jitted SPMD.
+
+    `telemetry=True` (default) wraps step_fn with
+    observability.instrument_step: per-step wall time, goodput, compile
+    events and a live MFU estimate (FLOPs from the model's analytic
+    `flops_per_token` at the batch's token shape) flow to the metrics
+    pipeline and the unified trace at zero change to the compiled HLO.
     """
     from ray_tpu.models import llama as L
 
@@ -70,6 +78,33 @@ def build_sharded_train_step(
         return (
             {"params": params, "opt": opt, "step": state["step"] + 1},
             {"loss": l, "grad_norm": gnorm, "step": state["step"] + 1},
+        )
+
+    if telemetry:
+        from ray_tpu.observability import instrument_step
+
+        flops_fn = getattr(model, "flops_per_token", None)
+        _flops_cache: Dict[Tuple[int, ...], float] = {}
+
+        def _step_flops(args, kwargs):
+            # batch tokens are [B, T+1] (inputs+shifted targets); the
+            # analytic FLOPs are per TRAINED token. Cached per shape —
+            # the math is cheap but the hot path should not repeat it.
+            if flops_fn is None:
+                return None
+            try:
+                tokens = args[1]["tokens"]
+                key = tuple(tokens.shape)
+                if key not in _flops_cache:
+                    b, t1 = tokens.shape
+                    _flops_cache[key] = flops_fn(cfg, t1 - 1) * b * (t1 - 1)
+                return _flops_cache[key]
+            except Exception:
+                return None
+
+        step_fn = instrument_step(
+            step_fn, name=telemetry_name, flops_per_call=_step_flops,
+            kind="training",
         )
 
     def init_fn(rng):

@@ -22,6 +22,24 @@ def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple:
     return tuple(sorted((tags or {}).items()))
 
 
+def metric_singletons(factory):
+    """Zero-arg accessor for a module-level {name: Metric} group, built
+    once on first call (thread-safe). Metric groups must construct
+    lazily (constructing a Metric registers it with the flusher — keep
+    that off import time) and exactly once (the registry keeps every
+    constructed Metric, so re-construction double-registers)."""
+    lock = threading.Lock()
+    cache: Dict[str, "Metric"] = {}
+
+    def get() -> Dict[str, "Metric"]:
+        with lock:
+            if not cache:
+                cache.update(factory())
+            return cache
+
+    return get
+
+
 class Metric:
     metric_type = "untyped"
 
@@ -85,6 +103,23 @@ class Histogram(Metric):
 
             counts[bisect.bisect_left(self.boundaries, value)] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def merge_counts(self, counts: Sequence[int], values_sum: float,
+                     tags: Optional[Dict[str, str]] = None):
+        """Bulk-merge locally accumulated bucket counts (len(boundaries)+1
+        non-cumulative entries, same layout observe() fills). Hot paths
+        (observability.step_telemetry) count into a plain local list per
+        step and merge here on a timer — the per-observation tags
+        merge/sort/lock is the measurable part of the wrapper tax."""
+        if len(counts) != len(self.boundaries) + 1:
+            raise ValueError(
+                f"expected {len(self.boundaries) + 1} buckets, got {len(counts)}")
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            cs = self._counts.setdefault(key, [0] * (len(self.boundaries) + 1))
+            for i, c in enumerate(counts):
+                cs[i] += c
+            self._sums[key] = self._sums.get(key, 0.0) + values_sum
 
     def _samples(self):
         out = []

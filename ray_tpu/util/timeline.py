@@ -7,6 +7,7 @@ the GCS task-event table; open in chrome://tracing or Perfetto).
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private.worker import get_global_core
@@ -15,7 +16,10 @@ from ray_tpu._private.worker import get_global_core
 def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     """Task state transitions as Chrome trace events. Each task becomes
     a duration ("X") event on its worker's row from RUNNING to
-    FINISHED/FAILED, plus instant events for scheduling transitions."""
+    FINISHED/FAILED, plus instant events for scheduling transitions.
+    A task still RUNNING at export time becomes an OPEN-ENDED slice
+    (end = now, args.outcome="RUNNING") — a hung task is exactly what
+    you open the timeline to find, so it must not be silently absent."""
     events = get_global_core().gcs_request("state.tasks", {"limit": 100000})
     starts: Dict[str, Dict[str, Any]] = {}
     trace: List[Dict[str, Any]] = []
@@ -53,6 +57,20 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                     "args": {"task_id": tid},
                 }
             )
+    now_us = time.time() * 1e6
+    for tid, st in starts.items():
+        trace.append(
+            {
+                "name": st.get("name", "task"),
+                "cat": "task",
+                "ph": "X",
+                "ts": st["time"] * 1e6,
+                "dur": max(0.0, now_us - st["time"] * 1e6),
+                "pid": "ray_tpu",
+                "tid": (st.get("worker_id") or st.get("node_id") or "scheduler")[:12],
+                "args": {"task_id": tid, "outcome": "RUNNING"},
+            }
+        )
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextvars
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -137,15 +138,50 @@ class execution_span:
 # ---------------------------------------------------------------- recording
 _buffer: List[Dict[str, Any]] = []
 
+# deferred-flush machinery for spans recorded on hot paths (device step
+# telemetry): those callers must never eat the GCS round-trip inline —
+# a wedged GCS stalling a train step or the engine decode loop through
+# a SPAN push would defeat the whole point of async telemetry. One
+# daemon thread drains on demand; RPC-path spans keep the inline flush
+# (a traced task already pays a GCS push per call by contract).
+_flush_wake = threading.Event()
+_flush_thread: Optional[threading.Thread] = None
+_flush_thread_lock = threading.Lock()
 
-def _record(span: Dict[str, Any]) -> None:
+
+def _record(span: Dict[str, Any], *, defer_flush: bool = False) -> None:
     _buffer.append(span)
     if len(_buffer) >= 128:
-        flush()
+        if defer_flush:
+            _schedule_flush()
+        else:
+            flush()
 
 
-def flush() -> None:
-    """Push buffered spans to the GCS collector (best-effort)."""
+def _schedule_flush() -> None:
+    global _flush_thread
+    with _flush_thread_lock:
+        if _flush_thread is None or not _flush_thread.is_alive():
+            _flush_thread = threading.Thread(
+                target=_flush_loop, daemon=True, name="span-flush")
+            _flush_thread.start()
+    _flush_wake.set()
+
+
+def _flush_loop() -> None:
+    while True:
+        _flush_wake.wait()
+        _flush_wake.clear()
+        try:
+            flush()
+        except Exception:
+            pass
+
+
+def flush(timeout: Optional[float] = 5.0) -> None:
+    """Push buffered spans to the GCS collector (best-effort). The
+    timeout bounds the RPC so no caller can hang forever on a wedged
+    GCS; unsent spans stay buffered for the next flush."""
     global _buffer
     if not _buffer:
         return
@@ -153,7 +189,8 @@ def flush() -> None:
     try:
         from ray_tpu._private.worker import get_global_core
 
-        get_global_core().gcs_request("spans.report", {"spans": spans})
+        get_global_core().gcs_request(
+            "spans.report", {"spans": spans}, timeout=timeout)
     except Exception:
         _buffer = spans + _buffer  # keep for the next flush
 

@@ -251,3 +251,51 @@ def test_continuous_llm_deployment(ray_start_regular):
         assert again == outs[0]
     finally:
         serve.delete("llm_cont")
+
+
+def test_engine_latency_histograms_and_concurrent_metrics():
+    """TTFT/TPOT percentiles come from the real latency histograms
+    (p50/p95/p99 present, ordered, finite) and metrics() stays safe
+    while the engine loop appends concurrently — the histogram lock
+    replaces the PR 2 retry-the-deque-copy dance."""
+    import threading
+
+    engine, _, _ = _tiny_engine(n_slots=2, chunk=4, macro_phases=4)
+    # telemetry objects are shared per engine NAME within a process —
+    # zero the counters so earlier engines in this module don't bleed in
+    engine.reset_metrics()
+    try:
+        errors = []
+
+        def hammer():
+            try:
+                for _ in range(300):
+                    m = engine.metrics()
+                    for k in ("ttft_ms_p50", "ttft_ms_p95", "ttft_ms_p99",
+                              "tpot_ms_p50", "tpot_ms_p95", "tpot_ms_p99"):
+                        assert k in m
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        reqs = [engine.submit([1 + i, 2 + i], 6) for i in range(8)]
+        for r in reqs:
+            assert r.done.wait(180), "engine request timed out"
+        t.join(timeout=120)
+        assert not errors, errors
+
+        m = engine.metrics()
+        assert m["ttft_ms_p50"] is not None and m["ttft_ms_p50"] > 0
+        assert m["ttft_ms_p50"] <= m["ttft_ms_p95"] <= m["ttft_ms_p99"]
+        assert m["tpot_ms_p50"] is not None and m["tpot_ms_p50"] > 0
+        assert m["tpot_ms_p50"] <= m["tpot_ms_p95"] <= m["tpot_ms_p99"]
+        # dispatch telemetry rode along: every dispatch left a device
+        # step event for the unified trace
+        assert engine._tel.steps + engine._tel.compiles >= 1
+        assert engine._tel.steps == m["dispatches"]
+        engine.reset_metrics()
+        m2 = engine.metrics()
+        assert m2["ttft_ms_p50"] is None and m2["tokens_out"] == 0
+    finally:
+        engine.shutdown()

@@ -1,0 +1,380 @@
+"""Unified observability: step telemetry, trace merging, metrics
+histograms, dashboard telemetry endpoints.
+
+Reference test shape: python/ray/tests/test_metrics_agent.py (pipeline
+to the Prometheus endpoint) + test_tracing.py (context propagation),
+extended with the device-step layer this repo adds (MegaScale-style
+always-on step/compile/MFU monitoring landing in ONE merged trace)."""
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+# ------------------------------------------------------------- unit layer
+def test_instrument_step_counters_and_compile_detection():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import observability
+
+    calls = []
+    inner = jax.jit(lambda x: (x * 2.0).sum())
+    step = observability.instrument_step(inner, name="tel_unit")
+    x = jnp.ones(256)
+    for _ in range(6):
+        calls.append(float(step(x)))
+    assert all(c == 512.0 for c in calls)
+    snap = step.telemetry.snapshot()
+    assert snap["steps"] == 6
+    assert snap["compiles"] == 1  # first call compiled, later ones hit cache
+    assert snap["compile_time_s"] > 0
+    assert snap["step_time_ms_avg"] is not None and snap["step_time_ms_avg"] >= 0
+    assert 0 <= snap["goodput_pct"] <= 100
+    # XLA cost analysis picked up FLOPs automatically after the compile
+    assert step.telemetry.flops_per_call and step.telemetry.flops_per_call > 0
+    assert snap.get("flops_per_s", 0) > 0
+    # retrace on a new shape is a new compile event
+    step(jnp.ones(128))
+    assert step.telemetry.snapshot()["compiles"] == 2
+
+
+def test_instrument_step_adds_zero_hlo(monkeypatch):
+    """The wrapper must be invisible to XLA: the jaxpr traced through the
+    instrumented step is bit-identical to the bare one (lint-style, like
+    test_lint_moe_dispatch.py — host-side counters only, no device syncs
+    or extra ops on the hot path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import observability
+
+    def f(x):
+        return (x @ x.T).sum()
+
+    bare = jax.jit(f)
+    inst = observability.instrument_step(jax.jit(f), name="tel_lint")
+    x = jnp.ones((8, 8))
+    assert str(jax.make_jaxpr(bare)(x)) == str(jax.make_jaxpr(inst)(x))
+
+    # and the REAL wiring: the sharded train step with telemetry on
+    # traces to the same jaxpr as with telemetry off
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ray_tpu.train.step import build_sharded_train_step
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, attn_impl="blockwise", remat=False)
+    mesh = build_mesh(MeshSpec(dp=1), jax.devices()[:1])
+    _, step_on, _, _ = build_sharded_train_step(cfg, mesh, strategy="dp",
+                                                telemetry=True)
+    _, step_off, _, _ = build_sharded_train_step(cfg, mesh, strategy="dp",
+                                                 telemetry=False)
+    init_fn, _, shard_batch, _ = build_sharded_train_step(
+        cfg, mesh, strategy="dp", telemetry=False)
+    state = init_fn(jax.random.PRNGKey(0))
+    batch = shard_batch({"tokens": jnp.zeros((2, 33), jnp.int32)})
+    assert str(jax.make_jaxpr(step_off)(state, batch)) == str(
+        jax.make_jaxpr(step_on)(state, batch))
+
+
+def test_histogram_inf_bucket_and_sum_count_consistency():
+    """Prometheus invariants on util.metrics.Histogram: the +Inf bucket
+    equals _count, bucket counts are cumulative and monotone, _sum is
+    the exact sum of observations."""
+    from ray_tpu.util.metrics import Histogram
+
+    h = Histogram("tel_test_hist_s", "t", boundaries=[0.1, 1.0, 10.0],
+                  tag_keys=("k",))
+    values = [0.05, 0.05, 0.5, 5.0, 50.0, 0.09]
+    for v in values:
+        h.observe(v, tags={"k": "a"})
+    h.observe(2.0, tags={"k": "b"})  # second series must not bleed in
+    samples = h._samples()
+    a = [(n, t, v) for n, t, v in samples if t.get("k") == "a"]
+    buckets = {t["le"]: v for n, t, v in a if n.endswith("_bucket")}
+    count = next(v for n, t, v in a if n.endswith("_count"))
+    total = next(v for n, t, v in a if n.endswith("_sum"))
+    assert buckets["+Inf"] == count == len(values)
+    assert buckets["0.1"] == 3          # 0.05, 0.05, 0.09
+    assert buckets["1.0"] == 4          # + 0.5
+    assert buckets["10.0"] == 5         # + 5.0
+    ordered = [buckets["0.1"], buckets["1.0"], buckets["10.0"], buckets["+Inf"]]
+    assert ordered == sorted(ordered)
+    assert total == pytest.approx(sum(values))
+
+
+def test_latency_hist_percentiles():
+    from ray_tpu.serve.llm_engine import _LatencyHist
+
+    class _Null:
+        def observe(self, *a, **k):
+            pass
+
+    h = _LatencyHist([0.01, 0.1, 1.0], _Null(), {})
+    assert h.percentiles_ms() == [None, None, None]
+    for _ in range(90):
+        h.observe(0.005)   # first bucket
+    for _ in range(10):
+        h.observe(0.5)     # third bucket
+    p50, p95, p99 = h.percentiles_ms()
+    assert p50 is not None and p50 <= 10.0      # inside [0, 10ms]
+    assert 100.0 <= p95 <= 1000.0               # interpolated in [0.1, 1.0]s
+    assert p99 >= p95 >= p50
+    h.reset()
+    assert h.percentiles_ms() == [None, None, None]
+
+
+def test_latency_hist_percentiles_stay_recent_weighted():
+    """A long-lived replica's percentiles must track the rotating
+    window, not all-of-history: after a latency regression, p95 moves
+    within ~one epoch of samples instead of needing to outvote the
+    process's entire past."""
+    from ray_tpu.serve.llm_engine import _LatencyHist
+
+    class _Null:
+        def observe(self, *a, **k):
+            pass
+
+    h = _LatencyHist([0.01, 0.1, 1.0], _Null(), {}, epoch=100)
+    for _ in range(1000):
+        h.observe(0.005)     # long healthy history
+    for _ in range(200):
+        h.observe(0.5)       # regression: two full epochs of slow samples
+    p50, p95, p99 = h.percentiles_ms()
+    # window now holds only slow samples — p50 must reflect the incident
+    assert p50 >= 100.0, p50
+    # cumulative counting would put p50 at ~5ms (1000 fast vs 200 slow)
+
+
+def test_no_preexec_fn_in_spawn_paths():
+    """Lint: process spawns must stay posix-spawn-compatible (no
+    preexec_fn — Python at-fork handlers under a multithreaded JAX
+    driver risk deadlock and spew the os.fork() RuntimeWarning)."""
+    import ray_tpu._private.node as node_mod
+    import ray_tpu._private.raylet as raylet_mod
+
+    for mod in (node_mod, raylet_mod):
+        src = open(mod.__file__).read()
+        for line in src.splitlines():
+            code = line.split("#", 1)[0]
+            assert "preexec_fn=" not in code, f"{mod.__name__}: {line.strip()}"
+
+
+# --------------------------------------------------------- cluster layer
+def test_trace_context_propagates_into_device_steps(ray_start_regular):
+    """Nested actor→task execution: the device step events recorded by
+    an instrumented jitted fn inside the task must parent under THAT
+    task's run span, in the same trace as the driver's submission — the
+    Dapper property the unified trace depends on."""
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+    try:
+        @ray_tpu.remote
+        def inner_step():
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu import observability
+
+            f = observability.instrument_step(
+                jax.jit(lambda x: (x + 1.0).sum()), name="dev_prop")
+            for _ in range(3):
+                float(f(jnp.ones(16)))
+            return 1
+
+        @ray_tpu.remote
+        class Driver:
+            def go(self):
+                import ray_tpu as rt
+
+                return rt.get(inner_step.remote(), timeout=120)
+
+        a = Driver.remote()
+        assert ray_tpu.get(a.go.remote(), timeout=120) == 1
+        time.sleep(1.0)
+        spans = tracing.get_spans()
+        dev = [s for s in spans if s.get("kind") == "DEVICE"
+               and s.get("step_name") == "dev_prop"]
+        assert dev, f"no device spans collected: {[s['name'] for s in spans]}"
+        run_task = next(s for s in spans if s["name"] == "run:inner_step")
+        run_actor = next(s for s in spans if s["name"] == "run:go")
+        for s in dev:
+            assert s["trace_id"] == run_task["trace_id"]
+            assert s["parent_id"] == run_task["span_id"]
+        # and the task itself chains up through the actor call
+        assert run_task["trace_id"] == run_actor["trace_id"]
+        assert any(s["name"].startswith("compile:dev_prop") for s in dev)
+        assert any(s["name"].startswith("step:dev_prop") for s in dev)
+    finally:
+        tracing.disable()
+
+
+def test_export_trace_merges_all_three_sources(ray_start_regular, tmp_path):
+    """One Perfetto-loadable file with task rows + RPC spans + device
+    step/compile events, parent linkage intact (acceptance criterion)."""
+    from ray_tpu import observability
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+    try:
+        @ray_tpu.remote
+        def traced_work():
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu import observability as obs
+
+            f = obs.instrument_step(jax.jit(lambda x: x * 2), name="merged_step")
+            for _ in range(2):
+                f(jnp.ones(8)).block_until_ready()
+            return "ok"
+
+        assert ray_tpu.get(traced_work.remote(), timeout=120) == "ok"
+
+        # ...and serve through the continuous engine under a driver
+        # span: its dispatches must land as device steps in the SAME
+        # file (acceptance: a run that trains and serves → one trace)
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import llama
+        from ray_tpu.serve.llm_engine import ContinuousBatchingEngine
+        from ray_tpu.util.tracing import execution_span, submission_context
+
+        cfg = llama.LlamaConfig.tiny(dtype=jnp.float32,
+                                     attn_impl="blockwise", remat=False)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        engine = ContinuousBatchingEngine(params, cfg, n_slots=2, chunk=4,
+                                          macro_phases=4, name="trace_test")
+        try:
+            ctx = submission_context("serve_req")
+            with execution_span(ctx, "serve_req"):
+                reqs = [engine.submit([1, 2, 3], 5), engine.submit([4, 5], 4)]
+            for r in reqs:
+                assert r.done.wait(180)
+        finally:
+            engine.shutdown()
+
+        time.sleep(1.0)
+        path = str(tmp_path / "unified.json")
+        events = observability.export_trace(path)
+        assert os.path.exists(path)
+        data = json.load(open(path))
+        assert isinstance(data, list) and data == sorted(
+            data, key=lambda e: e.get("ts", 0.0))
+        cats = {e.get("cat") for e in data}
+        assert "task" in cats, cats          # timeline task rows
+        assert "span" in cats, cats          # RPC spans
+        assert "device_step" in cats, cats   # device step/compile events
+        # parent linkage: every device slice names its parent span, and
+        # that span exists in the same file
+        span_ids = {e["args"].get("span_id") for e in data
+                    if e.get("cat") == "span"}
+        dev = [e for e in data if e.get("cat") == "device_step"
+               and "merged_step" in e.get("name", "")]
+        assert dev
+        linked = [e for e in dev if e.get("args", {}).get("parent_span_id")]
+        assert linked, "device steps lost their parent linkage"
+        assert all(e["args"]["parent_span_id"] in span_ids for e in linked)
+        # the serve dispatches landed as device steps parented under the
+        # request's span — proxy span → dispatch is followable
+        serve_dev = [e for e in data if e.get("cat") == "device_step"
+                     and "llm_dispatch:trace_test" in e.get("name", "")]
+        assert serve_dev, "engine dispatches missing from the merged trace"
+        assert any(e.get("args", {}).get("parent_span_id") in span_ids
+                   for e in serve_dev)
+        # flow arrows for Perfetto's request->dispatch rendering
+        assert any(e.get("ph") == "s" for e in data)
+        assert any(e.get("ph") == "f" for e in data)
+    finally:
+        tracing.disable()
+
+
+def test_timeline_reports_still_running_tasks(ray_start_regular):
+    """A task that reported RUNNING but never finished (hung, or its
+    worker died without a FAILED transition reaching the GCS) must show
+    as an open-ended slice ending at export time — not vanish: a hung
+    task is exactly what the timeline is opened to find. Exercised
+    through the events API (the direct task path reports its events only
+    at completion by design — one push per batch, PR 1)."""
+    from ray_tpu._private.worker import get_global_core
+    from ray_tpu.util.timeline import timeline
+
+    t_started = time.time() - 3.0
+    get_global_core().gcs_request("events.report", {"events": [{
+        "task_id": "t-hung-0001", "name": "hung_task",
+        "state": "RUNNING", "time": t_started, "worker_id": "wdead",
+    }]})
+    ev = next((e for e in timeline()
+               if e.get("args", {}).get("task_id") == "t-hung-0001"), None)
+    assert ev is not None, "RUNNING-without-FINISH task dropped from timeline"
+    assert ev["ph"] == "X"
+    assert ev["args"]["outcome"] == "RUNNING"
+    # open-ended: the slice runs from its start to ~export time
+    assert ev["dur"] >= 2.5e6
+    assert ev["name"] == "hung_task"
+
+
+def test_api_training_serves_latest_snapshot(ray_start_regular):
+    """A short instrumented loop + a published training snapshot must be
+    readable back through the dashboard's /api/training endpoint."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import observability
+    from ray_tpu._private.worker import global_worker
+
+    url_file = os.path.join(global_worker.session_dir, "dashboard_url")
+    deadline = time.time() + 20
+    while time.time() < deadline and not os.path.exists(url_file):
+        time.sleep(0.5)
+    if not os.path.exists(url_file):
+        pytest.skip("dashboard not running")
+    base = open(url_file).read().strip()
+
+    f = observability.instrument_step(
+        jax.jit(lambda x: (x * 3.0).sum()), name="api_train_step",
+        kind="training")
+    for _ in range(4):
+        float(f(jnp.ones(64)))
+    observability.publish_snapshot("training", {"loss": 1.25, "step": 4})
+    assert observability.flush("training")
+
+    got = json.load(urllib.request.urlopen(base + "/api/training", timeout=20))
+    assert got, "no training snapshot on the dashboard"
+    snap = next(iter(got.values()))
+    assert snap["loss"] == 1.25
+    steps = snap.get("steps", {})
+    assert "api_train_step" in steps
+    assert steps["api_train_step"]["steps"] >= 4
+    assert steps["api_train_step"]["compiles"] >= 1
+    # /api/serve exists and answers (empty dict without an engine)
+    served = json.load(urllib.request.urlopen(base + "/api/serve", timeout=20))
+    assert isinstance(served, dict)
+
+
+def test_step_gauges_reach_metrics_endpoint(ray_start_regular):
+    """The per-step gauges flush through the standard metrics pipeline
+    and appear in the Prometheus text the dashboard serves."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import observability
+    from ray_tpu._private.worker import get_global_core
+    from ray_tpu.util import metrics as metrics_mod
+
+    f = observability.instrument_step(
+        jax.jit(lambda x: x.sum()), name="gauge_step")
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 0.6:  # outlive the 4 Hz gauge throttle
+        float(f(jnp.ones(32)))
+    metrics_mod._flush_once()
+    text = get_global_core().gcs_request("metrics.text", {})
+    assert "ray_tpu_step_time_s_bucket" in text
+    assert 'ray_tpu_step_goodput_pct{' in text
+    assert 'step="gauge_step"' in text
