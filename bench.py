@@ -896,6 +896,118 @@ def bench_telemetry_overhead(extra):
         log(f"[bench] telemetry overhead bench skipped: {e}")
 
 
+_ELASTIC_BENCH_SCRIPT = r"""
+import json, os, sys, tempfile, time
+import numpy as np
+import jax, jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.parallel.multislice import setup_multislice_training
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.fault_injection import (
+    FaultEvent, PreemptionInjector, PreemptionSchedule)
+from ray_tpu.train.goodput import GoodputMeter
+
+cfg = LlamaConfig.tiny(dtype=jnp.float32)
+tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 65), 0, 512)
+N = 30
+sched = PreemptionSchedule(
+    [FaultEvent(step=10, slice_idx=1, kind="kill", duration_steps=3,
+                notice_steps=2)], seed=0)
+inj = PreemptionInjector(sched)
+ms = setup_multislice_training(
+    cfg, dcn_dp=2, strategy="dp", elastic=True, probe_timeout_s=120.0,
+    injector=inj)
+states = ms.init_states(jax.random.PRNGKey(0))
+for _ in range(2):  # compiles (fresh + donated layouts)
+    states, _ = ms.step(states, ms.shard_batches({"tokens": tokens}))
+# bill goodput only for the steady-state run, not warmup compiles
+ms.goodput = GoodputMeter().start()
+run_dir = tempfile.mkdtemp(prefix="elastic_bench_")
+mgr = CheckpointManager(run_dir, fmt="numpy", goodput_meter=ms.goodput)
+for step in range(N):
+    if ms.maintenance_notice():
+        mgr.save(step, states[0], priority=True)   # preemption incoming
+    elif step and step % 6 == 0:
+        mgr.save(step, states[0])                  # periodic async save
+    states, m = ms.step(states, ms.shard_batches({"tokens": tokens}))
+mgr.wait()
+elastic = ms.goodput.summary()
+
+# async-checkpoint overhead vs no-checkpoint baseline at the SAME
+# cadence as the elastic run (every 6th step): the step path only ever
+# pays the D2H snapshot; the write rides the background writer thread
+save_every = 6
+def run(k, save):
+    global states
+    t0 = time.perf_counter()
+    for i in range(k):
+        if save and i % save_every == 0:
+            mgr.save(1000 + i, states[0])
+        states, m = ms.step(states, ms.shard_batches({"tokens": tokens}))
+    _ = float(m["loss"])
+    return time.perf_counter() - t0
+
+run(3, False)  # settle
+t_base = min(run(18, False) for _ in range(2))
+t_ckpt = min(run(18, True) for _ in range(2))
+mgr.wait(); mgr.close(); ms.close()
+print("ELASTIC_JSON " + json.dumps({
+    "goodput_pct": elastic["goodput_pct"],
+    "recovery_s": elastic["lost_s"],
+    "recovery_breakdown_s": elastic["recovery_breakdown_s"],
+    "recovery_events": elastic["recovery_events"],
+    "degraded_steps": elastic["degraded_steps"],
+    "ckpt_overhead_pct": round(100.0 * (t_ckpt - t_base) / t_base, 2),
+}))
+"""
+
+
+def bench_elastic(extra):
+    """Elastic multislice under an injected slice preemption: goodput %
+    + recovery-cost breakdown (detect/regang/restore/recompile/ckpt
+    stall) and the async-checkpoint step-time tax. Runs on the 8-device
+    virtual CPU mesh in a subprocess (jax platform flags must be set
+    before backend init; the driver process may already own a TPU) —
+    ROADMAP item 4's bench gate is goodput >= 95% here."""
+    import subprocess
+
+    try:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        proc = subprocess.run(
+            [sys.executable, "-c", _ELASTIC_BENCH_SCRIPT],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=600,
+        )
+        line = next(
+            (l for l in proc.stdout.splitlines() if l.startswith("ELASTIC_JSON ")),
+            None,
+        )
+        if line is None:
+            raise RuntimeError(
+                f"elastic bench subprocess produced no ELASTIC_JSON "
+                f"(exit {proc.returncode}); stderr tail: "
+                f"{proc.stderr[-800:].strip()}"
+            )
+        r = json.loads(line[len("ELASTIC_JSON "):])
+        extra["elastic_goodput_pct"] = r["goodput_pct"]
+        extra["elastic_recovery_s"] = r["recovery_s"]
+        extra["elastic_recovery_breakdown_s"] = r["recovery_breakdown_s"]
+        extra["elastic_recovery_events"] = r["recovery_events"]
+        extra["elastic_ckpt_overhead_pct"] = r["ckpt_overhead_pct"]
+        bd = " ".join(f"{k}={v:.3f}s" for k, v in r["recovery_breakdown_s"].items() if v)
+        log(f"[bench] elastic: goodput {r['goodput_pct']}% under injected "
+            f"preemption ({r['recovery_events']} recovery events, "
+            f"{r['degraded_steps']} degraded steps; {bd}); async-ckpt "
+            f"step-time overhead {r['ckpt_overhead_pct']:+.1f}%")
+    except Exception as e:
+        log(f"[bench] elastic bench skipped: {e}")
+
+
 def bench_pixel_rl(extra):
     """Pixel-RL throughput: conv-PPO on the native MinAtar-style
     Breakout (BASELINE.json north star #2 — "RLlib PPO Atari"; ale_py is
@@ -963,6 +1075,7 @@ def main():
     bench_broadcast(extra)
     bench_data_pipeline(extra)
     bench_telemetry_overhead(extra)
+    bench_elastic(extra)
     bench_pixel_rl(extra)
     mfu = bench_tpu_train(extra)
     if mfu is not None:

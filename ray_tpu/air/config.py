@@ -67,11 +67,19 @@ class FailureConfig:
 
 @dataclasses.dataclass
 class CheckpointConfig:
-    """reference: air/config.py CheckpointConfig."""
+    """reference: air/config.py CheckpointConfig.
+
+    `checkpoint_interval` / `async_save` drive the round-9 async
+    checkpoint manager (train/checkpoint_manager.py): save every
+    `checkpoint_interval` steps (0 = only when the loop reports one),
+    with the write pipelined behind the step unless async_save=False.
+    """
 
     num_to_keep: Optional[int] = None
     checkpoint_frequency: int = 0
     checkpoint_at_end: bool = True
+    checkpoint_interval: int = 0
+    async_save: bool = True
 
 
 @dataclasses.dataclass

@@ -19,7 +19,7 @@ from ray_tpu.air.checkpoint import Checkpoint
 class _Session:
     def __init__(self, rank: int, world_size: int, local_rank: int, result_queue, storage_dir: str,
                  restore_checkpoint: Optional[str] = None, elastic_coord=None,
-                 elastic_resume=None, elastic_gen: int = 0):
+                 elastic_resume=None, elastic_gen: int = 0, checkpoint_config=None):
         self.rank = rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -36,13 +36,23 @@ class _Session:
         self.elastic_state = None
         self.elastic_step = 0
         self.elastic_resume = elastic_resume
+        self.checkpoint_config = checkpoint_config
+        self._ckpt_manager = None
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
         ckpt_path = None
         if checkpoint is not None and self.rank == 0:
+            from ray_tpu.train._internal import storage
+
             dest = os.path.join(self.storage_dir, f"checkpoint_{self.iteration:06d}")
             if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
-                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+                # atomic ingest: copy into a tmp dir, marker, rename —
+                # a worker killed mid-copy can't leave a half checkpoint
+                # under a name latest_checkpoint() would resolve to
+                with storage.atomic_checkpoint_dir(dest) as tmp:
+                    shutil.copytree(checkpoint.path, tmp, dirs_exist_ok=True)
+            elif not storage.is_committed(dest):
+                storage.write_commit_marker(dest)
             ckpt_path = dest
         self.iteration += 1
         if self.result_queue is not None:
@@ -68,6 +78,26 @@ def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
     if s is None:
         raise RuntimeError("train.report() called outside a training worker")
     s.report(metrics, checkpoint)
+
+
+def get_checkpoint_manager():
+    """This worker's async CheckpointManager over the run directory,
+    built from RunConfig.checkpoint_config (num_to_keep, async_save)
+    — the never-block-the-step save path for elastic train loops."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("get_checkpoint_manager() called outside a training worker")
+    if s._ckpt_manager is None:
+        from ray_tpu.train.checkpoint_manager import CheckpointManager
+
+        cc = s.checkpoint_config
+        s._ckpt_manager = CheckpointManager(
+            s.storage_dir,
+            async_save=getattr(cc, "async_save", True),
+            num_to_keep=getattr(cc, "num_to_keep", None),
+            checkpoint_interval=getattr(cc, "checkpoint_interval", 0),
+        )
+    return s._ckpt_manager
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

@@ -6,8 +6,21 @@ from ray_tpu.air.config import (  # noqa: F401
     RunConfig,
     ScalingConfig,
 )
-from ray_tpu.air.session import get_checkpoint, get_context, report  # noqa: F401
+from ray_tpu.air.session import (  # noqa: F401
+    get_checkpoint,
+    get_checkpoint_manager,
+    get_context,
+    report,
+)
+from ray_tpu.train.checkpoint_manager import CheckpointManager  # noqa: F401
 from ray_tpu.train.elastic import elastic_barrier  # noqa: F401
+from ray_tpu.train.fault_injection import (  # noqa: F401
+    FaultEvent,
+    PreemptionInjector,
+    PreemptionSchedule,
+    SlicePreempted,
+)
+from ray_tpu.train.goodput import GoodputMeter  # noqa: F401
 from ray_tpu.train.jax_trainer import DataParallelTrainer, JaxTrainer, Result  # noqa: F401
 from ray_tpu.train.step import (  # noqa: F401
     build_sharded_train_step,

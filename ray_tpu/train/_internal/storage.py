@@ -5,12 +5,35 @@ Equivalent of the reference's StorageContext
 layer). Here local/NFS paths are handled directly and jax pytrees go
 through orbax (which itself speaks tensorstore for sharded arrays on
 real slices).
+
+Commit protocol (round 9): every checkpoint directory is written with
+tmp-dir → COMMIT-marker → atomic rename, so a writer killed at ANY
+point can never corrupt the checkpoint that `latest_checkpoint()`
+resolves to:
+
+  1. payload is written into `<final>.tmp-<pid>-<nonce>` — a name
+     `latest_checkpoint()` never considers
+  2. a `COMMIT` marker (json: step/time/format) is written INSIDE the
+     tmp dir, after the payload files are flushed
+  3. the tmp dir is renamed to its final `checkpoint_XXXXXX` name —
+     atomic on POSIX, so the final name appears with the marker already
+     inside
+
+`latest_checkpoint()` additionally requires the marker to be present
+and parseable, which also screens out dirs produced by non-atomic
+copies (cross-filesystem rsync, a partially copytree'd legacy dir).
 """
 from __future__ import annotations
 
+import contextlib
+import json
 import os
 import time
-from typing import Any, Dict, Optional
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+COMMIT_MARKER = "COMMIT"
+_TMP_INFIX = ".tmp-"
 
 
 def make_run_dir(storage_path: str, name: Optional[str]) -> str:
@@ -20,14 +43,128 @@ def make_run_dir(storage_path: str, name: Optional[str]) -> str:
     return path
 
 
+# ------------------------------------------------------------------ commit
+def commit_marker_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, COMMIT_MARKER)
+
+
+def write_commit_marker(ckpt_dir: str, meta: Optional[Dict[str, Any]] = None) -> None:
+    """Stamp `ckpt_dir` as committed. Written via its own tmp-file +
+    rename so a torn marker write can never half-exist."""
+    payload = dict(meta or {})
+    payload.setdefault("time", time.time())
+    tmp = os.path.join(ckpt_dir, f".{COMMIT_MARKER}.{uuid.uuid4().hex[:8]}")
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, commit_marker_path(ckpt_dir))
+
+
+def is_committed(ckpt_dir: str) -> bool:
+    """True iff `ckpt_dir` finished its atomic write: the final name
+    (no tmp infix) AND a parseable COMMIT marker."""
+    if _TMP_INFIX in os.path.basename(ckpt_dir):
+        return False
+    try:
+        with open(commit_marker_path(ckpt_dir)) as f:
+            json.load(f)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def read_commit_meta(ckpt_dir: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(commit_marker_path(ckpt_dir)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+@contextlib.contextmanager
+def atomic_checkpoint_dir(final_dir: str, meta: Optional[Dict[str, Any]] = None) -> Iterator[str]:
+    """Yield a tmp dir to write checkpoint payload into; on clean exit
+    the marker is written and the dir atomically renamed to `final_dir`.
+    A crash anywhere inside the block leaves only a `.tmp-` dir that
+    `latest_checkpoint()` ignores and `sweep_stale_tmp_dirs()` reaps."""
+    final_dir = os.path.abspath(final_dir)
+    parent = os.path.dirname(final_dir)
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{final_dir}{_TMP_INFIX}{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    os.makedirs(tmp)
+    try:
+        yield tmp
+        write_commit_marker(tmp, meta)
+        aside = None
+        if os.path.isdir(final_dir):
+            # re-save of the same step: move the old dir aside (tmp
+            # name, so a crash leaves it reapable) IMMEDIATELY before
+            # the rename-in, and reap it only after the new dir holds
+            # the final name — the only window in which this step has
+            # no committed dir is the two adjacent rename syscalls
+            # (older committed checkpoints are untouched throughout)
+            aside = f"{final_dir}{_TMP_INFIX}replaced-{uuid.uuid4().hex[:8]}"
+            os.rename(final_dir, aside)
+        os.rename(tmp, final_dir)
+        if aside is not None:
+            import shutil
+
+            shutil.rmtree(aside, ignore_errors=True)
+    except BaseException:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def sweep_stale_tmp_dirs(run_dir: str) -> int:
+    """Remove leftover `.tmp-` dirs from writers that died mid-save."""
+    import shutil
+
+    if not os.path.isdir(run_dir):
+        return 0
+    n = 0
+    for d in os.listdir(run_dir):
+        if d.startswith("checkpoint_") and _TMP_INFIX in d:
+            shutil.rmtree(os.path.join(run_dir, d), ignore_errors=True)
+            n += 1
+    return n
+
+
+# ------------------------------------------------------------------- orbax
 def save_jax_state(path: str, state: Any) -> str:
-    """Save a jax pytree (params/opt state) with orbax."""
+    """Save a jax pytree (params/opt state) with orbax — atomically.
+    orbax writes into a `.state.tmp-*` dir that is renamed to
+    `<path>/state` only once fully flushed, and the dir-level COMMIT
+    marker lands LAST — a process killed anywhere mid-save leaves
+    `path` uncommitted, never half-written under its final name. The
+    marker stays at the checkpoint-dir level (orbax owns the payload
+    dir's contents and must not see foreign files)."""
+    import shutil
+
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(path, "state"), state, force=True)
-    ckptr.wait_until_finished()
+    final = os.path.join(path, "state")
+    tmp = os.path.join(path, f".state{_TMP_INFIX}{os.getpid()}-{uuid.uuid4().hex[:8]}")
+    try:
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(tmp, state, force=True)
+        ckptr.wait_until_finished()
+        aside = None
+        if os.path.isdir(final):
+            # old payload moves aside for only the instant between the
+            # renames and is deleted after the new one holds the name
+            aside = f"{tmp}-replaced"
+            os.rename(final, aside)
+        os.rename(tmp, final)
+        if aside is not None:
+            shutil.rmtree(aside, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    write_commit_marker(path, {"format": "orbax-standard"})
     return path
 
 
@@ -39,18 +176,66 @@ def load_jax_state(path: str, target: Any) -> Any:
     return ckptr.restore(os.path.join(os.path.abspath(path), "state"), target)
 
 
-def latest_checkpoint(run_dir: str) -> Optional[str]:
+# ------------------------------------------------------------ dir listing
+def _checkpoint_dirs(run_dir: str):
+    """All non-tmp checkpoint_* dirs, name-sorted (name order == step
+    order for zero-padded names)."""
     if not os.path.isdir(run_dir):
-        return None
-    ckpts = sorted(d for d in os.listdir(run_dir) if d.startswith("checkpoint_"))
-    return os.path.join(run_dir, ckpts[-1]) if ckpts else None
+        return []
+    out = []
+    for d in sorted(os.listdir(run_dir)):
+        if not d.startswith("checkpoint_") or _TMP_INFIX in d:
+            continue
+        full = os.path.join(run_dir, d)
+        if os.path.isdir(full):
+            out.append(full)
+    return out
+
+
+def _committed_checkpoints(run_dir: str):
+    return [d for d in _checkpoint_dirs(run_dir) if is_committed(d)]
+
+
+def list_checkpoints(run_dir: str):
+    """Resolvable checkpoints, oldest → newest. COMMITTED dirs when any
+    exist; otherwise falls back to MARKER-LESS `checkpoint_*` dirs so a
+    run dir written by a pre-commit-protocol release stays resumable
+    after an upgrade. The fallback applies only when NO committed dir
+    exists (once one new-protocol save lands, legacy dirs are never
+    trusted over it), and a dir with a CORRUPT marker is excluded even
+    from the fallback — a damaged marker means a new-protocol dir that
+    was tampered with or half-copied, not a legacy write."""
+    committed = _committed_checkpoints(run_dir)
+    if committed:
+        return committed
+    return [
+        d for d in _checkpoint_dirs(run_dir)
+        if not os.path.exists(commit_marker_path(d))
+    ]
+
+
+def latest_checkpoint(run_dir: str) -> Optional[str]:
+    """Newest resolvable checkpoint dir — uncommitted (killed mid-save),
+    tmp, and corrupt-marker dirs are skipped, so a crash during a save
+    always resolves to the previous good checkpoint. Marker-less legacy
+    dirs are accepted only when no committed dir exists (see
+    `list_checkpoints`)."""
+    ckpts = list_checkpoints(run_dir)
+    return ckpts[-1] if ckpts else None
 
 
 def prune_checkpoints(run_dir: str, num_to_keep: Optional[int]):
+    """Keep the newest `num_to_keep` RESOLVABLE checkpoints — the same
+    set `latest_checkpoint()` chooses from (committed dirs, or the
+    legacy marker-less fallback when none are committed — so legacy
+    runs still age out). Corrupt-marker and `.tmp-` dirs never count
+    against the budget and are never deleted here (the tmp sweep reaps
+    `.tmp-` litter), and the newest resolvable checkpoint is never
+    deleted — pruning can't take a committed dir in favor of an
+    unreadable newer-named one."""
     if not num_to_keep:
         return
     import shutil
 
-    ckpts = sorted(d for d in os.listdir(run_dir) if d.startswith("checkpoint_"))
-    for d in ckpts[:-num_to_keep]:
-        shutil.rmtree(os.path.join(run_dir, d), ignore_errors=True)
+    for d in list_checkpoints(run_dir)[:-num_to_keep]:
+        shutil.rmtree(d, ignore_errors=True)

@@ -26,7 +26,8 @@ class TrainWorker:
             os.environ[k] = v
 
     def setup_session(self, result_queue, storage_dir: str, restore_checkpoint: Optional[str],
-                      elastic_coord=None, elastic_resume=None, elastic_gen: int = 0):
+                      elastic_coord=None, elastic_resume=None, elastic_gen: int = 0,
+                      checkpoint_config=None):
         from ray_tpu.air.session import _Session, _set_session
 
         self._session = _Session(
@@ -39,6 +40,7 @@ class TrainWorker:
             elastic_coord=elastic_coord,
             elastic_resume=elastic_resume,
             elastic_gen=elastic_gen,
+            checkpoint_config=checkpoint_config,
         )
         _set_session(self._session)
         return True

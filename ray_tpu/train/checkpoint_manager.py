@@ -1,0 +1,399 @@
+"""Async checkpoint manager — checkpointing that never blocks the step.
+
+CheckFreq (Mohan et al., FAST '21) splits a checkpoint into a cheap
+synchronous SNAPSHOT and an expensive asynchronous PERSIST, pipelining
+the write behind subsequent training steps. This manager is that split
+for sharded jax train state:
+
+  - `save(step, state)` captures the state to HOST memory (D2H, the
+    only part the train loop ever waits for — call it right after the
+    next step is dispatched so the copy overlaps device compute), then
+    hands the write to a background thread and returns.
+  - The writer thread persists with the atomic commit protocol from
+    `train/_internal/storage.py`: payload into a `.tmp-` dir, COMMIT
+    marker, `os.rename` to the final `checkpoint_XXXXXX` name. A
+    process SIGKILLed at ANY point leaves either a committed previous
+    checkpoint or an ignorable tmp dir — `latest_checkpoint()` can
+    never resolve to a torn write.
+  - At-most-one-save-in-flight backpressure: a `save()` arriving while
+    a write is still running is SKIPPED (counted in `stats()`), so a
+    slow filesystem degrades checkpoint frequency instead of stacking
+    host copies of the whole model. `priority=True` (the maintenance-
+    notice path: a preemption is coming and THIS state must land) waits
+    for the in-flight write and then saves.
+  - Retention pruning keeps the newest `num_to_keep` committed
+    checkpoints; uncommitted garbage never counts against the budget.
+
+Payload formats: "orbax" (zarr, sharded-friendly — default when orbax
+imports) or "numpy" (flat npz — zero extra deps, used by tests and as
+the automatic fallback).
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import shutil
+import signal
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.train._internal import storage
+
+_PAYLOAD_SUBDIR = "state"
+_LEAF_KEY = "leaf_{:05d}"
+
+# test hook: crash the WRITER at a named protocol point
+# ("after_payload" = between tmp-write and commit marker,
+#  "after_marker" = between marker and rename)
+_CRASH_ENV = "RAY_TPU_CKPT_TEST_CRASH"
+
+
+def _maybe_crash(point: str) -> None:
+    if os.environ.get(_CRASH_ENV) == point:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _host_snapshot(state: Any) -> Any:
+    """D2H copy of every leaf (blocks until the arrays are computed —
+    the snapshot cost save() reports as its stall)."""
+    import jax
+    import numpy as np
+
+    return jax.tree.map(lambda x: np.asarray(x), state)
+
+
+def _write_numpy(payload_dir: str, host_state: Any) -> None:
+    import jax
+    import numpy as np
+
+    leaves, _ = jax.tree.flatten(host_state)
+    os.makedirs(payload_dir, exist_ok=True)
+    arrays = {_LEAF_KEY.format(i): np.asarray(l) for i, l in enumerate(leaves)}
+    # savez to a tmp name then rename: np.savez is not atomic either
+    tmp = os.path.join(payload_dir, f".leaves.{uuid.uuid4().hex[:8]}.npz")
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(payload_dir, "leaves.npz"))
+
+
+def _read_numpy(payload_dir: str, target: Any = None) -> Any:
+    import jax
+    import numpy as np
+
+    with np.load(os.path.join(payload_dir, "leaves.npz")) as z:
+        arrays = [z[_LEAF_KEY.format(i)] for i in range(len(z.files))]
+    if target is None:
+        return arrays
+    t_leaves, treedef = jax.tree.flatten(target)
+    if len(t_leaves) != len(arrays):
+        raise ValueError(
+            f"checkpoint has {len(arrays)} leaves, target has {len(t_leaves)}"
+        )
+    return jax.tree.unflatten(treedef, arrays)
+
+
+def _write_orbax(payload_dir: str, host_state: Any) -> None:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    # orbax owns payload_dir's final contents: write to its own tmp
+    # sibling and rename so foreign files never mix into the zarr tree
+    tmp = f"{payload_dir}.ocp-{uuid.uuid4().hex[:8]}"
+    ckptr.save(tmp, host_state, force=True)
+    # PyTreeCheckpointer is synchronous on older orbax (no drain method)
+    getattr(ckptr, "wait_until_finished", lambda: None)()
+    os.rename(tmp, payload_dir)
+
+
+def _read_orbax(payload_dir: str, target: Any = None) -> Any:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    if target is None:
+        return ckptr.restore(payload_dir)
+    import jax
+    import numpy as np
+
+    host_target = jax.tree.map(lambda x: np.asarray(x), target)
+    return ckptr.restore(payload_dir, item=host_target)
+
+
+_WRITERS = {"numpy": (_write_numpy, _read_numpy), "orbax": (_write_orbax, _read_orbax)}
+
+
+def _resolve_format(fmt: str) -> str:
+    if fmt != "auto":
+        return fmt
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return "orbax"
+    except Exception:
+        return "numpy"
+
+
+class CheckpointManager:
+    """Async, atomic, pruned checkpointing for one run directory.
+
+    Typical elastic train loop::
+
+        mgr = CheckpointManager(run_dir, num_to_keep=3, checkpoint_interval=50)
+        restored = mgr.restore(target=state)
+        if restored is not None:
+            state, start_step = restored[0], restored[1] + 1
+        for step in range(start_step, total):
+            state, metrics = step_fn(state, batch)   # dispatched async
+            mgr.maybe_save(step, state)              # snapshot + return
+        mgr.wait()                                   # drain before exit
+    """
+
+    def __init__(
+        self,
+        run_dir: str,
+        *,
+        async_save: bool = True,
+        num_to_keep: Optional[int] = None,
+        checkpoint_interval: int = 0,
+        fmt: str = "auto",
+        goodput_meter=None,
+    ):
+        self.run_dir = os.path.abspath(run_dir)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.async_save = async_save
+        self.num_to_keep = num_to_keep
+        self.checkpoint_interval = int(checkpoint_interval)
+        self.fmt = _resolve_format(fmt)
+        self._meter = goodput_meter
+        storage.sweep_stale_tmp_dirs(self.run_dir)
+
+        self._lock = threading.Lock()
+        self._inflight: Optional[threading.Event] = None
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._stats: Dict[str, Any] = {
+            "saves": 0,
+            "skipped_inflight": 0,
+            "failures": 0,
+            "last_stall_ms": 0.0,
+            "total_stall_ms": 0.0,
+            "last_write_ms": 0.0,
+            "last_saved_step": None,
+        }
+
+    # ------------------------------------------------------------ worker
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._writer_loop, daemon=True, name="ckpt-writer"
+            )
+            self._thread.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            step, host_state, done = job
+            t0 = time.perf_counter()
+            try:
+                self._write_checkpoint(step, host_state)
+                with self._lock:
+                    self._stats["saves"] += 1
+                    self._stats["last_saved_step"] = step
+                    self._stats["last_write_ms"] = (time.perf_counter() - t0) * 1e3
+            except Exception:
+                with self._lock:
+                    self._stats["failures"] += 1
+            finally:
+                done.set()
+                with self._lock:
+                    if self._inflight is done:
+                        self._inflight = None
+
+    def _write_checkpoint(self, step: int, host_state: Any) -> None:
+        """The full commit protocol, crash-hookable at every seam."""
+        final = os.path.join(self.run_dir, f"checkpoint_{step:06d}")
+        tmp = f"{final}{storage._TMP_INFIX}{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        os.makedirs(tmp)
+        try:
+            write_fn, _ = _WRITERS[self.fmt]
+            write_fn(os.path.join(tmp, _PAYLOAD_SUBDIR), host_state)
+            _maybe_crash("after_payload")
+            storage.write_commit_marker(tmp, {"step": step, "format": self.fmt})
+            _maybe_crash("after_marker")
+            aside = None
+            if os.path.isdir(final):
+                # re-save of the same step: the old dir moves aside (tmp
+                # name → reapable) only for the instant between the two
+                # renames, and is deleted only after the new dir holds
+                # the final name — older checkpoints stay committed
+                # throughout, so a SIGKILL here costs at most this one
+                # step's dir, never the run's restorability
+                aside = f"{final}{storage._TMP_INFIX}replaced-{uuid.uuid4().hex[:8]}"
+                os.rename(final, aside)
+            os.rename(tmp, final)
+            if aside is not None:
+                shutil.rmtree(aside, ignore_errors=True)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        storage.prune_checkpoints(self.run_dir, self.num_to_keep)
+
+    # -------------------------------------------------------------- save
+    def save(self, step: int, state: Any, *, priority: bool = False,
+             block: Optional[bool] = None) -> bool:
+        """Snapshot `state` to host and persist it as checkpoint `step`.
+
+        Returns False when skipped by the at-most-one-in-flight
+        backpressure (never for priority saves). `block` overrides the
+        manager's async_save default; even a blocking save runs the
+        writer on the background thread — the caller just waits — so
+        the hot path has exactly one code shape to lint.
+        """
+        block = (not self.async_save) if block is None else block
+        with self._lock:
+            inflight = self._inflight
+        if inflight is not None and not inflight.is_set():
+            if not priority:
+                with self._lock:
+                    self._stats["skipped_inflight"] += 1
+                return False
+            inflight.wait()
+
+        t0 = time.perf_counter()
+        host_state = _host_snapshot(state)
+        stall_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self._stats["last_stall_ms"] = stall_ms
+            self._stats["total_stall_ms"] += stall_ms
+        if self._meter is not None:
+            try:
+                self._meter.add_lost("checkpoint_stall", stall_ms / 1e3)
+            except Exception:
+                pass
+
+        done = threading.Event()
+        with self._lock:
+            self._inflight = done
+        self._ensure_thread()
+        self._queue.put((int(step), host_state, done))
+        if block:
+            done.wait()
+        return True
+
+    def maybe_save(self, step: int, state: Any, *, priority: bool = False) -> bool:
+        """save() gated on the configured `checkpoint_interval`
+        (CheckpointConfig.checkpoint_interval; 0 = never automatic) —
+        the train-loop one-liner. A priority save (maintenance notice)
+        always goes through regardless of the interval."""
+        if priority or (
+            self.checkpoint_interval and step % self.checkpoint_interval == 0
+        ):
+            return self.save(step, state, priority=priority)
+        return False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the in-flight write (if any) completes."""
+        with self._lock:
+            inflight = self._inflight
+        if inflight is None:
+            return True
+        return inflight.wait(timeout)
+
+    def close(self) -> None:
+        self.wait()
+        if self._thread is not None and self._thread.is_alive():
+            self._queue.put(None)
+            self._thread.join(timeout=5)
+
+    # ----------------------------------------------------------- restore
+    def latest_checkpoint(self) -> Optional[str]:
+        """Newest committed checkpoint dir (skips uncommitted/corrupt)."""
+        return storage.latest_checkpoint(self.run_dir)
+
+    def latest_step(self) -> Optional[int]:
+        path = self.latest_checkpoint()
+        if path is None:
+            return None
+        meta = storage.read_commit_meta(path) or {}
+        if "step" in meta:
+            return int(meta["step"])
+        try:
+            return int(os.path.basename(path).split("_")[-1])
+        except ValueError:
+            return None
+
+    def restore(self, target: Any = None) -> Optional[Tuple[Any, int]]:
+        """(state, step) from the newest manager-readable checkpoint,
+        or None.
+
+        The checkpoint is resolved ONCE and its step read from that
+        same dir's marker (re-resolving could race a background commit
+        landing in between — state from one checkpoint with a newer
+        one's step number). Checkpoints in the run dir that this
+        manager didn't write (e.g. `session.report` ingests — no
+        payload subdir, foreign format) are skipped in favor of the
+        newest one it can actually read.
+
+        With `target` given, the loaded host arrays are placed back
+        onto `target`'s shardings (H2D) so the state resumes exactly
+        where the sharded train step expects it.
+        """
+        path = host_state = meta = None
+        for cand in reversed(storage.list_checkpoints(self.run_dir)):
+            meta = storage.read_commit_meta(cand) or {}
+            fmt = meta.get("format", self.fmt)
+            payload = os.path.join(cand, _PAYLOAD_SUBDIR)
+            if fmt not in _WRITERS or not os.path.isdir(payload):
+                continue
+            _, read_fn = _WRITERS[fmt]
+            host_state = read_fn(payload, target)
+            path = cand
+            break
+        if path is None:
+            return None
+        if "step" in meta:
+            step = int(meta["step"])
+        else:
+            try:
+                step = int(os.path.basename(path).split("_")[-1])
+            except ValueError:
+                step = 0
+        if target is None:
+            return host_state, step
+        import jax
+
+        def _place(loaded, like):
+            sharding = getattr(like, "sharding", None)
+            if sharding is not None:
+                return jax.device_put(loaded, sharding)
+            return loaded
+
+        return jax.tree.map(_place, host_state, target), step
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = dict(self._stats)
+            out["save_in_flight"] = (
+                self._inflight is not None and not self._inflight.is_set()
+            )
+        out["format"] = self.fmt
+        out["async_save"] = self.async_save
+        return out
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def latest_checkpoint(run_dir: str) -> Optional[str]:
+    """Module-level convenience mirroring storage.latest_checkpoint."""
+    return storage.latest_checkpoint(run_dir)

@@ -47,7 +47,7 @@ Loop contract (see tests/test_elastic.py)::
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Set
+from typing import Any, Dict, Optional
 
 import ray_tpu
 
@@ -145,8 +145,51 @@ def elastic_barrier(step: int, state: Any = None) -> Dict[str, Any]:
     coord = getattr(s, "elastic_coord", None)
     if coord is None:
         return {"resync": False, "state": None, "step": step}
-    resp = ray_tpu.get(coord.barrier.remote(s.rank, s.elastic_gen, step))
+    resp = _bounded_barrier(coord, s.rank, s.elastic_gen, step)
     if resp.get("resync"):
         s.elastic_gen = resp["gen"]
         return {"resync": True, "state": None, "step": resp["step"]}
     return {"resync": False, "state": None, "step": step}
+
+
+def _bounded_barrier(coord, rank: int, gen: int, step: int) -> Dict[str, Any]:
+    """barrier() with a timeout + bounded retry, never an unbounded get.
+
+    A parked barrier is NORMAL (peers may be slow, a regang may be in
+    flight), so a per-attempt `ray_tpu.get` timeout is retried — the
+    coordinator's waiter set is keyed by rank, making the re-issued
+    call idempotent. What is NOT normal: a dead coordinator actor
+    (raises immediately) or one that never answers across every retry
+    (dead GCS / restarted coordinator the session still points at).
+    Both surface as an actionable RuntimeError instead of hanging every
+    rank forever. Knobs: RAY_TPU_ELASTIC_BARRIER_TIMEOUT_S (per
+    attempt, default 60) and RAY_TPU_ELASTIC_BARRIER_RETRIES
+    (default 10)."""
+    import os
+
+    from ray_tpu import exceptions
+
+    timeout_s = float(os.environ.get("RAY_TPU_ELASTIC_BARRIER_TIMEOUT_S", "60"))
+    retries = int(os.environ.get("RAY_TPU_ELASTIC_BARRIER_RETRIES", "10"))
+    last_err: Optional[BaseException] = None
+    for _ in range(max(1, retries)):
+        try:
+            return ray_tpu.get(
+                coord.barrier.remote(rank, gen, step), timeout=timeout_s
+            )
+        except exceptions.GetTimeoutError as e:
+            last_err = e
+            continue
+        except (exceptions.ActorError, exceptions.WorkerCrashedError) as e:
+            raise RuntimeError(
+                f"ElasticCoordinator died (rank {rank}, step {step}): the "
+                "trainer must start a new coordinator and re-setup sessions "
+                "before training can continue"
+            ) from e
+    raise RuntimeError(
+        f"ElasticCoordinator barrier unanswered after {retries} x "
+        f"{timeout_s:.0f}s (rank {rank}, step {step}) — the coordinator is "
+        "hung or was restarted without a regang; raise "
+        "RAY_TPU_ELASTIC_BARRIER_TIMEOUT_S if the gang legitimately parks "
+        "longer than this"
+    ) from last_err

@@ -164,7 +164,8 @@ class JaxTrainer:
         try:
             ray_tpu.get(
                 [
-                    w.setup_session.remote(results_q, run_dir, restore, coord)
+                    w.setup_session.remote(results_q, run_dir, restore, coord,
+                                           None, 0, cc)
                     for w in group.workers
                 ]
             )
@@ -285,7 +286,7 @@ class JaxTrainer:
             ray_tpu.get(
                 w.setup_session.remote(
                     results_q, run_dir, restore, coord,
-                    (state, step), gen,
+                    (state, step), gen, self.run_config.checkpoint_config,
                 )
             )
             pending[w.run.remote(self._train_loop, config)] = r

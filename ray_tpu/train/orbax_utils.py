@@ -12,23 +12,55 @@ orbax's zarr sharded format instead of pickle:
     train.report(metrics, checkpoint=Checkpoint(ckpt_dir))
     # on restore:
     params = load_pytree_from_checkpoint(result.checkpoint.path)
+
+Even this SYNC path writes atomically (tmp dir → rename → dir-level
+COMMIT marker, train/_internal/storage.py): a process killed mid-save
+can never corrupt the checkpoint that `storage.latest_checkpoint()`
+resolves to. The async, never-block-the-step path is
+`train/checkpoint_manager.py`.
 """
 from __future__ import annotations
 
 import os
+import uuid
 from typing import Any
+
+from ray_tpu.train._internal.storage import _TMP_INFIX, write_commit_marker
 
 _SUBDIR = "orbax_pytree"
 
 
 def save_pytree_to_checkpoint(checkpoint_dir: str, pytree: Any) -> str:
     """Write `pytree` under the checkpoint dir with orbax; returns the
-    orbax path."""
+    orbax path. Atomic: orbax targets a tmp name, the final `_SUBDIR`
+    name appears only via rename once the write fully flushed, and the
+    checkpoint dir's COMMIT marker lands after that."""
+    import shutil
+
     import orbax.checkpoint as ocp
 
-    path = os.path.join(os.path.abspath(checkpoint_dir), _SUBDIR)
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, pytree, force=True)
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
+    path = os.path.join(checkpoint_dir, _SUBDIR)
+    tmp = os.path.join(
+        checkpoint_dir, f".{_SUBDIR}{_TMP_INFIX}{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    )
+    try:
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(tmp, pytree, force=True)
+        getattr(ckptr, "wait_until_finished", lambda: None)()
+        aside = None
+        if os.path.isdir(path):
+            # old payload moves aside for only the instant between the
+            # renames and is deleted after the new one holds the name
+            aside = f"{tmp}-replaced"
+            os.rename(path, aside)
+        os.rename(tmp, path)
+        if aside is not None:
+            shutil.rmtree(aside, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    write_commit_marker(checkpoint_dir, {"format": "orbax-pytree"})
     return path
 
 

@@ -28,6 +28,18 @@ except Exception:
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavy tests excluded from the tier-1 run (-m 'not slow')"
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection chaos tier — long injector schedules; run "
+        "explicitly with -m chaos (chaos tests are also marked slow so they "
+        "stay out of tier-1 timing)",
+    )
+
+
 @pytest.fixture(scope="module")
 def ray_start_regular():
     import ray_tpu

@@ -1,0 +1,245 @@
+"""Async checkpoint manager: atomic commit protocol, kill-mid-save
+recovery, at-most-one-in-flight backpressure, retention pruning
+(train/checkpoint_manager.py; CheckFreq-style snapshot/persist split).
+The kill tests SIGKILL a real writer subprocess between protocol
+phases and assert `latest_checkpoint()` still resolves to the previous
+good checkpoint."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.train._internal import storage
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+
+
+def _state(v: float):
+    return {"w": np.full((4, 4), v, np.float32), "step": np.int64(int(v))}
+
+
+def test_save_restore_roundtrip_and_commit(tmp_path):
+    run = str(tmp_path / "run")
+    mgr = CheckpointManager(run, fmt="numpy", async_save=False)
+    assert mgr.latest_checkpoint() is None
+    mgr.save(3, _state(3.0))
+    path = mgr.latest_checkpoint()
+    assert path is not None and path.endswith("checkpoint_000003")
+    assert storage.is_committed(path)
+    assert (storage.read_commit_meta(path) or {}).get("step") == 3
+    restored, step = mgr.restore(target=_state(0.0))
+    assert step == 3
+    np.testing.assert_array_equal(restored["w"], _state(3.0)["w"])
+    # sharded jax target: loaded leaves land back on the target sharding
+    import jax
+
+    jtarget = {"w": jax.device_put(np.zeros((4, 4), np.float32)), "step": np.int64(0)}
+    jrestored, _ = mgr.restore(target=jtarget)
+    np.testing.assert_array_equal(np.asarray(jrestored["w"]), _state(3.0)["w"])
+    mgr.close()
+
+
+_KILL_SCRIPT = """
+import os, sys
+import numpy as np
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+mgr = CheckpointManager(sys.argv[1], fmt="numpy", async_save=False)
+mgr.save(8, {"w": np.full((4, 4), 8.0, np.float32), "step": np.int64(8)})
+print("UNREACHABLE")  # the writer SIGKILLs this process mid-protocol
+"""
+
+
+@pytest.mark.parametrize("crash_point", ["after_payload", "after_marker"])
+def test_kill_mid_save_keeps_previous_good(tmp_path, crash_point):
+    """SIGKILL the writer between tmp-write and commit (and between
+    marker and rename): latest_checkpoint() must return the previous
+    good checkpoint and resume state must match it exactly."""
+    run = str(tmp_path / "run")
+    mgr = CheckpointManager(run, fmt="numpy", async_save=False)
+    mgr.save(5, _state(5.0))
+    mgr.close()
+
+    env = dict(os.environ)
+    env["RAY_TPU_CKPT_TEST_CRASH"] = crash_point
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_SCRIPT, run],
+        env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, timeout=120,
+    )
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode, proc.stderr[-500:])
+    assert b"UNREACHABLE" not in proc.stdout
+
+    # the torn step-8 save never became visible
+    mgr2 = CheckpointManager(run, fmt="numpy")  # init also sweeps tmp litter
+    assert mgr2.latest_step() == 5
+    restored, step = mgr2.restore(target=_state(0.0))
+    assert step == 5
+    np.testing.assert_array_equal(restored["w"], _state(5.0)["w"])
+    # no checkpoint_ dir without a commit marker survives under a final name
+    for d in os.listdir(run):
+        full = os.path.join(run, d)
+        if d.startswith("checkpoint_") and os.path.isdir(full):
+            assert storage.is_committed(full), d
+    mgr2.close()
+
+
+def test_corrupt_marker_skipped(tmp_path):
+    run = str(tmp_path / "run")
+    mgr = CheckpointManager(run, fmt="numpy", async_save=False)
+    mgr.save(1, _state(1.0))
+    mgr.save(2, _state(2.0))
+    newest = mgr.latest_checkpoint()
+    with open(storage.commit_marker_path(newest), "w") as f:
+        f.write("{truncated")  # torn marker = uncommitted
+    assert mgr.latest_step() == 1
+    mgr.close()
+
+
+def test_legacy_markerless_checkpoint_resumable(tmp_path):
+    """A run dir written by a pre-commit-protocol release (final-name
+    dirs, no COMMIT marker) must stay resumable after an upgrade — but
+    only until the first new-protocol save lands, after which committed
+    dirs always win; and a CORRUPT marker is never trusted, even in the
+    fallback."""
+    run = str(tmp_path / "run")
+    legacy = os.path.join(run, "checkpoint_000007")
+    os.makedirs(legacy)
+    with open(os.path.join(legacy, "payload.bin"), "wb") as f:
+        f.write(b"x")
+    assert storage.latest_checkpoint(run) == legacy  # upgrade resume
+
+    corrupt = os.path.join(run, "checkpoint_000009")
+    os.makedirs(corrupt)
+    with open(storage.commit_marker_path(corrupt), "w") as f:
+        f.write("{torn")
+    assert storage.latest_checkpoint(run) == legacy  # corrupt never wins
+
+    mgr = CheckpointManager(run, fmt="numpy", async_save=False)
+    mgr.save(8, _state(8.0))
+    assert mgr.latest_step() == 8  # committed beats newer-named legacy
+    # restore() skips dirs the manager can't read and lands on its own
+    restored, step = mgr.restore(target=_state(0.0))
+    assert step == 8
+    np.testing.assert_array_equal(restored["w"], _state(8.0)["w"])
+    # pruning operates on the resolvable set: it can never delete the
+    # committed checkpoint in favor of the unreadable newer-named dirs
+    storage.prune_checkpoints(run, 1)
+    assert mgr.latest_step() == 8
+    mgr.close()
+
+
+def test_at_most_one_save_in_flight(tmp_path, monkeypatch):
+    """A save arriving while a write is in flight is skipped (counted);
+    a priority save waits for the in-flight write and then lands."""
+    run = str(tmp_path / "run")
+    mgr = CheckpointManager(run, fmt="numpy", async_save=True)
+    orig = CheckpointManager._write_checkpoint
+
+    def slow_write(self, step, host_state):
+        time.sleep(0.4)
+        return orig(self, step, host_state)
+
+    monkeypatch.setattr(CheckpointManager, "_write_checkpoint", slow_write)
+    assert mgr.save(1, _state(1.0)) is True
+    assert mgr.save(2, _state(2.0)) is False  # backpressure skip
+    assert mgr.stats()["skipped_inflight"] == 1
+    assert mgr.save(3, _state(3.0), priority=True) is True  # waits, then lands
+    mgr.wait()
+    assert mgr.latest_step() == 3
+    st = mgr.stats()
+    assert st["saves"] == 2 and st["failures"] == 0
+    mgr.close()
+
+
+def test_maybe_save_respects_interval(tmp_path):
+    """maybe_save is the CheckpointConfig.checkpoint_interval consumer:
+    saves land only on interval steps, except priority saves."""
+    run = str(tmp_path / "run")
+    mgr = CheckpointManager(run, fmt="numpy", async_save=False, checkpoint_interval=3)
+    assert mgr.maybe_save(1, _state(1.0)) is False
+    assert mgr.maybe_save(3, _state(3.0)) is True
+    assert mgr.maybe_save(4, _state(4.0)) is False
+    assert mgr.maybe_save(5, _state(5.0), priority=True) is True
+    assert mgr.latest_step() == 5
+    # interval 0 = never automatic
+    mgr0 = CheckpointManager(str(tmp_path / "r0"), fmt="numpy", async_save=False)
+    assert mgr0.maybe_save(10, _state(1.0)) is False
+    assert mgr0.latest_checkpoint() is None
+    mgr.close()
+    mgr0.close()
+
+
+def test_retention_pruning_keeps_newest_committed(tmp_path):
+    run = str(tmp_path / "run")
+    mgr = CheckpointManager(run, fmt="numpy", async_save=False, num_to_keep=2)
+    for s in range(4):
+        mgr.save(s, _state(float(s)))
+    mgr.wait()
+    kept = sorted(d for d in os.listdir(run) if d.startswith("checkpoint_"))
+    assert kept == ["checkpoint_000002", "checkpoint_000003"]
+    mgr.close()
+
+
+def test_async_save_does_not_block_step(tmp_path, monkeypatch):
+    """save() returns before the (artificially slow) write completes —
+    the step only ever pays the D2H snapshot."""
+    run = str(tmp_path / "run")
+    mgr = CheckpointManager(run, fmt="numpy", async_save=True)
+    orig = CheckpointManager._write_checkpoint
+
+    def slow_write(self, step, host_state):
+        time.sleep(0.5)
+        return orig(self, step, host_state)
+
+    monkeypatch.setattr(CheckpointManager, "_write_checkpoint", slow_write)
+    t0 = time.perf_counter()
+    mgr.save(1, _state(1.0))
+    assert time.perf_counter() - t0 < 0.25, "async save blocked on the write"
+    assert mgr.latest_checkpoint() is None  # not yet committed
+    mgr.wait()
+    assert mgr.latest_step() == 1
+    mgr.close()
+
+
+def test_orbax_format_roundtrip(tmp_path):
+    ocp = pytest.importorskip("orbax.checkpoint")  # noqa: F841
+    run = str(tmp_path / "run")
+    mgr = CheckpointManager(run, fmt="orbax", async_save=False)
+    mgr.save(2, _state(2.0))
+    assert (storage.read_commit_meta(mgr.latest_checkpoint()) or {}).get("format") == "orbax"
+    restored, step = mgr.restore(target=_state(0.0))
+    assert step == 2
+    np.testing.assert_array_equal(restored["w"], _state(2.0)["w"])
+    mgr.close()
+
+
+def test_sync_orbax_utils_save_is_atomic(tmp_path):
+    """Satellite: even the sync orbax_utils path commits atomically —
+    the checkpoint dir carries a marker and a fake torn twin (payload
+    without marker) is invisible to storage.latest_checkpoint()."""
+    pytest.importorskip("orbax.checkpoint")
+    import jax.numpy as jnp
+
+    from ray_tpu.train.orbax_utils import (
+        load_pytree_from_checkpoint,
+        save_pytree_to_checkpoint,
+    )
+
+    run = str(tmp_path / "run")
+    good = os.path.join(run, "checkpoint_000001")
+    os.makedirs(good)
+    save_pytree_to_checkpoint(good, {"w": jnp.arange(4.0)})
+    assert storage.is_committed(good)
+    np.testing.assert_array_equal(
+        np.asarray(load_pytree_from_checkpoint(good)["w"]), np.arange(4.0)
+    )
+    # a torn dir (payload present, no marker — the pre-round-9 failure
+    # mode) must not win latest_checkpoint
+    torn = os.path.join(run, "checkpoint_000002")
+    os.makedirs(os.path.join(torn, "orbax_pytree"))
+    assert storage.latest_checkpoint(run) == good

@@ -1,0 +1,324 @@
+"""Slice-granular elastic recovery on the 8-device virtual CPU mesh:
+deterministic fault injection (train/fault_injection.py), degrade to
+survivors with a generation-stamped DCN denominator, re-admit via
+survivor state broadcast, goodput accounting (train/goodput.py), and
+the maintenance-notice → priority-checkpoint handshake
+(parallel/multislice.py elastic mode; ROADMAP item 4)."""
+import numpy as np
+import pytest
+
+from ray_tpu.train.fault_injection import (
+    FaultEvent,
+    PreemptionInjector,
+    PreemptionSchedule,
+)
+from ray_tpu.train.goodput import RECOVERY_PHASES, GoodputMeter
+
+
+def _tokens(b=8, t=33):
+    import jax
+
+    return jax.random.randint(jax.random.PRNGKey(1), (b, t), 0, 512)
+
+
+def _elastic_ms(injector, probe_timeout_s=60.0, dcn_dp=2):
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.parallel.multislice import setup_multislice_training
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    return setup_multislice_training(
+        cfg,
+        dcn_dp=dcn_dp,
+        strategy="dp",
+        elastic=True,
+        probe_timeout_s=probe_timeout_s,
+        injector=injector,
+    )
+
+
+# ------------------------------------------------------------- schedule
+def test_schedule_replay_deterministic():
+    """Same (seed, args) → byte-identical schedule; json roundtrip is
+    lossless — the property that makes a chaos run replayable."""
+    kw = dict(n_slices=4, total_steps=64, n_events=3)
+    s1 = PreemptionSchedule.generate(7, **kw)
+    s2 = PreemptionSchedule.generate(7, **kw)
+    assert s1 == s2 and len(s1.events) >= 1
+    assert PreemptionSchedule.from_json(s1.to_json()) == s1
+    assert PreemptionSchedule.generate(8, **kw) != s1
+    for e in s1.events:
+        # slice 0 is never targeted: one survivor must hold the state
+        assert 1 <= e.slice_idx < 4
+        assert e.kind in ("kill", "hang", "slow")
+    # events are spaced: each outage resolves before the next fires
+    for a, b in zip(s1.events, s1.events[1:]):
+        assert b.step >= a.end_step
+
+
+def test_injector_notice_and_revive_windows():
+    ev = FaultEvent(step=5, slice_idx=1, kind="kill", duration_steps=3, notice_steps=2)
+    inj = PreemptionInjector(PreemptionSchedule([ev]))
+    assert inj.maintenance_notice(2) == []
+    assert inj.maintenance_notice(3) == [ev] and inj.maintenance_notice(4) == [ev]
+    assert inj.maintenance_notice(5) == []  # fired, not a notice anymore
+    assert inj.active_event(1, 5) is ev and inj.active_event(1, 7) is ev
+    assert inj.active_event(1, 8) is None
+    assert 1 not in inj.revivable(7) and 1 in inj.revivable(8)
+
+
+# ------------------------------------------- degrade → re-admit parity
+def test_slice_preemption_degrade_readmit_parity():
+    """A killed slice degrades the gang to the survivor (denominator
+    rescales, training continues), then re-admission broadcasts the
+    survivor's state back: both slices end bit-comparable with the full
+    step count applied — the end-to-end elastic acceptance path."""
+    import jax
+
+    ev = FaultEvent(step=2, slice_idx=1, kind="kill", duration_steps=2)
+    inj = PreemptionInjector(PreemptionSchedule([ev]))
+    ms = _elastic_ms(inj)
+    try:
+        states = ms.init_states(jax.random.PRNGKey(0))
+        tokens = _tokens()
+        seen = []
+        for _ in range(6):
+            batches = ms.shard_batches({"tokens": tokens})
+            states, m = ms.step(states, batches)
+            seen.append(m)
+
+        # healthy → degraded (kill at step 2, outage steps 2-3) → re-admitted
+        assert seen[1]["n_live"] == 2 and not seen[1]["degraded"]
+        assert seen[2]["n_live"] == 1 and seen[2]["degraded"] and seen[2]["applied"]
+        assert seen[3]["n_live"] == 1 and seen[3]["degraded"]
+        assert seen[4]["n_live"] == 2 and not seen[4]["degraded"]
+        assert all(np.isfinite(m["loss"]) for m in seen)
+
+        # step count matches an uninterrupted run: every step applied an
+        # update, and the re-admitted slice carries the donor's counter
+        assert int(np.asarray(states[0]["step"])) == 6
+        assert int(np.asarray(states[1]["step"])) == 6
+
+        # parity after re-admit: both slices trained on identically
+        for a, b in zip(
+            jax.tree.leaves(states[0]["params"]), jax.tree.leaves(states[1]["params"])
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+        # recovery log + generation stamps tell the same story
+        assert [e["event"] for e in ms.recovery_log] == ["degrade", "readmit"]
+        assert ms.generation == 2
+        assert inj.fired == [ev]
+
+        g = ms.goodput.summary()
+        assert g["steps"] == 6 and g["degraded_steps"] == 2
+        assert g["recovery_events"] == 2
+        assert set(g["recovery_breakdown_s"]) >= set(RECOVERY_PHASES)
+        assert g["goodput_pct"] is not None and 0.0 < g["goodput_pct"] <= 100.0
+
+        # the recovery published the summary into the process-local
+        # "training" telemetry snapshot — the data /api/training serves
+        from ray_tpu import observability
+
+        snap = observability.snapshot("training")
+        assert snap["elastic"]["recovery_events"] == 2
+        assert "recovery_breakdown_s" in snap["elastic"]
+    finally:
+        ms.close()
+
+
+def test_hung_slice_detected_by_bounded_timeout():
+    """A hang (wedged slice, no exception) is detected by the bounded-
+    timeout probe — the step never blocks on the hung slice beyond
+    probe_timeout_s, and the slice is marked dead as 'hung'."""
+    import jax
+
+    ev = FaultEvent(step=2, slice_idx=1, kind="hang", duration_steps=2)
+    inj = PreemptionInjector(PreemptionSchedule([ev]), hang_s=2.0)
+    ms = _elastic_ms(inj)
+    try:
+        states = ms.init_states(jax.random.PRNGKey(0))
+        tokens = _tokens()
+        for _ in range(2):  # healthy warmup (compiles under the big timeout)
+            states, m = ms.step(states, ms.shard_batches({"tokens": tokens}))
+        ms.probe_timeout_s = 0.5  # << hang_s: detection must be the timeout
+        import time
+
+        t0 = time.perf_counter()
+        states, m = ms.step(states, ms.shard_batches({"tokens": tokens}))
+        assert time.perf_counter() - t0 < 1.9, "step blocked on the hung slice"
+        assert m["degraded"] and m["n_live"] == 1
+        assert ms.recovery_log[0]["kind"] == "hung"
+        ms.probe_timeout_s = 60.0
+        states, m = ms.step(states, ms.shard_batches({"tokens": tokens}))  # degraded
+        states, m = ms.step(states, ms.shard_batches({"tokens": tokens}))  # re-admit
+        assert m["n_live"] == 2 and not m["degraded"]
+        assert int(np.asarray(states[1]["step"])) == 5
+    finally:
+        ms.close()
+
+
+def test_cold_dispatch_compile_grace():
+    """A cold slice's first dispatch has compilation in flight and is
+    judged against max(probe_timeout_s, compile_grace_s) — a
+    steady-state probe timeout far below compile time cannot mark a
+    healthy-but-compiling slice hung at step 0."""
+    import jax
+
+    ms = _elastic_ms(None, probe_timeout_s=0.001)
+    try:
+        states = ms.init_states(jax.random.PRNGKey(0))
+        states, m = ms.step(states, ms.shard_batches({"tokens": _tokens()}))
+        assert m["n_live"] == 2 and not m["degraded"], (
+            "compiling slice was marked dead by the steady-state timeout"
+        )
+        assert ms._warm == [True, True]
+    finally:
+        ms.close()
+
+
+def test_probe_slices_bounded():
+    """probe_slices() answers within the timeout for every slice even
+    when one is wedged — detection is bounded, not an unbounded get."""
+    ev = FaultEvent(step=0, slice_idx=1, kind="hang", duration_steps=1)
+    inj = PreemptionInjector(PreemptionSchedule([ev]), hang_s=2.5)
+    ms = _elastic_ms(inj, probe_timeout_s=1.0)
+    try:
+        assert ms.probe_slices() == {0: True, 1: False}
+    finally:
+        ms.close()
+
+
+# ------------------------------- maintenance notice → priority ckpt
+def test_maintenance_notice_triggers_priority_checkpoint(tmp_path):
+    """An advance maintenance notice lands a PRIORITY checkpoint before
+    the kill fires, and the checkpoint stall is billed to goodput."""
+    import jax
+
+    from ray_tpu.train.checkpoint_manager import CheckpointManager
+
+    ev = FaultEvent(step=3, slice_idx=1, kind="kill", duration_steps=2, notice_steps=2)
+    inj = PreemptionInjector(PreemptionSchedule([ev]))
+    ms = _elastic_ms(inj)
+    mgr = CheckpointManager(
+        str(tmp_path / "run"), fmt="numpy", goodput_meter=ms.goodput
+    )
+    try:
+        states = ms.init_states(jax.random.PRNGKey(0))
+        tokens = _tokens()
+        saved_at = None
+        for step in range(6):
+            if ms.maintenance_notice() and saved_at is None:
+                assert mgr.save(step, states[0], priority=True)
+                saved_at = step
+            states, m = ms.step(states, ms.shard_batches({"tokens": tokens}))
+        mgr.wait()
+        assert saved_at == 1, "notice window (steps 1-2 for a kill at 3) missed"
+        assert mgr.latest_step() == saved_at
+        assert ms.goodput.summary()["recovery_breakdown_s"]["checkpoint_stall"] > 0
+        # the kill still fired and was survived
+        assert [e["event"] for e in ms.recovery_log] == ["degrade", "readmit"]
+    finally:
+        mgr.close()
+        ms.close()
+
+
+# ------------------------------------------------------------ chaos tier
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_generated_schedule_survives():
+    """A seeded generated schedule replayed against a real elastic run:
+    training survives every event, ends fully re-admitted, and the
+    goodput ledger accounts each recovery."""
+    import jax
+
+    sched = PreemptionSchedule.generate(
+        123, n_slices=2, total_steps=24, n_events=2, kinds=("kill", "slow"),
+        duration_steps=(2, 3), min_gap_steps=6,
+    )
+    assert sched.events, "seed 123 must produce a non-empty schedule"
+    inj = PreemptionInjector(sched)
+    ms = _elastic_ms(inj)
+    try:
+        states = ms.init_states(jax.random.PRNGKey(0))
+        tokens = _tokens()
+        for _ in range(24):
+            states, m = ms.step(states, ms.shard_batches({"tokens": tokens}))
+        n_kills = sum(1 for e in sched.events if e.kind == "kill")
+        assert sum(1 for e in ms.recovery_log if e["event"] == "degrade") == n_kills
+        assert sum(1 for e in ms.recovery_log if e["event"] == "readmit") == n_kills
+        assert m["n_live"] == 2
+        g = ms.goodput.summary()
+        assert g["steps"] == 24 and g["recovery_events"] == 2 * n_kills
+        assert g["goodput_pct"] > 0
+    finally:
+        ms.close()
+
+
+def test_bounded_barrier_surfaces_dead_coordinator(monkeypatch):
+    """Satellite: the elastic barrier is never an unbounded get — a
+    coordinator that times out across every retry, or that has died,
+    raises an actionable RuntimeError instead of hanging every rank."""
+    from ray_tpu import exceptions
+    from ray_tpu.train import elastic as el
+
+    class _FakeCoord:
+        class barrier:  # noqa: N801 — mimics the actor method handle
+            @staticmethod
+            def remote(*a):
+                return "ref"
+
+    monkeypatch.setenv("RAY_TPU_ELASTIC_BARRIER_TIMEOUT_S", "0.01")
+    monkeypatch.setenv("RAY_TPU_ELASTIC_BARRIER_RETRIES", "3")
+
+    calls = []
+
+    def timeout_get(ref, timeout=None):
+        calls.append(timeout)
+        raise exceptions.GetTimeoutError("parked")
+
+    monkeypatch.setattr(el.ray_tpu, "get", timeout_get)
+    with pytest.raises(RuntimeError, match="unanswered after 3"):
+        el._bounded_barrier(_FakeCoord(), rank=0, gen=0, step=1)
+    assert calls == [0.01] * 3, "every attempt must carry the bounded timeout"
+
+    def dead_get(ref, timeout=None):
+        raise exceptions.ActorError("coordinator died")
+
+    monkeypatch.setattr(el.ray_tpu, "get", dead_get)
+    with pytest.raises(RuntimeError, match="ElasticCoordinator died"):
+        el._bounded_barrier(_FakeCoord(), rank=0, gen=0, step=1)
+
+    # a barrier that answers within the retry budget passes through
+    answers = iter([exceptions.GetTimeoutError("parked"), {"resync": False}])
+
+    def flaky_get(ref, timeout=None):
+        a = next(answers)
+        if isinstance(a, BaseException):
+            raise a
+        return a
+
+    monkeypatch.setattr(el.ray_tpu, "get", flaky_get)
+    assert el._bounded_barrier(_FakeCoord(), rank=0, gen=0, step=1) == {"resync": False}
+
+
+def test_goodput_meter_ledger():
+    """Pure-host meter arithmetic: booked losses subtract from wall."""
+    t = [0.0]
+    meter = GoodputMeter(clock=lambda: t[0]).start()
+    t[0] = 2.0
+    meter.add_lost("detect", 0.25)
+    meter.add_lost("restore", 0.25)
+    with meter.lost("regang"):
+        t[0] = 2.5
+    meter.step_done()
+    meter.step_done(degraded=True)
+    meter.recovery_event()
+    meter.stop()
+    g = meter.summary()
+    assert g["wall_s"] == 2.5 and g["lost_s"] == 1.0
+    assert g["goodput_pct"] == pytest.approx(100.0 * 1.5 / 2.5)
+    assert g["recovery_breakdown_s"]["regang"] == 0.5
+    assert g["steps"] == 2 and g["degraded_steps"] == 1
+    assert g["recovery_events"] == 1

@@ -69,6 +69,38 @@ def test_multislice_matches_single_mesh_updates():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+def test_dcn_mean_accumulates_bf16_in_float32():
+    """Satellite (round 9): the host-side cross-slice mean accumulates
+    in float32 even for bf16 gradient leaves — the result must equal
+    the float32 mean cast ONCE to bf16 at the H2D push, and the pushed
+    leaf keeps the leaf's own dtype. Accumulating in the bf16 leaf
+    dtype loses mantissa bits as the slice count grows."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.parallel.multislice import setup_multislice_training
+
+    cfg = LlamaConfig.tiny(dtype=jnp.bfloat16)
+    ms = setup_multislice_training(cfg, dcn_dp=4, strategy="dp")
+    rng = np.random.default_rng(0)
+    host = [rng.standard_normal((16, 16)).astype(np.float32) for _ in range(4)]
+    grads = [
+        {"g": jax.device_put(jnp.asarray(h, jnp.bfloat16),
+                             NamedSharding(ms.meshes[s], PartitionSpec()))}
+        for s, h in enumerate(host)
+    ]
+    out = ms._dcn_mean(grads)
+    bf16_inputs = [np.asarray(jnp.asarray(h, jnp.bfloat16), np.float32) for h in host]
+    ref = jnp.asarray(sum(bf16_inputs) / 4.0, jnp.bfloat16)  # f32-accumulated
+    for o in out:
+        assert o["g"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(o["g"], np.float32), np.asarray(ref, np.float32)
+        )
+
+
 def test_setup_sharded_training_dcn_strategy(monkeypatch):
     """The "dcn_dp=2+dp" strategy string routes setup_sharded_training
     to the multislice path (ScalingConfig.strategy plumbing)."""
