@@ -1069,9 +1069,187 @@ def bench_pixel_rl(extra):
             pass
 
 
+_DISPATCH_JIT_SCRIPT = r"""
+import json, os, statistics, sys, time
+sys.path.insert(0, os.getcwd())
+out = {}
+
+# channel round trip BEFORE importing jax (fork + jax threads don't mix)
+from ray_tpu.experimental.channel import RingChannel
+req = RingChannel.create("bench_rt_req", 1 << 16)
+rsp = RingChannel.create("bench_rt_rsp", 1 << 16)
+pid = os.fork()
+if pid == 0:
+    r = RingChannel.open(req.path); s = RingChannel.open(rsp.path)
+    while True:
+        m = r.read(timeout=30)
+        if m == b"q":
+            os._exit(0)
+        s.write(m)
+time.sleep(0.3)
+payload = b"x" * 64
+for _ in range(200):
+    req.write(payload); rsp.read()
+ts = []
+for _ in range(3000):
+    t0 = time.perf_counter()
+    req.write(payload); rsp.read()
+    ts.append(time.perf_counter() - t0)
+out["channel_rt_us"] = round(statistics.median(ts) * 1e6, 1)
+req.write(b"q"); os.waitpid(pid, 0)
+req.unlink(); rsp.unlink()
+
+# pjit dispatch microbenchmarks (the shape of JAX's own
+# benchmarks/api_benchmark.py jit_simple_dispatch / jit_aot_dispatch):
+# python-side per-dispatch overhead, async dispatch timed, one block at
+# the end — so train/decode dispatch tax is tracked per round like MFU
+import jax, jax.numpy as jnp
+x = jnp.arange(8, dtype=jnp.float32)
+f = jax.jit(lambda a: a + 1)
+f(x).block_until_ready()
+N = 2000
+t0 = time.perf_counter()
+for _ in range(N):
+    y = f(x)
+y.block_until_ready()
+out["jit_simple_dispatch_us"] = round((time.perf_counter() - t0) / N * 1e6, 1)
+
+aot = jax.jit(lambda a: a + 1).lower(x).compile()
+aot(x).block_until_ready()
+t0 = time.perf_counter()
+for _ in range(N):
+    y = aot(x)
+y.block_until_ready()
+out["jit_aot_dispatch_us"] = round((time.perf_counter() - t0) / N * 1e6, 1)
+print("DISPATCH_JSON " + json.dumps(out))
+"""
+
+
+def bench_dispatch(extra):
+    """Dispatch-floor microbenchmarks (ROADMAP item 3): pjit dispatch
+    tax, shm-ring channel round trip, direct-transport actor call rate,
+    and serve submit→completion overhead with the fast path on vs off —
+    tracked per round like MFU so regressions in the hot loop's fixed
+    costs are visible."""
+    import statistics
+    import subprocess
+
+    # jit + raw-channel numbers ride a CPU subprocess: the driver may
+    # own a (relay-attached) TPU, which would time the relay instead of
+    # the python dispatch path
+    try:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-c", _DISPATCH_JIT_SCRIPT],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=300,
+        )
+        line = next(
+            (l for l in proc.stdout.splitlines() if l.startswith("DISPATCH_JSON ")),
+            None,
+        )
+        if line is None:
+            raise RuntimeError(
+                f"no DISPATCH_JSON (exit {proc.returncode}); stderr tail: "
+                f"{proc.stderr[-500:].strip()}"
+            )
+        r = json.loads(line[len("DISPATCH_JSON "):])
+        extra.update(r)
+        log(f"[bench] jit dispatch: simple {r['jit_simple_dispatch_us']}us "
+            f"aot {r['jit_aot_dispatch_us']}us; channel rt {r['channel_rt_us']}us")
+    except Exception as e:
+        log(f"[bench] jit/channel dispatch bench skipped: {e}")
+
+    # direct-transport actor calls vs the RPC stack, same harness shape
+    # as actor_calls_async_1to1 (N in flight, amortized per-call cost)
+    import ray_tpu
+
+    try:
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+
+        @ray_tpu.remote
+        class Echo:
+            def ping(self, x=None):
+                return x
+
+        a = Echo.remote()
+        ray_tpu.get(a.ping.remote())
+        m = a.ping.options(direct=True)
+        m.remote()  # kick negotiation
+        time.sleep(1.5)
+        from ray_tpu.experimental.direct_transport import transport_stats
+
+        N = 3000
+
+        def _run(meth):
+            t0 = time.perf_counter()
+            ray_tpu.get([meth.remote() for _ in range(N)])
+            return (time.perf_counter() - t0) / N * 1e6
+
+        _run(m)  # warm
+        direct_us = min(_run(m) for _ in range(3))
+        rpc_us = min(_run(a.ping) for _ in range(3))
+        engaged = any(s["direct_calls"] > 0 for s in transport_stats().values())
+        extra["direct_call_us"] = round(direct_us, 1)
+        extra["direct_call_rpc_us"] = round(rpc_us, 1)
+        extra["direct_call_engaged"] = engaged
+        log(f"[bench] direct actor call: {direct_us:.1f}us/call vs RPC "
+            f"{rpc_us:.1f}us/call (fast path engaged: {engaged})")
+        ray_tpu.kill(a)
+
+        # serve submit→completion overhead (non-compute): a no-op
+        # deployment, serial p50 round trip through the handle — the
+        # per-request fixed cost every steady-state serve request pays.
+        # Measured twice: fast path on, then forced off (RPC), for the
+        # overhead ratio.
+        from ray_tpu import serve
+        from ray_tpu._private.config import RayConfig
+
+        @serve.deployment
+        class Null:
+            def __call__(self, x):
+                return x
+
+        handle = serve.run(Null.bind(), name="bench_dispatch")
+        handle.remote(1).result(timeout=30)
+
+        def _serve_p50():
+            for _ in range(100):  # warm + negotiate
+                handle.remote(1).result(timeout=30)
+            ts = []
+            for _ in range(400):
+                t0 = time.perf_counter()
+                handle.remote(1).result(timeout=30)
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts) * 1e6
+
+        direct_serve = _serve_p50()
+        RayConfig.update({"direct_transport_enabled": False})
+        try:
+            rpc_serve = _serve_p50()
+        finally:
+            RayConfig.update({"direct_transport_enabled": True})
+        extra["serve_submit_overhead_us"] = round(direct_serve, 1)
+        extra["serve_submit_overhead_rpc_us"] = round(rpc_serve, 1)
+        extra["serve_submit_overhead_speedup"] = round(rpc_serve / max(direct_serve, 1e-9), 2)
+        log(f"[bench] serve submit overhead: {direct_serve:.0f}us direct vs "
+            f"{rpc_serve:.0f}us rpc ({rpc_serve / max(direct_serve, 1e-9):.2f}x)")
+        serve.shutdown()
+    except Exception as e:
+        log(f"[bench] direct-transport bench skipped: {e}")
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+    _settle()
+
+
 def main():
     extra = {}
     bench_runtime(extra)
+    bench_dispatch(extra)
     bench_broadcast(extra)
     bench_data_pipeline(extra)
     bench_telemetry_overhead(extra)

@@ -37,6 +37,17 @@ _DEFS: Dict[str, Any] = {
     # overhead: measured 17.0k vs 10.0k noop tasks/s with 1 vs 2 leases)
     "max_leases_per_shape": max(1, os.cpu_count() or 4),
     "actor_call_batch_max": 128,  # pipelined actor calls coalesced per wire message
+    # --- direct transport (shm-ring actor dispatch fast path) ---
+    # opt-in per method via .options(direct=True); negotiated lazily on
+    # first call, falls back to RPC for large payloads / ref args /
+    # non-colocated actors / broken streams (docs/ARCHITECTURE.md
+    # "Dispatch fast path")
+    "direct_transport_enabled": True,
+    "direct_transport_ring_bytes": 1 << 20,  # per-direction ring capacity
+    "direct_transport_max_payload_bytes": 128 * 1024,  # bigger calls ride RPC
+    "direct_transport_write_timeout_s": 0.2,  # ring-full grace before RPC fallback
+    "direct_transport_slow_method_ms": 2.0,  # inline→pool reclassification bar
+    "direct_transport_liveness_s": 5.0,  # idle-with-inflight death-poll period
     "direct_task_batch_max": 128,  # direct-path tasks coalesced per wire message
     "worker_pool_prestart": 2,
     "worker_pool_max_idle": 8,

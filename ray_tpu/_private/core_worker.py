@@ -226,6 +226,10 @@ class CoreWorker:
         self._actor_addr_cache: Dict[str, str] = {}
         self._actor_queues: Dict[str, "collections.deque"] = {}
         self._actor_senders: Dict[str, asyncio.Task] = {}
+        # direct transport: per-actor shm-ring clients (lazy; see
+        # experimental/direct_transport.py)
+        self._direct_clients: Dict[str, Any] = {}
+        self._direct_clients_lock = threading.Lock()
 
         self._subscriptions: Dict[str, List] = {}
         self.executor = None  # set by worker_proc for executor workers
@@ -688,6 +692,12 @@ class CoreWorker:
         from ray_tpu._private.object_ref import set_ref_hooks
 
         set_ref_hooks(None)
+        for client in list(self._direct_clients.values()):
+            try:
+                client.close()
+            except Exception:
+                pass
+        self._direct_clients.clear()
 
         async def _aclose():
             # last task-event flush so short-lived drivers still surface
@@ -2174,6 +2184,7 @@ class CoreWorker:
         kwargs: dict,
         num_returns: int = 1,
         max_task_retries: int = 0,
+        direct: bool = False,
     ) -> List[ObjectRef]:
         returns = [new_id() for _ in range(num_returns)]
         # slim spec — no task_id (returns[0] is the call's identity: actor
@@ -2183,10 +2194,12 @@ class CoreWorker:
         # actor transport needs only method+args+seq)
         # empty args stay OFF the wire entirely (the no-arg ping is the
         # fan-out hot shape; consumers treat a missing "args" as empty)
+        has_refs = False
         if args or kwargs:
             packed = self.pack_args(args, kwargs)
             spec = {"method": method_name, "args": packed, "returns": returns}
             if packed.get("hr") or packed.get("nr"):
+                has_refs = True
                 self._pin_args(returns[0], packed)
         else:
             spec = {"method": method_name, "returns": returns}
@@ -2195,10 +2208,28 @@ class CoreWorker:
         if tracing.should_trace():
             spec["trace"] = tracing.submission_context(method_name)
         self._register_returns(returns)
+        # opted-in hot methods try the shm-ring fast path; ref-carrying
+        # args stay on RPC (borrow bookkeeping rides the RPC reply), and
+        # any transport-level refusal falls through to the RPC enqueue
+        if direct and not has_refs and RayConfig.direct_transport_enabled:
+            client = self._direct_client(actor_id)
+            if client.try_submit(spec):
+                return [ObjectRef(oid) for oid in returns]
         # fire-and-forget enqueue: the caller holds refs whose cells are
         # already waitable; the loop does the sending
         self._post(lambda: self._enqueue_actor_call(actor_id, spec, max_task_retries))
         return [ObjectRef(oid) for oid in returns]
+
+    def _direct_client(self, actor_id: str):
+        client = self._direct_clients.get(actor_id)
+        if client is None:
+            from ray_tpu.experimental.direct_transport import DirectClient
+
+            with self._direct_clients_lock:
+                client = self._direct_clients.get(actor_id)
+                if client is None:
+                    client = self._direct_clients[actor_id] = DirectClient(self, actor_id)
+        return client
 
     def _enqueue_actor_call(self, actor_id: str, spec, retries_left: int):
         import collections

@@ -202,12 +202,13 @@ class NodeProcesses:
         # /dev/shm files in its own graceful path, so session teardown
         # must sweep its children's or kill-tested runs leak host shm
         # until the next init's stale-arena GC. Names embed the creator
-        # pid (ray_tpu_<pid>_* / ray_tpu_chan_<pid>_*).
+        # pid (ray_tpu_<pid>_* / ray_tpu_chan_<pid>_* /
+        # ray_tpu_ring_<pid>_* direct-transport rings).
         import re
 
         pids = {str(proc.pid) for proc in self.procs}
         for name in os.listdir("/dev/shm"):
-            m = re.match(r"ray_tpu_(?:chan_)?(\d+)_", name)
+            m = re.match(r"ray_tpu_(?:chan_|ring_)?(\d+)_", name)
             if not m:
                 continue
             pid_s = m.group(1)

@@ -50,7 +50,7 @@ def _gc_stale_arenas():
     import re
 
     for path in glob.glob("/dev/shm/ray_tpu_*"):
-        m = re.match(r".*/ray_tpu_(?:chan_)?(\d+)_", path)
+        m = re.match(r".*/ray_tpu_(?:chan_|ring_)?(\d+)_", path)
         if not m:
             continue
         pid = int(m.group(1))

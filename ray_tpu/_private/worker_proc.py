@@ -72,6 +72,14 @@ class Executor:
         # separate from the C++ core, python/ray/_private/async_compat.py)
         self._user_loop: Optional[asyncio.AbstractEventLoop] = None
         self.actor_id: Optional[str] = None
+        # direct (shm-ring) transport endpoints serving this actor, one
+        # per connected caller (experimental/direct_transport.py)
+        self.direct_servers: list = []
+        # serial actors (sync, max_concurrency=1) must stay mutually
+        # exclusive between the RPC pool thread and direct service
+        # threads — both execution paths take this lock
+        self._serial_lock = threading.Lock()
+        self._serial_exec = False
         # per-caller ordering state
         self._order: Dict[str, Dict[str, Any]] = {}
         self._current_task_id: Optional[str] = None
@@ -125,6 +133,7 @@ class Executor:
             self.pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_conc, thread_name_prefix="actor")
         self.actor_max_concurrency = max_conc
         self.actor_semaphore = asyncio.Semaphore(max_conc)
+        self._serial_exec = not self.actor_is_async and max_conc == 1
         return {"ok": True, "addr": self.core._listen_addr}
 
     async def handle_direct_task(self, data) -> Dict[str, Any]:
@@ -202,6 +211,20 @@ class Executor:
             "o": [oid for r in replies for oid in r["o"]],
             "e": [env for r in replies for env in r["e"]],
         }
+
+    def exec_direct(self, spec: Dict[str, Any]):
+        """Execute one direct-transport call on the CALLING thread (the
+        ring service thread, or a pool thread for reclassified-slow
+        methods) and return result envelopes. Reuses the full sync
+        execution path — overlays, tracing spans, error conversion,
+        serial-actor locking — then registers retained borrows before
+        the reply ships (the same contract the RPC reply path keeps).
+        Not a cancel target (cancellable=False): cancel() routes over
+        RPC and must keep aiming at the pool thread's current task."""
+        envs = self._exec_sync_one(spec, True, self.loop, cancellable=False)
+        if self.core._ref_events or self.core._borrows_to_flush:
+            self.core.flush_borrows_sync()
+        return envs
 
     def _ensure_user_loop(self) -> asyncio.AbstractEventLoop:
         if self._user_loop is None:
@@ -308,7 +331,7 @@ class Executor:
                 except KeyboardInterrupt:
                     continue
 
-    def _exec_sync_one(self, spec, actor: bool, loop):
+    def _exec_sync_one(self, spec, actor: bool, loop, cancellable: bool = True):
         """Thread-side: execute ONE spec fully — unpack → invoke →
         serialize → error conversion. Runs on a pool thread so pipelined
         batches can share a single loop⇄thread round trip."""
@@ -318,9 +341,13 @@ class Executor:
         tid = spec.get("task_id") or spec["returns"][0]
         try:
             # the task that owns the pool thread is the one cancel() can
-            # interrupt, so both fields are set HERE, on that thread
-            self._current_thread_ident = threading.get_ident()
-            self._current_task_id = tid
+            # interrupt, so both fields are set HERE, on that thread.
+            # Direct-transport threads run this concurrently with the
+            # pool thread and are NOT cancel targets (cancel routes over
+            # RPC) — they must not clobber the pool task's identity
+            if cancellable:
+                self._current_thread_ident = threading.get_ident()
+                self._current_task_id = tid
             try:
                 if tid in self._cancelled:
                     raise exceptions.TaskCancelledError(spec.get("name", ""))
@@ -348,6 +375,16 @@ class Executor:
                         from ray_tpu.experimental.compiled_dag import run_channel_loop
 
                         fn = functools.partial(run_channel_loop, self.actor_instance)
+                    elif spec["method"] == "__ray_tpu_direct_connect__":
+                        # direct-transport negotiation (experimental/
+                        # direct_transport.py): open the caller's rings
+                        # and start the resident service thread — same
+                        # framework-method interception as the DAG loop
+                        import functools
+
+                        from ray_tpu.experimental.direct_transport import accept_connect
+
+                        fn = functools.partial(accept_connect, self)
                     else:
                         fn = getattr(self.actor_instance, spec["method"])
                 else:
@@ -381,6 +418,13 @@ class Executor:
                         result = _a.run_coroutine_threadsafe(
                             fn(*args, **kwargs), self._ensure_user_loop()
                         ).result()
+                    elif actor and self._serial_exec:
+                        # serial actor: direct-transport service threads
+                        # execute user code too, so the single pool
+                        # thread alone no longer implies serial — both
+                        # paths take this (uncontended-cheap) lock
+                        with self._serial_lock:
+                            result = fn(*args, **kwargs)
                     else:
                         result = fn(*args, **kwargs)
                 values = self._split_returns(spec, result)
@@ -388,8 +432,9 @@ class Executor:
                     return [self._bad_arity_env(spec, name)] * len(spec["returns"])
                 return [self._to_env_sync(oid, v) for oid, v in zip(spec["returns"], values)]
             finally:
-                self._current_thread_ident = None
-                self._current_task_id = None
+                if cancellable:
+                    self._current_thread_ident = None
+                    self._current_task_id = None
         except (Exception, KeyboardInterrupt) as e:
             # KeyboardInterrupt is how cancel() interrupts the user thread
             # (PyThreadState_SetAsyncExc) — it is a BaseException, so a bare

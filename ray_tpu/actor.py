@@ -17,16 +17,25 @@ from ray_tpu.remote_function import _normalize_resources, _scheduling_fields
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1,
+                 direct: bool = False):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._direct = direct
 
     def remote(self, *args, **kwargs):
-        return self._handle._invoke(self._method_name, args, kwargs, self._num_returns)
+        return self._handle._invoke(
+            self._method_name, args, kwargs, self._num_returns, direct=self._direct
+        )
 
-    def options(self, num_returns: int = 1, **_):
-        return ActorMethod(self._handle, self._method_name, num_returns)
+    def options(self, num_returns: int = 1, direct: bool = False, **_):
+        """`direct=True` opts this method into the shm-ring direct
+        transport (experimental/direct_transport.py): steady-state calls
+        bypass the asyncio RPC stack, falling back to RPC for ref args,
+        oversized payloads, non-colocated actors and broken streams.
+        Direct calls order among themselves, not against RPC calls."""
+        return ActorMethod(self._handle, self._method_name, num_returns, direct=direct)
 
     def bind(self, *args, **kwargs):
         from ray_tpu.dag import ActorMethodNode
@@ -51,7 +60,7 @@ class ActorHandle:
     def _id(self):
         return self._actor_id
 
-    def _invoke(self, method_name, args, kwargs, num_returns):
+    def _invoke(self, method_name, args, kwargs, num_returns, direct: bool = False):
         from ray_tpu._private.worker import get_global_core
 
         core = get_global_core()
@@ -62,6 +71,7 @@ class ActorHandle:
             kwargs,
             num_returns=num_returns,
             max_task_retries=self._max_task_retries,
+            direct=direct,
         )
         return refs[0] if num_returns == 1 else refs
 

@@ -1,1 +1,2 @@
 from ray_tpu.experimental import internal_kv  # noqa: F401
+from ray_tpu.experimental import direct_transport  # noqa: F401

@@ -1,47 +1,78 @@
-"""Mutable shared-memory channels — the compiled-DAG transport.
+"""Mutable shared-memory channels — the direct-dispatch transport.
 
 Equivalent of the reference's experimental channels
 (reference: python/ray/experimental/channel.py _create_channel_ref — a
 reusable mutable plasma buffer that compiled DAGs write/read per
-execution instead of allocating a new object per call). A channel is a
-tiny /dev/shm mmap:
+execution instead of allocating a new object per call). Two wire
+formats, both a tiny /dev/shm mmap:
 
-    [ magic u64 | seq u64 | len u64 | notify u32 | pad u32 | payload ]
+1. `Channel` — single-slot seq channel (compiled-DAG lockstep rounds):
 
-Writer stores payload then bumps seq (then notify); readers wait for a
-seq past their cursor. The hot path is the native library
-(src/channel.cc): FUTEX_WAIT on the notify word instead of sleep
-polling — microsecond wakeups with zero busy CPU. A pure-python
-polling implementation backs it up when the native build is
-unavailable, and the two interoperate on the same wire format (the
-native reader's futex wait is time-sliced so python writers, which
-cannot futex-wake, still unblock it).
+    [ magic u64 | seq u64 | len u64 | notify u32 | caps u32 | payload ]
+
+   Writer stores payload then bumps seq (then notify); readers wait for
+   a seq past their cursor. One message in flight.
+
+2. `RingChannel` — multi-in-flight byte ring (the direct actor
+   transport's request/response streams):
+
+    [ magic u64 | capacity u64 | head u64 | tail u64 |
+      wr_notify u32 | rd_notify u32 | caps u32 | rsvd | payload ring ]
+
+   head/tail are cumulative byte counts; records are
+   [len u64 | payload | pad to 8] and may wrap the ring edge. The
+   writer blocks on ring-full (slow-reader backpressure), the reader
+   on ring-empty.
+
+The hot path is the native library (src/channel.cc): FUTEX_WAIT on the
+notify words — microsecond wakeups with zero busy CPU. A pure-python
+implementation backs it up when the native build is unavailable and
+interoperates on the same wire format. Python endpoints issue the
+futex syscalls themselves via ctypes (FUTEX_WAKE after every publish,
+FUTEX_WAIT instead of sleep polling), and advertise that in the
+header's caps word so native peers drop their compensating time-sliced
+waits for pure ones; only when the futex syscall is unavailable
+(non-Linux) does an endpoint clear the caps bits and fall back to
+sleep polling — and peers then time-slice their waits to compensate.
 """
 from __future__ import annotations
 
 import ctypes
 import mmap
 import os
+import platform
 import struct
 import threading
 import time
 from typing import Optional
 
-_HDR = struct.Struct("<QQQII")  # magic, seq, payload_len, notify, pad
+_HDR = struct.Struct("<QQQII")  # magic, seq, payload_len, notify, caps
 _MAGIC = 0x52545043484E4C31  # "RTPCHNL1"
+
+# magic, cap, head, tail, wr_notify, rd_notify, caps, rsvd0,
+# wr_parked, rd_parked (+ 8 reserved bytes to 64)
+_RING_HDR = struct.Struct("<QQQQIIIIII")
+_RING_MAGIC = 0x52545052494E4731  # "RTPRING1"
+_RING_HDR_SIZE = 64
+_WR_PARKED_OFF = 48
+_RD_PARKED_OFF = 52
+
+CAP_WRITER_WAKES = 1  # every writer futex-wakes after publishing
+CAP_READER_WAKES = 2  # every reader futex-wakes after consuming
 
 _SRC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "src", "channel.cc"
 )
 _build_lock = threading.Lock()
 _lib = None
+_lib_gil = None  # PyDLL binding: GIL stays HELD (non-blocking calls only)
 _lib_tried = False
 
 
 def _native_lib() -> Optional[ctypes.CDLL]:
     """Build (hash-keyed, shared helper) + load the futex channel lib;
-    None when unavailable — callers fall back to polling."""
-    global _lib, _lib_tried
+    None when unavailable — callers fall back to the python paths."""
+    global _lib, _lib_gil, _lib_tried
     if _lib_tried:
         return _lib
     with _build_lock:
@@ -50,7 +81,8 @@ def _native_lib() -> Optional[ctypes.CDLL]:
         try:
             from ray_tpu._private.native_build import build_native_library
 
-            lib = ctypes.CDLL(build_native_library(_SRC, "channel"))
+            so_path = build_native_library(_SRC, "channel")
+            lib = ctypes.CDLL(so_path)
             lib.chan_open.restype = ctypes.c_void_p
             lib.chan_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
             lib.chan_capacity.restype = ctypes.c_uint64
@@ -65,15 +97,111 @@ def _native_lib() -> Optional[ctypes.CDLL]:
                 ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
             ]
             lib.chan_close.argtypes = [ctypes.c_void_p]
+            lib.ring_open.restype = ctypes.c_void_p
+            lib.ring_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+            lib.ring_capacity.restype = ctypes.c_uint64
+            lib.ring_capacity.argtypes = [ctypes.c_void_p]
+            lib.ring_pending.restype = ctypes.c_uint64
+            lib.ring_pending.argtypes = [ctypes.c_void_p]
+            lib.ring_write.restype = ctypes.c_uint64
+            lib.ring_write.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int64,
+            ]
+            lib.ring_read.restype = ctypes.c_int64
+            lib.ring_read.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int64,
+            ]
+            lib.ring_close.argtypes = [ctypes.c_void_p]
+            # second binding of the SAME .so via PyDLL: the GIL stays
+            # held across the call, so a non-blocking ring op (~1us)
+            # skips the release/re-acquire round trip — under pipelined
+            # load, re-acquiring the GIL after a CDLL call stalls the
+            # submitting thread behind whichever thread grabbed it (up
+            # to a full 5ms switch interval; measured ~96us/call on the
+            # serve hot loop). ONLY ever call these with timeout 0.
+            gil = ctypes.PyDLL(so_path)
+            gil.ring_write.restype = ctypes.c_uint64
+            gil.ring_write.argtypes = lib.ring_write.argtypes
+            gil.ring_read.restype = ctypes.c_int64
+            gil.ring_read.argtypes = lib.ring_read.argtypes
+            gil.chan_write.restype = ctypes.c_uint64
+            gil.chan_write.argtypes = lib.chan_write.argtypes
             _lib = lib
+            _lib_gil = gil
         except Exception:
             _lib = None
+            _lib_gil = None
         _lib_tried = True
         return _lib
 
 
+# ------------------------------------------------------------------ futex
+# Python-side futex syscalls (satellite of the wake-capability protocol):
+# a python writer that cannot wake forces every native reader to
+# time-slice its waits — so python issues the syscall itself via ctypes.
+_FUTEX_WAIT = 0
+_FUTEX_WAKE = 1
+_SYS_FUTEX = {
+    "x86_64": 202, "aarch64": 98, "riscv64": 98,
+    "armv7l": 240, "i686": 240, "ppc64le": 221, "s390x": 238,
+}.get(platform.machine())
+
+
+class _timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+_libc = None
+_futex_tried = False
+
+
+def _futex_syscall():
+    """libc.syscall bound for futex, or None when unsupported."""
+    global _libc, _futex_tried
+    if _futex_tried:
+        return _libc
+    _futex_tried = True
+    if _SYS_FUTEX is None or not hasattr(os, "uname") or os.uname().sysname != "Linux":
+        _libc = None
+        return None
+    try:
+        _libc = ctypes.CDLL(None, use_errno=True)
+        _libc.syscall.restype = ctypes.c_long
+    except Exception:
+        _libc = None
+    return _libc
+
+
+def futex_available() -> bool:
+    return _futex_syscall() is not None
+
+
+def _futex_wake(word: ctypes.c_uint32) -> None:
+    lib = _futex_syscall()
+    if lib is not None:
+        lib.syscall(_SYS_FUTEX, ctypes.byref(word), _FUTEX_WAKE,
+                    0x7FFFFFFF, None, None, 0)
+
+
+def _futex_wait(word: ctypes.c_uint32, expected: int, timeout_s: float) -> None:
+    """Wait while *word == expected, up to timeout_s. Spurious returns
+    (EINTR/EAGAIN) are fine — callers loop on the real condition."""
+    lib = _futex_syscall()
+    if lib is None:
+        time.sleep(min(timeout_s, 2e-3))
+        return
+    ts = _timespec(int(timeout_s), int((timeout_s - int(timeout_s)) * 1e9))
+    lib.syscall(_SYS_FUTEX, ctypes.byref(word), _FUTEX_WAIT,
+                ctypes.c_uint32(expected), ctypes.byref(ts), None, 0)
+
+
 class ChannelTimeoutError(TimeoutError):
     pass
+
+
+class RingFullError(Exception):
+    """Writer overrun: the ring stayed full past the write timeout (or a
+    non-blocking write found it full)."""
 
 
 class Channel:
@@ -86,6 +214,20 @@ class Channel:
         self._mm = mm  # python fallback
         self._cursor = 0  # reader-side: last seq consumed
         self._closed = False
+        if mm is not None:
+            # stable addresses of the notify word for the futex syscalls
+            # (from_buffer pins the mmap; close() tolerates BufferError)
+            self._notify_word = ctypes.c_uint32.from_buffer(mm, 24)
+            self._advertise_caps(mm, 28)
+
+    @staticmethod
+    def _advertise_caps(mm, off: int):
+        """Set (or clear) the writer-wakes capability bit for this python
+        endpoint. Setup-time only — not atomic, which is fine: losing a
+        concurrent set degrades to a time-sliced wait, never a hang."""
+        (caps,) = struct.unpack_from("<I", mm, off)
+        caps = (caps | CAP_WRITER_WAKES) if futex_available() else (caps & ~CAP_WRITER_WAKES)
+        struct.pack_into("<I", mm, off, caps)
 
     # -- lifecycle -------------------------------------------------------
     @classmethod
@@ -134,6 +276,7 @@ class Channel:
             _native_lib().chan_close(self._handle)
             self._handle = None
         if self._mm is not None:
+            self._notify_word = None  # unpin before closing the map
             try:
                 self._mm.close()
             except (BufferError, ValueError):
@@ -158,7 +301,10 @@ class Channel:
         if len(payload) > self.capacity:
             raise ValueError(f"payload {len(payload)} exceeds channel capacity {self.capacity}")
         if self._handle is not None:
-            return _native_lib().chan_write(self._handle, payload, len(payload))
+            # chan_write never blocks (single-slot overwrite): the
+            # GIL-held binding skips the release/re-acquire stall
+            _native_lib()
+            return _lib_gil.chan_write(self._handle, payload, len(payload))
         mm = self._mm
         mm[_HDR.size : _HDR.size + len(payload)] = payload
         magic, seq, _, notify, _ = _HDR.unpack_from(mm, 0)
@@ -173,6 +319,9 @@ class Channel:
         struct.pack_into("<Q", mm, 16, len(payload))
         struct.pack_into("<Q", mm, 8, seq + 1)
         struct.pack_into("<I", mm, 24, (notify + 1) & 0xFFFFFFFF)
+        # wake futex-waiting readers (native or python): without this a
+        # native reader can only time-slice its wait to notice us
+        _futex_wake(self._notify_word)
         return seq + 1
 
     def read(self, timeout: Optional[float] = 10.0) -> bytes:
@@ -196,9 +345,10 @@ class Channel:
             self._cursor = seq_out.value
             return ctypes.string_at(buf, n)
         deadline = None if timeout is None else time.monotonic() + timeout
+        use_futex = futex_available()
         delay = 20e-6
         while True:
-            magic, seq, ln, _, _ = _HDR.unpack_from(self._mm, 0)
+            magic, seq, ln, notify, caps = _HDR.unpack_from(self._mm, 0)
             if seq > self._cursor:
                 payload = bytes(self._mm[_HDR.size : _HDR.size + ln])
                 # stable-seq re-check: if a concurrent write advanced seq
@@ -210,7 +360,295 @@ class Channel:
                     continue
                 self._cursor = seq
                 return payload
-            if deadline is not None and time.monotonic() > deadline:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
                 raise ChannelTimeoutError(f"channel {self.path} idle for {timeout}s")
-            time.sleep(delay)
-            delay = min(delay * 2, 2e-3)
+            if use_futex:
+                # pure wait when the peer advertises wake capability;
+                # time-sliced otherwise (a poll-only writer can't wake us)
+                slice_s = 3600.0 if caps & CAP_WRITER_WAKES else 2e-3
+                if remaining is not None:
+                    slice_s = min(slice_s, remaining)
+                _futex_wait(self._notify_word, notify, slice_s)
+            else:
+                time.sleep(delay)
+                delay = min(delay * 2, 2e-3)
+
+
+class RingChannel:
+    """Multi-in-flight byte ring over a /dev/shm mmap (see module doc).
+
+    Single consumer always. Single producer PROCESS by default; within
+    that process concurrent writer threads serialize on an internal
+    lock. `multi_producer=True` additionally serializes producers
+    ACROSS processes with an fcntl range lock on the ring file — such
+    endpoints always use the python write path (the native write path
+    assumes external serialization), at ~1µs extra per write; readers
+    still go native. The direct actor transport's per-(caller, actor)
+    rings are SPSC and never pay this.
+    """
+
+    def __init__(self, path: str, capacity: int, handle=None,
+                 mm: Optional[mmap.mmap] = None, lock_fd: Optional[int] = None):
+        self.path = path
+        self.capacity = capacity
+        self._handle = handle
+        self._mm = mm
+        self._lock_fd = lock_fd  # multi-producer cross-process lock
+        self._wlock = threading.Lock()
+        self._closed = False
+        if mm is not None:
+            self._wr_word = ctypes.c_uint32.from_buffer(mm, 32)
+            self._rd_word = ctypes.c_uint32.from_buffer(mm, 36)
+            self._advertise_caps(mm)
+
+    @staticmethod
+    def _advertise_caps(mm):
+        (caps,) = struct.unpack_from("<I", mm, 40)
+        bits = CAP_WRITER_WAKES | CAP_READER_WAKES
+        caps = (caps | bits) if futex_available() else (caps & ~bits)
+        struct.pack_into("<I", mm, 40, caps)
+
+    # -- lifecycle -------------------------------------------------------
+    @classmethod
+    def create(cls, name: str, capacity: int = 1 << 20, *,
+               multi_producer: bool = False,
+               use_native: Optional[bool] = None) -> "RingChannel":
+        path = (
+            name if name.startswith("/") else
+            f"/dev/shm/ray_tpu_ring_{os.getpid()}_{name}"
+        )
+        lib = _native_lib() if use_native in (None, True) else None
+        if lib is not None and not multi_producer:
+            h = lib.ring_open(path.encode(), capacity, 1)
+            if not h:
+                raise FileExistsError(path)
+            return cls(path, capacity, handle=h)
+        fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_EXCL, 0o600)
+        try:
+            os.ftruncate(fd, _RING_HDR_SIZE + capacity)
+            mm = mmap.mmap(fd, _RING_HDR_SIZE + capacity)
+        except BaseException:
+            os.close(fd)
+            raise
+        _RING_HDR.pack_into(mm, 0, _RING_MAGIC, capacity, 0, 0, 0, 0, 0, 0, 0, 0)
+        struct.pack_into("<Q", mm, 56, 0)
+        if multi_producer:
+            return cls(path, capacity, mm=mm, lock_fd=fd)
+        os.close(fd)
+        return cls(path, capacity, mm=mm)
+
+    @classmethod
+    def open(cls, path: str, *, multi_producer: bool = False,
+             use_native: Optional[bool] = None) -> "RingChannel":
+        lib = _native_lib() if use_native in (None, True) else None
+        if lib is not None and not multi_producer:
+            h = lib.ring_open(path.encode(), 0, 0)
+            if not h:
+                raise ValueError(f"{path} is not a ring channel")
+            return cls(path, lib.ring_capacity(h), handle=h)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            size = os.fstat(fd).st_size
+            mm = mmap.mmap(fd, size)
+        except BaseException:
+            os.close(fd)
+            raise
+        (magic,) = struct.unpack_from("<Q", mm, 0)
+        if magic != _RING_MAGIC or size < _RING_HDR_SIZE:
+            mm.close()
+            os.close(fd)
+            raise ValueError(f"{path} is not a ring channel")
+        if multi_producer:
+            return cls(path, size - _RING_HDR_SIZE, mm=mm, lock_fd=fd)
+        os.close(fd)
+        return cls(path, size - _RING_HDR_SIZE, mm=mm)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._handle is not None:
+            _native_lib().ring_close(self._handle)
+            self._handle = None
+        if self._mm is not None:
+            self._wr_word = self._rd_word = None
+            try:
+                self._mm.close()
+            except (BufferError, ValueError):
+                pass
+        if self._lock_fd is not None:
+            try:
+                os.close(self._lock_fd)
+            except OSError:
+                pass
+            self._lock_fd = None
+
+    def unlink(self):
+        self.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    # -- data plane ------------------------------------------------------
+    def pending(self) -> int:
+        """Bytes published but not yet consumed."""
+        if self._handle is not None:
+            return _native_lib().ring_pending(self._handle)
+        _, _, head, tail = struct.unpack_from("<QQQQ", self._mm, 0)
+        return head - tail
+
+    @staticmethod
+    def _rec_size(n: int) -> int:
+        return 8 + ((n + 7) & ~7)
+
+    def write(self, payload: bytes, timeout: Optional[float] = 10.0) -> None:
+        """Append one record. Blocks while the ring is full (slow-reader
+        backpressure) up to `timeout` (None = forever, 0 = non-blocking);
+        raises RingFullError on overrun, ValueError if the record can
+        never fit."""
+        if self._rec_size(len(payload)) > self.capacity:
+            raise ValueError(
+                f"record {len(payload)}B can never fit ring capacity {self.capacity}"
+            )
+        if self._handle is not None:
+            tmo = -1 if timeout is None else max(0, int(timeout * 1000))
+            if timeout is not None and timeout > 0 and tmo == 0:
+                tmo = 1
+            # native ring_write is single-producer; the in-process lock
+            # makes one RingChannel object safe for many writer threads
+            # (uncontended-cheap; cross-process stays single-producer)
+            with self._wlock:
+                # GIL-held non-blocking attempt first (the steady-state
+                # ring has room; re-acquiring the GIL after a releasing
+                # call stalls the submit thread behind reply processing),
+                # then the GIL-releasing blocking path on a full ring
+                _native_lib()
+                r = _lib_gil.ring_write(self._handle, payload, len(payload), 0)
+                if r == 0 and tmo != 0:
+                    r = _lib.ring_write(self._handle, payload, len(payload), tmo)
+            if r == 0:
+                raise RingFullError(
+                    f"ring {self.path} full ({self.capacity}B) after {timeout}s"
+                )
+            if r == 0xFFFFFFFFFFFFFFFF:
+                raise ValueError(f"record can never fit ring {self.path}")
+            return
+        with self._wlock:
+            if self._lock_fd is not None:
+                import fcntl
+
+                fcntl.lockf(self._lock_fd, fcntl.LOCK_EX)
+            try:
+                self._py_write(payload, timeout)
+            finally:
+                if self._lock_fd is not None:
+                    import fcntl
+
+                    fcntl.lockf(self._lock_fd, fcntl.LOCK_UN)
+
+    def _py_write(self, payload: bytes, timeout: Optional[float]) -> None:
+        mm = self._mm
+        rec = self._rec_size(len(payload))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        parked = False
+        try:
+            while True:
+                _, cap, head, tail, wrn, rdn, caps, _, _, _ = _RING_HDR.unpack_from(mm, 0)
+                if head - tail + rec <= cap:
+                    break
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise RingFullError(
+                        f"ring {self.path} full ({self.capacity}B) after {timeout}s"
+                    )
+                # announce the park so a (native) reader pays the wake
+                # syscall; plain store + bounded backstop slice instead
+                # of the native path's seq_cst handshake + pure wait
+                if not parked:
+                    struct.pack_into("<I", mm, _RD_PARKED_OFF, 1)
+                    parked = True
+                slice_s = 0.05 if (caps & CAP_READER_WAKES and futex_available()) else 2e-3
+                if remaining is not None:
+                    slice_s = min(slice_s, remaining)
+                _futex_wait(self._rd_word, rdn, slice_s)
+        finally:
+            if parked:
+                struct.pack_into("<I", mm, _RD_PARKED_OFF, 0)
+        self._copy_in(head, struct.pack("<Q", len(payload)))
+        self._copy_in(head + 8, payload)
+        struct.pack_into("<Q", mm, 16, head + rec)  # publish
+        struct.pack_into("<I", mm, 32, (wrn + 1) & 0xFFFFFFFF)
+        # unconditional wake: a python writer cannot take the precise-
+        # parking shortcut safely (no atomics / fences from here)
+        _futex_wake(self._wr_word)
+
+    def read(self, timeout: Optional[float] = 10.0) -> bytes:
+        """Pop one record; ChannelTimeoutError when none arrives in time."""
+        if self._handle is not None:
+            lib = _native_lib()
+            buf = getattr(self, "_read_buf", None)
+            if buf is None:
+                buf = self._read_buf = ctypes.create_string_buffer(self.capacity)
+            tmo = -1 if timeout is None else max(0, int(timeout * 1000))
+            if timeout is not None and timeout > 0 and tmo == 0:
+                tmo = 1
+            # GIL-held attempt first (burst drains issue many empty-ring
+            # probes); block via the GIL-releasing binding only when the
+            # caller asked to wait
+            n = _lib_gil.ring_read(self._handle, buf, self.capacity, 0)
+            if n == -1 and tmo != 0:
+                n = lib.ring_read(self._handle, buf, self.capacity, tmo)
+            if n == -1:
+                raise ChannelTimeoutError(f"ring {self.path} idle for {timeout}s")
+            if n < 0:
+                raise ValueError(f"ring read error {n} on {self.path}")
+            return ctypes.string_at(buf, n)
+        mm = self._mm
+        deadline = None if timeout is None else time.monotonic() + timeout
+        parked = False
+        try:
+            while True:
+                _, cap, head, tail, wrn, rdn, caps, _, _, _ = _RING_HDR.unpack_from(mm, 0)
+                if head != tail:
+                    break
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise ChannelTimeoutError(f"ring {self.path} idle for {timeout}s")
+                if not parked:
+                    struct.pack_into("<I", mm, _WR_PARKED_OFF, 1)
+                    parked = True
+                # bounded backstop slice: the plain-store park above can
+                # race a writer's parked-check (no fences from python),
+                # so never sleep unbounded on the wake
+                slice_s = 0.05 if (caps & CAP_WRITER_WAKES and futex_available()) else 2e-3
+                if remaining is not None:
+                    slice_s = min(slice_s, remaining)
+                _futex_wait(self._wr_word, wrn, slice_s)
+        finally:
+            if parked:
+                struct.pack_into("<I", mm, _WR_PARKED_OFF, 0)
+        (ln,) = struct.unpack("<Q", self._copy_out(tail, 8))
+        payload = self._copy_out(tail + 8, ln)
+        struct.pack_into("<Q", mm, 24, tail + self._rec_size(ln))  # consume
+        struct.pack_into("<I", mm, 36, (rdn + 1) & 0xFFFFFFFF)
+        _futex_wake(self._rd_word)
+        return payload
+
+    def _copy_in(self, pos: int, data: bytes) -> None:
+        mm, cap = self._mm, self.capacity
+        off = pos % cap
+        first = min(cap - off, len(data))
+        mm[_RING_HDR_SIZE + off : _RING_HDR_SIZE + off + first] = data[:first]
+        if first < len(data):
+            mm[_RING_HDR_SIZE : _RING_HDR_SIZE + len(data) - first] = data[first:]
+
+    def _copy_out(self, pos: int, n: int) -> bytes:
+        mm, cap = self._mm, self.capacity
+        off = pos % cap
+        first = min(cap - off, n)
+        out = mm[_RING_HDR_SIZE + off : _RING_HDR_SIZE + off + first]
+        if first < n:
+            out += mm[_RING_HDR_SIZE : _RING_HDR_SIZE + n - first]
+        return out

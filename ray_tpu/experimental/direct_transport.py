@@ -1,0 +1,670 @@
+"""Direct actor transport: actor method calls over shm rings.
+
+The serve hot loop's dispatch floor is the asyncio RPC stack: a
+steady-state actor call costs ~30µs of event-loop hops, socket framing
+and executor round trips per hop (BENCH_r05), while the compiled-DAG
+shm channel already did a full round in 22µs. This module promotes
+that channel into a first-class dispatch substrate for actor calls
+(reference analogue: the compiled-graph promotion of
+python/ray/dag/compiled_dag_node.py — resident loops over mutable
+shared-memory channels instead of per-call task submission), without
+the compiled DAG's lockstep restriction: the rings carry a REQUEST
+STREAM with multiple calls in flight.
+
+Wire protocol (both rings are `channel.RingChannel`s created by the
+caller in /dev/shm; records are 1 kind byte + pickled body):
+
+    caller --(req ring)--> actor   b"C" call   {method, args?, returns}
+                                   b"A" ack    {oids}  (shm handoff pins)
+                                   b"S" stop
+    actor  --(rsp ring)--> caller  b"R" reply  {"o": oids, "e": envs}
+                                   b"X" fatal  utf-8 reason
+
+Negotiation is LAZY, on the first opted-in call: the caller creates
+the ring pair and sends a plain RPC actor call to the intercepted
+`__ray_tpu_direct_connect__` method; the actor worker opens the rings
+(failing — and refusing — when it cannot, e.g. not colocated on this
+host) and starts a resident service thread. While negotiation runs,
+and whenever it is refused or the stream breaks, calls flow over the
+normal RPC path — the transport is an opportunistic fast path, never
+a correctness dependency.
+
+Per-call fallbacks to RPC (the matrix in docs/ARCHITECTURE.md):
+- payload larger than `direct_transport_max_payload_bytes`
+- args carrying ObjectRefs (borrow bookkeeping rides the RPC reply)
+- ring full past the write timeout (slow-consumer backpressure)
+- transport negotiating / refused / broken
+
+Results ride the reply record as ordinary result envelopes: small
+values inline, large values through the node's shm arena with the
+handoff-pin ack returned over the req ring — so a large RESULT costs
+one arena write, never a proxy round trip.
+
+Ordering: direct calls from one caller execute in ring order; ordering
+against concurrent RPC-path calls to the same actor is NOT defined
+(the two streams race) — that is the opt-in contract of
+`.options(direct=True)`, intended for hot methods where every call is
+independent (serve request submits, telemetry pulls, engine polls).
+"""
+from __future__ import annotations
+
+import contextvars
+import logging
+import os
+import pickle
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.experimental.channel import (
+    ChannelTimeoutError,
+    RingChannel,
+    RingFullError,
+)
+
+logger = logging.getLogger("ray_tpu.direct")
+
+DIRECT_CONNECT_METHOD = "__ray_tpu_direct_connect__"
+
+K_CALL = b"C"
+K_ACK = b"A"
+K_STOP = b"S"
+K_REPLY = b"R"
+K_FATAL = b"X"
+
+# states
+NEW, NEGOTIATING, READY, REFUSED, BROKEN = range(5)
+_STATE_NAMES = ["new", "negotiating", "ready", "refused", "broken"]
+
+# server-side reply write bound: a full rsp ring means the caller's
+# reader has stalled (paused driver, livelocked process) — blocking
+# longer just wedges the engine loop / service thread behind _wlock, so
+# past this the stream is declared broken and closed
+_REPLY_TIMEOUT_S = 5.0
+# server-side serve-loop wake period: an idle service thread wakes this
+# often to poll whether its caller is gone (unlinked rings / dead pid)
+_PEER_POLL_S = 60.0
+# caller-side stall break: calls in flight but NO replies for this long
+# means replies were dropped on a wedged stream (the server's fatal may
+# itself have been undeliverable) — break so waiters get
+# ActorUnavailableError instead of hanging forever. Far above any
+# method the direct opt-in contract is meant for (hot, fast calls).
+_STALL_BREAK_S = 120.0
+
+
+def _cfg():
+    from ray_tpu._private.config import RayConfig
+
+    return RayConfig
+
+
+# --------------------------------------------------------------- caller side
+class DirectClient:
+    """Caller-side endpoint for one (this process, actor) pair: a req
+    ring this process writes and a rsp ring a dedicated reader thread
+    drains into the CoreWorker's in-process store (`_deliver_batch` —
+    the same delivery the RPC reply path uses, so `ray_tpu.get` and
+    async waiters work unchanged)."""
+
+    def __init__(self, core, actor_id: str):
+        self._core = core
+        self._actor_id = actor_id
+        self._state = NEW
+        self._lock = threading.Lock()
+        self._req: Optional[RingChannel] = None
+        self._rsp: Optional[RingChannel] = None
+        self._reader: Optional[threading.Thread] = None
+        self._inflight: Dict[bytes, Dict[str, Any]] = {}
+        self._inflight_lock = threading.Lock()
+        self._last_reply = time.monotonic()
+        self._closed = False
+        # connection-setup-time constants: the submit hot path must not
+        # re-read config or allocate per call (see the dispatch-path lint)
+        cfg = _cfg()
+        self._max_payload = cfg.direct_transport_max_payload_bytes
+        self._write_timeout = cfg.direct_transport_write_timeout_s
+        self._liveness_s = cfg.direct_transport_liveness_s
+        self.stats = {
+            "direct_calls": 0,
+            "rpc_fallback_oversize": 0,
+            "rpc_fallback_backpressure": 0,
+            "rpc_fallback_state": 0,
+            "negotiated": False,
+            "state": _STATE_NAMES[NEW],
+        }
+
+    # -- submit ---------------------------------------------------------
+    def try_submit(self, spec: Dict[str, Any]) -> bool:
+        """Send `spec` over the ring; False means the caller must use
+        the RPC path (negotiating / refused / broken / oversize / ring
+        full). Return oids must already be registered pending."""
+        if self._state == READY:
+            payload = K_CALL + pickle.dumps(spec, protocol=5)
+            if len(payload) > self._max_payload:
+                self.stats["rpc_fallback_oversize"] += 1
+                return False
+            key = bytes(spec["returns"][0])
+            with self._inflight_lock:
+                self._inflight[key] = spec
+            try:
+                self._req.write(payload, timeout=self._write_timeout)
+            except RingFullError:
+                with self._inflight_lock:
+                    self._inflight.pop(key, None)
+                self.stats["rpc_fallback_backpressure"] += 1
+                return False
+            except Exception as e:
+                with self._inflight_lock:
+                    self._inflight.pop(key, None)
+                self._break(f"request ring failed: {e}")
+                return False
+            if self._state != READY:
+                # raced _break: its sweep already failed every spec it
+                # saw, but ours may have registered AFTER the sweep with
+                # no reader left to resolve it — if it's still ours, pull
+                # it back and ride RPC; if the sweep took it, the call is
+                # already failed and must not double-submit
+                with self._inflight_lock:
+                    mine = self._inflight.pop(key, None) is not None
+                if mine:
+                    self.stats["rpc_fallback_state"] += 1
+                    return False
+                return True
+            self.stats["direct_calls"] += 1
+            return True
+        if self._state == NEW:
+            self._start_negotiation()
+        self.stats["rpc_fallback_state"] += 1
+        return False
+
+    # -- negotiation ----------------------------------------------------
+    def _start_negotiation(self):
+        with self._lock:
+            if self._state != NEW:
+                return
+            self._state = NEGOTIATING
+            self.stats["state"] = _STATE_NAMES[NEGOTIATING]
+        threading.Thread(
+            target=self._negotiate, daemon=True, name="direct-negotiate"
+        ).start()
+
+    def _negotiate(self):
+        req = rsp = None
+        try:
+            cfg = _cfg()
+            tag = f"dt_{os.getpid()}_{self._actor_id[:8]}_{id(self) & 0xFFFFFF:x}"
+            req = RingChannel.create(f"{tag}_req", cfg.direct_transport_ring_bytes)
+            rsp = RingChannel.create(f"{tag}_rsp", cfg.direct_transport_ring_bytes)
+            # plain RPC call to the intercepted framework method; while
+            # this is in flight the client is NEGOTIATING, so concurrent
+            # submits keep flowing over RPC
+            refs = self._core.submit_actor_task(
+                self._actor_id, DIRECT_CONNECT_METHOD, (req.path, rsp.path), {}
+            )
+            ack = self._core.get_values(refs, timeout=60.0)[0]
+            if isinstance(ack, BaseException):
+                raise ack
+            if not (isinstance(ack, dict) and ack.get("ok")):
+                raise RuntimeError(f"refused: {ack!r}")
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("client closed during negotiation")
+                self._req, self._rsp = req, rsp
+                self._reader = threading.Thread(
+                    target=self._reader_loop, daemon=True, name="direct-reader"
+                )
+                self._reader.start()
+                self._state = READY
+                self.stats["negotiated"] = True
+                self.stats["state"] = _STATE_NAMES[READY]
+        except Exception as e:
+            logger.info(
+                "direct transport to actor %s unavailable, staying on RPC: %s",
+                self._actor_id[:12], e,
+            )
+            for ch in (req, rsp):
+                if ch is not None:
+                    ch.unlink()
+            with self._lock:
+                self._state = REFUSED
+                self.stats["state"] = _STATE_NAMES[REFUSED]
+
+    # -- replies --------------------------------------------------------
+    def _reader_loop(self):
+        while not self._closed:
+            try:
+                msg = self._rsp.read(timeout=1.0)
+            except ChannelTimeoutError:
+                self._check_liveness()
+                continue
+            except Exception as e:
+                self._break(f"reply ring failed: {e}")
+                return
+            # burst drain: everything already in the ring delivers under
+            # ONE store-lock pass (_deliver_batch) — per-record delivery
+            # pays a lock round trip + event wake per result, which is
+            # what caps pipelined call rate
+            batch = [msg]
+            while len(batch) < 64:
+                try:
+                    batch.append(self._rsp.read(timeout=0))
+                except ChannelTimeoutError:
+                    break
+                except Exception:
+                    break  # surfaced by the next blocking read
+            self._last_reply = time.monotonic()
+            oids: List[bytes] = []
+            envs: List[Dict[str, Any]] = []
+            fatal: Optional[str] = None
+            for m in batch:
+                kind = m[:1]
+                if kind == K_REPLY:
+                    r = pickle.loads(m[1:])
+                    oids.extend(bytes(o) for o in r["o"])
+                    envs.extend(r["e"])
+                elif kind == K_FATAL:
+                    fatal = m[1:].decode("utf-8", "replace") or "server fatal"
+            if oids:
+                with self._inflight_lock:
+                    for oid in oids:
+                        self._inflight.pop(oid, None)
+                self._core._deliver_batch(oids, envs)
+                shm = [
+                    o for o, e in zip(oids, envs)
+                    if isinstance(e, dict) and e.get("k") == "s"
+                ]
+                if shm:
+                    # handoff-pin ack rides the req ring (the RPC path
+                    # pushes "pins.ack" over its socket); the producer's
+                    # 60s deadline backstops a full ring
+                    try:
+                        self._req.write(
+                            K_ACK + pickle.dumps({"oids": shm}), timeout=0
+                        )
+                    except Exception:
+                        pass
+            if fatal is not None:
+                self._break(fatal)
+                return
+
+    def _check_liveness(self):
+        """Reply ring idle with calls in flight: poll the GCS for actor
+        death — a SIGKILLed actor cannot send K_FATAL, and without this
+        the in-flight callers would block until their own timeouts."""
+        with self._inflight_lock:
+            waiting = bool(self._inflight)
+        idle = time.monotonic() - self._last_reply
+        if not waiting or idle < self._liveness_s:
+            return
+        try:
+            info = self._core.gcs_request(
+                "actor.get_info", {"actor_id": self._actor_id, "wait_ready": False}
+            )
+        except Exception:
+            return
+        if info.get("state") == "DEAD":
+            self._break(f"actor died: {info.get('death_cause')}")
+        elif idle >= _STALL_BREAK_S:
+            # actor alive but the stream produced nothing for minutes:
+            # replies were dropped on a wedged ring (server-side bounded
+            # write gave up) — fail the waiters rather than hang them
+            self._break(
+                f"no replies for {idle:.0f}s with calls in flight "
+                "(stream wedged)"
+            )
+
+    def _break(self, msg: str):
+        from ray_tpu import exceptions
+
+        with self._lock:
+            if self._state == BROKEN:
+                return
+            self._state = BROKEN
+            self.stats["state"] = _STATE_NAMES[BROKEN]
+        logger.warning(
+            "direct transport to actor %s broke (%s); falling back to RPC",
+            self._actor_id[:12], msg,
+        )
+        with self._inflight_lock:
+            doomed = list(self._inflight.values())
+            self._inflight.clear()
+        for spec in doomed:
+            self._core._fail_call(
+                spec,
+                exceptions.ActorUnavailableError(
+                    f"direct transport broke: {msg}", actor_id=self._actor_id
+                ),
+            )
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._state == READY:
+            try:
+                self._req.write(K_STOP, timeout=0)
+            except Exception:
+                pass
+        # the reader thread may be INSIDE a native ring_read on these
+        # handles — closing would munmap under it (segfault on wake).
+        # Its blocking read is 1s-bounded, so join catches it; if it
+        # somehow stays alive, leak the maps (unlink the paths only).
+        reader = self._reader
+        if reader is not None and reader is not threading.current_thread():
+            reader.join(timeout=3.0)
+        safe = reader is None or not reader.is_alive() \
+            or reader is threading.current_thread()
+        for ch in (self._req, self._rsp):
+            if ch is None:
+                continue
+            if safe:
+                ch.unlink()
+            else:
+                try:
+                    os.unlink(ch.path)
+                except OSError:
+                    pass
+
+
+def transport_stats() -> Dict[str, Dict[str, Any]]:
+    """Per-actor direct-transport counters for this process's core
+    (the serve e2e test asserts the fast path engaged from these)."""
+    from ray_tpu._private.worker import get_global_core
+
+    core = get_global_core()
+    return {aid: dict(c.stats) for aid, c in core._direct_clients.items()}
+
+
+# ---------------------------------------------------------------- actor side
+_server_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_direct_server_ctx", default=None
+)
+
+
+class Deferred:
+    """Deferred direct reply: a method that kicks work to a background
+    engine can complete its caller's call LATER, from any thread, with
+    one ring write — instead of parking an executor thread on an event
+    and paying a full reply round trip at completion (the
+    serve→llm_engine hot path; see `maybe_defer`)."""
+
+    def __init__(self, server: "DirectServer", spec: Dict[str, Any]):
+        self._server = server
+        self._spec = spec
+        self._done = False
+        self._lock = threading.Lock()
+
+    def _claim(self) -> bool:
+        with self._lock:
+            if self._done:
+                return False
+            self._done = True
+            return True
+
+    def complete(self, value: Any) -> None:
+        if not self._claim():
+            return
+        ex = self._server._exec
+        envs = [ex._to_env_sync(oid, value) for oid in self._spec["returns"]]
+        self._server.flush_borrows()
+        self._server.write_reply(self._spec["returns"], envs)
+
+    def fail(self, exc: BaseException) -> None:
+        if not self._claim():
+            return
+        from ray_tpu._private.core_worker import _env_err
+
+        env = _env_err(exc, self._spec.get("method", ""))
+        self._server.write_reply(
+            self._spec["returns"], [env] * len(self._spec["returns"])
+        )
+
+
+def maybe_defer() -> Optional[Deferred]:
+    """Inside a direct-transport call: arm and return a Deferred reply
+    (the method's own return value is then discarded). Returns None on
+    the RPC path — callers must fall back to blocking."""
+    ctx = _server_ctx.get()
+    if ctx is None:
+        return None
+    server, spec, holder = ctx
+    holder["deferred"] = Deferred(server, spec)
+    return holder["deferred"]
+
+
+class DirectServer:
+    """Actor-worker-side endpoint for one connected caller: a resident
+    service thread drains the req ring. Fast methods execute INLINE on
+    the service thread (no pool hop); a method observed slower than
+    `direct_transport_slow_method_ms` on three consecutive calls (the
+    first call of a method never counts — cold imports and jit caches
+    would misclassify every method) is reclassified and offloaded to the
+    actor's executor pool from then on, so one long call cannot
+    head-of-line-block the stream. Serial actors (sync,
+    max_concurrency=1) stay serial via the executor's serial lock.
+
+    Replies COALESCE: inline results accumulate while more requests are
+    already waiting in the ring and flush as one K_REPLY record when the
+    ring drains (or at 64 calls) — under pipelined load this amortizes
+    the reply pickle + ring write + reader wake across the burst, the
+    same trick the RPC path's 128-call batches play."""
+
+    _SLOW_STRIKES = 3
+    _REPLY_BATCH = 64
+
+    def __init__(self, executor, req_path: str, rsp_path: str):
+        self._exec = executor
+        self._core = executor.core
+        self._req = RingChannel.open(req_path)
+        self._rsp = RingChannel.open(rsp_path)
+        # caller pid, parsed from the ring name (ray_tpu_ring_<pid>_*):
+        # the serve loop's bounded read polls this so a caller that died
+        # or unlinked without a deliverable K_STOP can't park the
+        # service thread (plus two pinned ring mmaps) forever
+        m = re.search(r"ray_tpu_ring_(\d+)_", req_path)
+        self._peer_pid = int(m.group(1)) if m else None
+        self._wlock = threading.Lock()  # rsp ring: service + pool + engine threads
+        self._slow: set = set()
+        self._strikes: Dict[str, int] = {}  # consecutive slow observations
+        self._slow_ms = _cfg().direct_transport_slow_method_ms
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._serve_loop, daemon=True, name="direct-serve"
+        )
+        self._thread.start()
+
+    def _serve_loop(self):
+        acc_oids: List[bytes] = []
+        acc_envs: List[Dict[str, Any]] = []
+        while not self._closed:
+            try:
+                # bounded, so a K_STOP that never arrived (dropped on a
+                # full ring, caller SIGKILLed, negotiation timed out
+                # caller-side after accept) degrades to a periodic
+                # peer-liveness poll instead of an eternal park
+                msg = self._req.read(timeout=_PEER_POLL_S)
+            except ChannelTimeoutError:
+                if self._peer_gone():
+                    self.close(unlink=False)
+                    return
+                continue
+            except Exception as e:
+                self._fatal(f"request ring failed: {e}")
+                return
+            # burst: drain whatever is already queued, coalescing inline
+            # replies; flush when the ring runs dry or the batch fills
+            while True:
+                if not self._handle_msg(msg, acc_oids, acc_envs):
+                    self._flush(acc_oids, acc_envs)
+                    return
+                if len(acc_oids) >= self._REPLY_BATCH:
+                    self._flush(acc_oids, acc_envs)
+                try:
+                    msg = self._req.read(timeout=0)
+                except ChannelTimeoutError:
+                    break
+                except Exception as e:
+                    self._flush(acc_oids, acc_envs)
+                    self._fatal(f"request ring failed: {e}")
+                    return
+            self._flush(acc_oids, acc_envs)
+
+    def _handle_msg(self, msg: bytes, acc_oids, acc_envs) -> bool:
+        """Process one record; False stops the serve loop (K_STOP)."""
+        kind = msg[:1]
+        if kind == K_CALL:
+            spec = pickle.loads(msg[1:])
+            if spec.get("method") in self._slow:
+                self._exec.pool.submit(self._run_call, spec, False)
+            else:
+                envs = self._run_call(spec, True)
+                if envs is not None:
+                    acc_oids.extend(spec["returns"])
+                    acc_envs.extend(envs)
+        elif kind == K_ACK:
+            self._core.release_handoff_pins(
+                [bytes(o) for o in pickle.loads(msg[1:])["oids"]]
+            )
+        elif kind == K_STOP:
+            self.close(unlink=False)
+            return False
+        return True
+
+    def _flush(self, acc_oids, acc_envs):
+        if acc_oids:
+            self.write_reply(list(acc_oids), list(acc_envs))
+            acc_oids.clear()
+            acc_envs.clear()
+
+    def _run_call(self, spec: Dict[str, Any], inline: bool):
+        """Execute one call. Inline calls RETURN their envelopes for the
+        serve loop to coalesce (None when the reply is deferred); pool
+        calls write their own reply."""
+        holder: Dict[str, Any] = {"deferred": None}
+        token = _server_ctx.set((self, spec, holder))
+        t0 = time.perf_counter()
+        try:
+            envs = self._exec.exec_direct(spec)
+        finally:
+            _server_ctx.reset(token)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        if inline:
+            method = spec.get("method")
+            if dur_ms > self._slow_ms:
+                # first observation is the cold call — never strikes
+                n = self._strikes.get(method)
+                if n is None:
+                    self._strikes[method] = 0
+                else:
+                    self._strikes[method] = n + 1
+                    if n + 1 >= self._SLOW_STRIKES:
+                        self._slow.add(method)
+            else:
+                self._strikes[method] = 0
+        deferred: Optional[Deferred] = holder["deferred"]
+        if deferred is not None:
+            if any(isinstance(e, dict) and e.get("k") == "e" for e in envs):
+                # the method armed a deferred reply then raised: surface
+                # the error now and disarm (a late complete() is a no-op)
+                if deferred._claim():
+                    self.write_reply(spec["returns"], envs)
+            return None
+        if inline:
+            return envs
+        self.write_reply(spec["returns"], envs)
+        return None
+
+    def flush_borrows(self):
+        if self._core._ref_events or self._core._borrows_to_flush:
+            self._core.flush_borrows_sync()
+
+    def write_reply(self, oids: List[bytes], envs: List[Dict[str, Any]]):
+        payload = K_REPLY + pickle.dumps({"o": oids, "e": envs}, protocol=5)
+        with self._wlock:
+            if self._closed:
+                logger.warning("direct reply after close dropped on %s", self._rsp.path)
+                return
+            try:
+                self._rsp.write(payload, timeout=_REPLY_TIMEOUT_S)
+                return
+            except Exception:
+                pass
+        # full rsp ring past the bound = the caller's reader is wedged.
+        # Blocking longer holds _wlock against the engine loop AND the
+        # service thread's flushes, stalling every request on the actor —
+        # declare the stream dead instead (the caller's stall break
+        # resolves its waiters); future calls fall back to RPC once the
+        # req ring fills
+        logger.warning(
+            "direct reply undeliverable for %.0fs (caller reader stalled?) "
+            "on %s — closing stream", _REPLY_TIMEOUT_S, self._rsp.path,
+        )
+        self._fatal("reply ring wedged")
+
+    def _peer_gone(self) -> bool:
+        """True when the caller can no longer use this stream: it
+        unlinked the ring paths (both close paths do) or its process is
+        dead (SIGKILL — the path then lingers until a /dev/shm sweep)."""
+        if not os.path.exists(self._req.path):
+            return True
+        if self._peer_pid is not None:
+            try:
+                os.kill(self._peer_pid, 0)
+            except ProcessLookupError:
+                return True
+            except OSError:
+                pass
+        return False
+
+    def _fatal(self, msg: str):
+        try:
+            with self._wlock:
+                if not self._closed:
+                    self._rsp.write(K_FATAL + msg.encode("utf-8"), timeout=0)
+        except Exception:
+            pass
+        self.close(unlink=False)
+
+    def close(self, unlink: bool = False):
+        # the rsp ring closes under the write lock so an engine thread
+        # completing a Deferred can never write a freed native handle
+        with self._wlock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._rsp.unlink() if unlink else self._rsp.close()
+            except Exception:
+                pass
+        try:
+            self._exec.direct_servers.remove(self)
+        except ValueError:
+            pass
+        if threading.current_thread() is self._thread:
+            try:
+                self._req.unlink() if unlink else self._req.close()
+            except Exception:
+                pass
+        else:
+            # the service thread may be inside a native read on _req —
+            # closing would munmap under it. Unlink the path and leak the
+            # map; the thread exits on its next wake (sees _closed).
+            try:
+                os.unlink(self._req.path)
+            except OSError:
+                pass
+
+
+def accept_connect(executor, req_path: str, rsp_path: str) -> Dict[str, Any]:
+    """Worker-side handler for the intercepted negotiation call. Opening
+    the caller's /dev/shm rings IS the colocation check: on a different
+    host the paths don't exist and the caller stays on RPC."""
+    if not _cfg().direct_transport_enabled:
+        return {"ok": False, "reason": "disabled on worker"}
+    try:
+        server = DirectServer(executor, req_path, rsp_path)
+    except Exception as e:
+        return {"ok": False, "reason": f"{type(e).__name__}: {e}"}
+    executor.direct_servers.append(server)
+    return {"ok": True, "pid": os.getpid()}

@@ -10,11 +10,18 @@ of the handle polling or waiting for a routing failure).
 """
 from __future__ import annotations
 
+import logging
 import random
 import threading
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+
+logger = logging.getLogger("ray_tpu.serve")
+
+# replica names whose get_actor already warned (module-wide: every
+# handle refresh re-walks the same membership list)
+_warned_replicas: set = set()
 
 
 class DeploymentResponse:
@@ -55,7 +62,8 @@ class DeploymentHandle:
         self.app_name = app_name
         self._replica_names: List[str] = []
         self._replicas: List[Any] = []
-        self._outstanding: Dict[int, int] = {}
+        self._submits: List[Any] = []  # prebound direct-dispatch methods
+        self._outstanding: Dict[str, int] = {}  # replica name -> in flight
         self._version = 0
         self._lock = threading.Lock()
         self._method = "__call__"
@@ -65,16 +73,40 @@ class DeploymentHandle:
 
     # -- replica set management ----------------------------------------
     def _apply_replicas(self, names: List[str], version: int):
-        handles = []
+        handles, ok_names, submits = [], [], []
         for name in names:
             try:
-                handles.append(ray_tpu.get_actor(name))
-            except Exception:
-                pass
+                h = ray_tpu.get_actor(name)
+            except Exception as e:
+                # a replica the controller lists but we cannot resolve is
+                # a routing hole — say so (once per name), don't bury it
+                if name not in _warned_replicas:
+                    _warned_replicas.add(name)
+                    logger.warning(
+                        "serve handle %s/%s: get_actor(%r) failed (%s); "
+                        "routing around it", self.app_name,
+                        self.deployment_name, name, e,
+                    )
+                continue
+            handles.append(h)
+            ok_names.append(name)
+            # prebound shm-ring dispatch: binding .options(direct=True)
+            # once per refresh keeps the per-request path allocation-free
+            # (the fast path negotiates lazily per (caller, replica) and
+            # falls back to RPC whenever the transport refuses)
+            submits.append(h.handle_request.options(direct=True))
         with self._lock:
-            self._replica_names = names
+            old = self._outstanding
+            # parallel lists stay index-aligned even when some names
+            # failed to resolve (names/handles previously diverged)
+            self._replica_names = ok_names
             self._replicas = handles
-            self._outstanding = {i: 0 for i in range(len(handles))}
+            self._submits = submits
+            # carry in-flight counts over for surviving replicas: a
+            # zeroing refresh wiped the signal power-of-two routing
+            # steers by, dogpiling the busiest replica after every
+            # membership change
+            self._outstanding = {n: old.get(n, 0) for n in ok_names}
             self._version = version
 
     def _refresh(self):
@@ -125,6 +157,7 @@ class DeploymentHandle:
         with self._lock:
             h._replica_names = list(self._replica_names)
             h._replicas = list(self._replicas)
+            h._submits = list(self._submits)
             h._outstanding = dict(self._outstanding)
             h._version = self._version
         if h._replicas:
@@ -155,36 +188,47 @@ class DeploymentHandle:
             a, b = ranked[0], ranked[1]
         else:
             a, b = random.sample(range(n), 2)
-        return a if self._outstanding.get(a, 0) <= self._outstanding.get(b, 0) else b
+        na, nb = self._replica_names[a], self._replica_names[b]
+        return a if self._outstanding.get(na, 0) <= self._outstanding.get(nb, 0) else b
+
+    def _reserve(self):
+        """Pick a replica and charge it one in-flight request — pick AND
+        read under one lock (the long-poll thread can swap _replicas for
+        a shorter list at any moment). Returns (name, submit_method)."""
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError(f"no replicas for {self.deployment_name}")
+            idx = self._pick()
+            name = self._replica_names[idx]
+            self._outstanding[name] = self._outstanding.get(name, 0) + 1
+            return name, self._submits[idx]
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         if not self._replicas:
             self._refresh()
-        if not self._replicas:
-            raise RuntimeError(f"no replicas for {self.deployment_name}")
-        with self._lock:
-            # pick AND read under one lock: the long-poll thread can swap
-            # _replicas for a shorter list at any moment
-            idx = self._pick()
-            self._outstanding[idx] = self._outstanding.get(idx, 0) + 1
-            replica = self._replicas[idx]
+        picked: Dict[str, str] = {}
 
         def done():
+            name = picked.get("name")
             with self._lock:
-                self._outstanding[idx] = max(0, self._outstanding.get(idx, 1) - 1)
+                # counts are name-keyed so a membership refresh neither
+                # wipes them nor mis-charges a replica that took over
+                # this index
+                if name in self._outstanding:
+                    self._outstanding[name] = max(0, self._outstanding[name] - 1)
 
         if self._model_id:
             kwargs = {**kwargs, "_serve_multiplexed_model_id": self._model_id}
+        picked["name"], submit = self._reserve()
         try:
-            ref = replica.handle_request.remote(self._method, args, kwargs)
+            # the prebound method rides the shm-ring direct transport
+            # when negotiated, the RPC path otherwise — same call shape
+            ref = submit.remote(self._method, args, kwargs)
         except Exception:
             done()
             self._refresh()
-            with self._lock:
-                if not self._replicas:
-                    raise RuntimeError(f"no replicas for {self.deployment_name}")
-                replica = self._replicas[self._pick()]
-            ref = replica.handle_request.remote(self._method, args, kwargs)
+            picked["name"], submit = self._reserve()
+            ref = submit.remote(self._method, args, kwargs)
         return DeploymentResponse(ref, on_done=done)
 
     def close(self):

@@ -78,6 +78,28 @@ class _LLMServer:
 
     def __call__(self, prompt: List[int]) -> List[int]:
         if self.engine is not None:
+            from ray_tpu.experimental.direct_transport import maybe_defer
+
+            deferred = maybe_defer()
+            if deferred is not None:
+                # direct-transport fast path: submit() enqueues onto the
+                # engine loop and the completion notification rides the
+                # reply ring FROM the engine loop thread — no replica
+                # thread parks on the done event and the completion costs
+                # one ring write instead of an object-store round trip
+                def _complete(req):
+                    if req.error is None:
+                        deferred.complete(req.tokens)
+                    else:
+                        deferred.fail(RuntimeError(f"generation failed: {req.error}"))
+
+                # a submit() raise (dead engine, bad request) propagates:
+                # the transport surfaces it and disarms the deferred
+                self.engine.submit(
+                    [int(t) for t in prompt], self.max_new_tokens,
+                    on_done=_complete,
+                )
+                return None
             return self.engine.generate(
                 [int(t) for t in prompt], self.max_new_tokens
             )

@@ -180,14 +180,20 @@ class _LatencyHist:
 
 class _Request:
     __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
-                 "_first_dev", "_remaining", "_t_submit", "_t_first",
-                 "_t_done", "_trace_ctx")
+                 "on_done", "_first_dev", "_remaining", "_t_submit",
+                 "_t_first", "_t_done", "_trace_ctx")
 
-    def __init__(self, prompt, max_new_tokens):
+    def __init__(self, prompt, max_new_tokens, on_done=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.tokens: List[int] = []
         self.done = threading.Event()
+        # completion callback, fired (once) from the engine loop thread
+        # right after done.set() — the serve direct-transport path
+        # completes the caller's deferred reply here with one ring
+        # write, instead of parking a replica thread per request on the
+        # event (see _LLMServer.__call__)
+        self.on_done = on_done
         self.error: Optional[str] = None
         self._first_dev = None   # device scalar: prefill's first token (legacy path)
         self._remaining = 0      # host-side plan counter (decode steps owed)
@@ -200,6 +206,19 @@ class _Request:
         # serve request is followable proxy span → replica task → the
         # exact macro-steps that decoded it
         self._trace_ctx: Optional[Dict[str, str]] = None
+
+
+def _finish(req: "_Request") -> None:
+    """Complete a request: set the event, then fire on_done exactly once
+    (callback failures are logged, never poison the engine loop)."""
+    req.done.set()
+    cb = req.on_done
+    if cb is not None:
+        req.on_done = None
+        try:
+            cb(req)
+        except Exception:
+            logger.exception("llm request on_done callback failed")
 
 
 class ContinuousBatchingEngine:
@@ -260,7 +279,8 @@ class ContinuousBatchingEngine:
         self._thread.start()
 
     # ------------------------------------------------------------- public
-    def submit(self, prompt: List[int], max_new_tokens: int) -> _Request:
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               on_done=None) -> _Request:
         if self._dead is not None:
             raise RuntimeError(f"engine is dead: {self._dead}")
         if len(prompt) == 0:
@@ -274,7 +294,7 @@ class ContinuousBatchingEngine:
                 f"prompt+generation ({len(prompt)}+{max_new_tokens}) exceeds "
                 f"engine max_len {self.max_len}"
             )
-        req = _Request([int(t) for t in prompt], max_new_tokens)
+        req = _Request([int(t) for t in prompt], max_new_tokens, on_done=on_done)
         try:
             from ray_tpu.util import tracing
 
@@ -287,7 +307,7 @@ class ContinuousBatchingEngine:
             # drain the queue, so fail the request here instead of letting
             # the caller eat a generic timeout
             req.error = f"engine is dead: {self._dead}"
-            req.done.set()
+            _finish(req)
             raise RuntimeError(req.error)
         self._wake.set()
         return req
@@ -610,7 +630,7 @@ class ContinuousBatchingEngine:
                 self._tpot.observe(
                     (req._t_done - req._t_first) / (len(req.tokens) - 1)
                 )
-            req.done.set()
+            _finish(req)
 
     def _resolve(self, entry) -> None:
         """Fetch one macro-step's (or legacy chunk's) tokens — the only
@@ -671,7 +691,7 @@ class ContinuousBatchingEngine:
                 break
         for req in doomed:
             req.error = msg
-            req.done.set()
+            _finish(req)
 
     def _loop(self) -> None:
         try:

@@ -37,10 +37,16 @@ class ProxyActor:
         self._poller.start()
 
     def _routes_poll_loop(self):
+        import logging
+        import random as _rnd
         import time as _t
 
         from ray_tpu.serve.api import _get_controller
 
+        log = logging.getLogger("ray_tpu.serve.proxy")
+        backoff = 1.0
+        last_warn = 0.0
+        failures = 0
         while True:
             try:
                 controller = _get_controller()
@@ -51,8 +57,23 @@ class ProxyActor:
                 if "routes" in changed:
                     self.routes = dict(changed["routes"]["data"])
                     self._routes_version = changed["routes"]["version"]
-            except Exception:
-                _t.sleep(1.0)
+                backoff = 1.0
+                failures = 0
+            except Exception as e:
+                # exponential backoff with jitter + a rate-limited warning:
+                # a dead controller must be VISIBLE, not a silent 1s-period
+                # hot-ish loop hammering the GCS forever
+                failures += 1
+                now = _t.monotonic()
+                if now - last_warn >= 30.0:
+                    last_warn = now
+                    log.warning(
+                        "proxy route long-poll failing (%d consecutive; "
+                        "controller down?): %s — backing off %.1fs",
+                        failures, e, backoff,
+                    )
+                _t.sleep(backoff * (0.5 + _rnd.random()))
+                backoff = min(backoff * 2.0, 30.0)
 
     async def _start(self):
         from aiohttp import web

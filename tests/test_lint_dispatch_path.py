@@ -1,0 +1,113 @@
+"""Repo lint: the serve steady-state dispatch path allocates at
+connection setup only.
+
+Guards the fast path's reason to exist: a ~30µs dispatch floor creeps
+back one "small" per-call cost at a time. The rules, enforced on the
+functions every steady-state serve request executes:
+
+- no per-call channel/mmap allocation (`RingChannel.create/open`,
+  `Channel.create/open`, `mmap.mmap`) — rings are negotiated ONCE per
+  (caller, actor) pair;
+- no per-call config reads (`RayConfig.`/`_cfg()`) in the submit hot
+  path — limits are snapshotted at client construction;
+- no per-call `pickle.dumps` of constant-shape headers: the one pickle
+  per call covers the whole spec; record kinds are single preallocated
+  bytes (K_CALL + body), never pickled framing dicts;
+- the serve handle's `remote()` builds no per-call ActorMethod — the
+  direct-bound submit methods are prebound at membership refresh.
+
+Pure source lint — no cluster.
+"""
+import inspect
+import re
+
+from ray_tpu.experimental import direct_transport as dt
+from ray_tpu.serve.handle import DeploymentHandle
+
+# the functions a steady-state serve request runs, end to end:
+# handle.remote → DirectClient.try_submit → (ring) → DirectServer serve
+# loop → exec → reply write → DirectClient reader → delivery
+HOT_FUNCS = {
+    "DeploymentHandle.remote": DeploymentHandle.remote,
+    "DeploymentHandle._reserve": DeploymentHandle._reserve,
+    "DeploymentHandle._pick": DeploymentHandle._pick,
+    "DirectClient.try_submit": dt.DirectClient.try_submit,
+    "DirectClient._reader_loop": dt.DirectClient._reader_loop,
+    "DirectServer._serve_loop": dt.DirectServer._serve_loop,
+    "DirectServer._handle_msg": dt.DirectServer._handle_msg,
+    "DirectServer._run_call": dt.DirectServer._run_call,
+    "DirectServer._flush": dt.DirectServer._flush,
+    "DirectServer.write_reply": dt.DirectServer.write_reply,
+}
+
+_ALLOC = re.compile(r"RingChannel\.(create|open)|Channel\.(create|open)|mmap\.mmap|\.create_string_buffer\(")
+_CONFIG = re.compile(r"RayConfig\.|_cfg\(\)")
+
+
+def _sources():
+    return {name: inspect.getsource(fn) for name, fn in HOT_FUNCS.items()}
+
+
+def test_no_per_call_channel_or_mmap_allocation():
+    for name, src in _sources().items():
+        assert not _ALLOC.search(src), (
+            f"{name} allocates a channel/mmap/buffer per call — the fast "
+            f"path must allocate at connection setup only (negotiation / "
+            f"client construction)"
+        )
+
+
+def test_no_per_call_config_reads_in_submit_path():
+    for name in ("DirectClient.try_submit", "DeploymentHandle.remote",
+                 "DeploymentHandle._reserve", "DirectServer._serve_loop",
+                 "DirectServer._handle_msg"):
+        src = inspect.getsource(HOT_FUNCS[name].__wrapped__ if hasattr(
+            HOT_FUNCS[name], "__wrapped__") else HOT_FUNCS[name])
+        assert not _CONFIG.search(src), (
+            f"{name} re-reads config per call — snapshot limits at "
+            f"connection setup (DirectClient.__init__)"
+        )
+
+
+def test_single_pickle_per_call_no_constant_header_pickles():
+    """One pickle.dumps per submitted call (the spec) and one per reply
+    flush — constant-shape framing must be preallocated byte kinds."""
+    src = inspect.getsource(dt.DirectClient.try_submit)
+    assert src.count("pickle.dumps") == 1, (
+        "try_submit must pickle exactly once (the spec); constant-shape "
+        "headers ride the preallocated kind byte"
+    )
+    assert "K_CALL +" in src, "record framing must be the preallocated kind byte"
+    # the reply path: one pickle per coalesced flush, none per record kind
+    src = inspect.getsource(dt.DirectServer.write_reply)
+    assert src.count("pickle.dumps") == 1
+    for name in ("DirectServer._serve_loop", "DirectServer._flush"):
+        assert "pickle.dumps" not in inspect.getsource(HOT_FUNCS[name])
+
+
+def test_handle_prebinds_direct_methods():
+    """remote() must use the methods prebound at membership refresh, not
+    rebuild .options(...) bindings per request."""
+    src = inspect.getsource(DeploymentHandle.remote) + inspect.getsource(
+        DeploymentHandle._reserve
+    )
+    assert ".options(" not in src, (
+        "DeploymentHandle.remote rebuilds an ActorMethod per call — "
+        "prebind in _apply_replicas"
+    )
+    apply_src = inspect.getsource(DeploymentHandle._apply_replicas)
+    assert "direct=True" in apply_src, (
+        "_apply_replicas no longer prebinds the direct-dispatch methods"
+    )
+
+
+def test_ring_write_hot_path_is_nonblocking_first():
+    """The native write path must try the GIL-held non-blocking binding
+    before the GIL-releasing blocking one (re-acquiring the GIL after a
+    released call stalls the submit thread behind reply processing)."""
+    from ray_tpu.experimental.channel import RingChannel
+
+    src = inspect.getsource(RingChannel.write)
+    assert "_lib_gil.ring_write" in src, (
+        "RingChannel.write lost the GIL-held fast path"
+    )
