@@ -442,9 +442,448 @@ def macro_step_slots(params, cache, feed, steps, has_admit, prompts, lengths,
     return toks, firsts, feed, cache
 
 
+# ---------------------------------------------------------------------------
+# Paged KV decode: block-table attention + real sampling (serve/_internal).
+# The dense per-slot cache above welds KV memory to slots x max_len; here
+# the device cache is a global pool of fixed-size blocks,
+# (L, n_blocks, block_size, kvh, hd), and each slot's sequence lives in
+# the blocks its BLOCK TABLE names — PagedAttention (Kwon et al., SOSP
+# '23) restated for static shapes: tables are host-planned i32 arrays
+# that ride every dispatch as program arguments exactly like prompt
+# tokens do, so slot count decouples from sequence length with zero
+# recompiles. Block 0 is the NULL block: inactive lanes and plan-padding
+# rows aim their writes at it, which is what makes speculative macro
+# plans safe when blocks are freed and reused mid-plan (a stopped slot
+# cannot corrupt its block's next owner). Sampling (temperature/top-k/
+# top-p via jax.random.categorical) and stop-token detection run INSIDE
+# the decode scan with per-slot rng threaded through the cache, so
+# scheduling stays host-plannable: the host plans speculatively and
+# repairs when resolved tokens reveal early stops (serve/llm_engine.py).
+# ---------------------------------------------------------------------------
+
+
+def init_paged_cache(cfg: LlamaConfig, n_slots: int, n_blocks: int,
+                     block_size: int) -> Dict[str, Any]:
+    """Paged decode state: the block pool plus per-slot scalars. Block
+    tables are NOT device state — the host allocator owns them."""
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((n_slots,), jnp.int32),
+        "remaining": jnp.zeros((n_slots,), jnp.int32),
+        # per-slot raw PRNG keys (threefry), reseeded at admission from
+        # the request seed and split once per decode step — a request's
+        # sample stream depends only on its seed and token index, never
+        # on what else is co-scheduled
+        "rng": jnp.zeros((n_slots, 2), jnp.uint32),
+    }
+
+
+def copy_kv_blocks(cache: Dict[str, Any], src, dst) -> Dict[str, Any]:
+    """Copy-on-write block copies: rows dst[i] <- src[i] across every
+    layer, K and V. src/dst are (N,) i32 block ids (host-planned by
+    BlockAllocator.ensure_writable)."""
+    out = dict(cache)
+    out["k"] = cache["k"].at[:, dst].set(cache["k"][:, src])
+    out["v"] = cache["v"].at[:, dst].set(cache["v"][:, src])
+    return out
+
+
+def _split_slot_keys(keys):
+    """(B, 2) u32 raw keys -> (carried (B, 2), subkeys (B, 2))."""
+    pairs = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
+    return pairs[:, 0], pairs[:, 1]
+
+
+def _topk_topp_mask(scaled, top_ks, top_ps):
+    """Mask `scaled` logits (B, V) to the per-row top-k / nucleus
+    (top-p) support: entries outside it go to -inf. top_k == 0 and
+    top_p == 1.0 disable their filters; ties at the cutoff are kept."""
+    V = scaled.shape[-1]
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_eff = jnp.where(top_ks > 0, jnp.minimum(top_ks, V), V)
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    keep = cum_before < top_ps[:, None]  # the argmax column is always kept
+    pth = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True)
+    cutoff = jnp.maximum(kth, pth)
+    return jnp.where(scaled >= cutoff, scaled, -jnp.inf)
+
+
+def sample_tokens(logits, temps, top_ks, top_ps, keys):
+    """Per-slot sampling: logits (B, V) f32, temps/top_ps (B,) f32,
+    top_ks (B,) i32, keys (B, 2) u32 raw PRNG keys -> (B,) i32.
+    temperature == 0 lanes take the argmax (bit-identical to the greedy
+    path); sampled lanes draw jax.random.categorical over the
+    temperature-scaled, top-k/top-p-masked logits with their OWN key."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temps > 0.0, temps, 1.0)
+    masked = _topk_topp_mask(logits / safe_t[:, None], top_ks, top_ps)
+    sampled = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+def _gather_block_ctx(k_layer, v_layer, tables):
+    """Materialize each slot's context from the pool: k_layer
+    (n_blocks, bs, kvh, hd), tables (B, MB) -> (B, MB*bs, kvh, hd).
+    The transient per-layer gather workspace — the pool itself never
+    exists in (n_slots, max_len) form."""
+    B, MB = tables.shape
+    bs = k_layer.shape[1]
+    ctx_k = k_layer[tables].reshape(B, MB * bs, *k_layer.shape[2:])
+    ctx_v = v_layer[tables].reshape(B, MB * bs, *v_layer.shape[2:])
+    return ctx_k, ctx_v
+
+
+def decode_step_slots_paged(params, cache, tokens, tables, temps, top_ks,
+                            top_ps, stop_ids, cfg: LlamaConfig,
+                            sampled: bool = True):
+    """One token on every slot against the PAGED cache. tables (B, MB)
+    i32 name each slot's blocks (0-padded -> null block); temps/top_ks/
+    top_ps are the per-slot sampling plan; stop_ids (B, NS) i32 are
+    -1-padded stop sets. Inactive lanes (remaining == 0) aim their KV
+    write at the null block — their old blocks may already belong to a
+    later-phase admission of the same macro plan. Returns
+    (logits, next_tokens, cache); a sampled stop token zeroes the
+    slot's `remaining` device-side (the host observes it one macro-step
+    later and repairs its speculative plan).
+
+    sampled=False is the STATIC greedy variant (host plans know whether
+    any resident request samples): next tokens come from one argmax —
+    no vocab sort/softmax/cumsum, no rng splits — so an all-greedy
+    workload pays exactly the pre-sampling per-step cost. Stop-token
+    detection stays (greedy requests may carry stop ids)."""
+    B = tokens.shape[0]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    bs = cache["k"].shape[2]
+    S = tables.shape[1] * bs
+    pos = cache["pos"]
+    active = cache["remaining"] > 0
+    x = params["embed"][tokens][:, None, :].astype(cfg.dtype)
+    cos, sin = rope_frequencies(hd, S, cfg.rope_theta)
+    positions = pos[:, None]
+
+    def body(carry, layer_and_idx):
+        x, k_full, v_full = carry
+        layer, li = layer_and_idx
+        a = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = (a @ layer["wq"]).reshape(B, 1, h, hd)
+        k = (a @ layer["wk"]).reshape(B, 1, kvh, hd)
+        v = (a @ layer["wv"]).reshape(B, 1, kvh, hd)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        # per-slot write into the slot's CURRENT block at its own
+        # offset (same sequential-DMA trick as the dense path: the
+        # advanced-index scatter form measured ~25 ms/step on TPU)
+        def write_slot(b, kv):
+            kf, vf = kv
+            kb = jax.lax.dynamic_slice_in_dim(k, b, 1, axis=0)[None]
+            vb = jax.lax.dynamic_slice_in_dim(v, b, 1, axis=0)[None]
+            pb = jax.lax.dynamic_index_in_dim(pos, b, keepdims=False)
+            ab = jax.lax.dynamic_index_in_dim(active, b, keepdims=False)
+            row = jax.lax.dynamic_index_in_dim(tables, b, 0, keepdims=False)
+            blk = jax.lax.dynamic_index_in_dim(row, pb // bs, keepdims=False)
+            blk = jnp.where(ab, blk, 0)  # inactive lanes write the null block
+            off = jnp.where(ab, pb % bs, 0)
+            kf = jax.lax.dynamic_update_slice(kf, kb, (li, blk, off, 0, 0))
+            vf = jax.lax.dynamic_update_slice(vf, vb, (li, blk, off, 0, 0))
+            return kf, vf
+
+        k_full, v_full = jax.lax.fori_loop(0, B, write_slot, (k_full, v_full))
+        k_layer = jax.lax.dynamic_index_in_dim(k_full, li, 0, keepdims=False)
+        v_layer = jax.lax.dynamic_index_in_dim(v_full, li, 0, keepdims=False)
+        ctx_k, ctx_v = _gather_block_ctx(k_layer, v_layer, tables)
+        o = _gqa_attend_slots(q, ctx_k, ctx_v, pos, cfg) @ layer["wo"]
+        x = x + o
+        m = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu((m @ layer["w_gate"]).astype(jnp.float32)).astype(cfg.dtype)
+        x = x + (gate * (m @ layer["w_up"])) @ layer["w_down"]
+        return (x, k_full, v_full), None
+
+    (x, new_k, new_v), _ = jax.lax.scan(
+        body,
+        (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)),
+        unroll=True,
+    )
+    x = rms_norm(x[:, 0, :], params["final_norm"], cfg.rms_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    if sampled:
+        new_rng, sub = _split_slot_keys(cache["rng"])
+        nxt = sample_tokens(logits, temps, top_ks, top_ps, sub)
+    else:
+        new_rng = cache["rng"]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    stopped = jnp.any(nxt[:, None] == stop_ids, axis=-1) & active
+    new_cache = {
+        "k": new_k,
+        "v": new_v,
+        "pos": pos + active.astype(jnp.int32),
+        "remaining": jnp.where(
+            stopped, 0, jnp.maximum(cache["remaining"] - 1, 0)
+        ),
+        "rng": new_rng,
+    }
+    return logits, nxt, new_cache
+
+
+def _gqa_attend_paged_prefill(q, k_ctx, v_ctx, positions, cfg: LlamaConfig):
+    """Suffix-prefill attention against gathered paged context: q
+    (A, P, h, hd) at absolute `positions` (A, P); k_ctx/v_ctx
+    (A, S, kvh, hd) hold the full context INCLUDING the suffix's own
+    just-written K/V, so the causal mask s <= positions[a, t] covers
+    both the reused prefix and intra-suffix causality in one score."""
+    A, P, h, hd = q.shape
+    S = k_ctx.shape[1]
+    qg = q.reshape(A, P, cfg.n_kv_heads, h // cfg.n_kv_heads, hd)
+    scores = jnp.einsum(
+        "apkgd,askd->akgps", qg, k_ctx, preferred_element_type=jnp.float32
+    ) * (hd**-0.5)
+    mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]  # (A, P, S)
+    scores = jnp.where(mask[:, None, None, :, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "akgps,askd->apkgd", probs.astype(v_ctx.dtype), v_ctx,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(A, P, h * hd).astype(cfg.dtype)
+
+
+def admit_slots_paged(params, prompts, lengths, starts, slots, rems, seeds,
+                      cache, feed, tables, temps, top_ks, top_ps, stop_ids,
+                      cfg: LlamaConfig, sampled: bool = True):
+    """Fused PAGED admission: prefill A right-padded SUFFIXES (A, P) —
+    `prompts` holds only the tokens after each row's cached prefix of
+    `starts[n]` tokens (block-aligned; 0 for a cache miss) — and land
+    rows with length > 0 in their target `slots`. The radix-prefix-hit
+    prefill skip happens exactly here: reused blocks are never
+    recomputed, the suffix attends to them read-only through the slot's
+    block table. P must be a multiple of block_size.
+
+    Per layer the body writes EVERY row's suffix K/V before ANY row
+    gathers context, so two same-phase admissions sharing a prefix (the
+    second's table naming blocks the first is filling right now) stay
+    correct: plan order == write order <= read order. Right-pad columns
+    write into the slot's own reserved (beyond-pos) cells or, past the
+    table's edge, the null block. Each row's first output token is
+    SAMPLED from its true-last-position logits with a key seeded from
+    `seeds[n]`; the carried key lands in the slot's rng state.
+    Returns (first tokens (A,), cache, feed)."""
+    A, P = prompts.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    bs = cache["k"].shape[2]
+    MB = tables.shape[1]
+    S = MB * bs
+    n_chunks = P // bs
+    adm_tables = tables[slots]  # (A, MB)
+    valid = lengths > 0
+    x = params["embed"][prompts].astype(cfg.dtype)
+    cos, sin = rope_frequencies(hd, S, cfg.rope_theta)
+    positions = starts[:, None] + jnp.broadcast_to(
+        jnp.arange(P, dtype=jnp.int32)[None, :], (A, P)
+    )
+
+    def body(carry, layer_and_idx):
+        x, k_full, v_full = carry
+        layer, li = layer_and_idx
+        a = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = (a @ layer["wq"]).reshape(A, P, h, hd)
+        k = (a @ layer["wk"]).reshape(A, P, kvh, hd)
+        v = (a @ layer["wv"]).reshape(A, P, kvh, hd)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        # phase 1: write all rows' suffix K/V block by block
+        def write_row(n, kv):
+            def wr(kv):
+                kf, vf = kv
+                s0 = jax.lax.dynamic_index_in_dim(starts, n, keepdims=False) // bs
+                row = jax.lax.dynamic_index_in_dim(adm_tables, n, 0, keepdims=False)
+                for j in range(n_chunks):  # static: P // bs chunks
+                    idx = s0 + j
+                    blk = jax.lax.dynamic_index_in_dim(
+                        row, jnp.minimum(idx, MB - 1), keepdims=False
+                    )
+                    blk = jnp.where(idx < MB, blk, 0)  # pad overshoot -> null
+                    kc = jax.lax.dynamic_slice(
+                        k, (n, j * bs, 0, 0), (1, bs, kvh, hd))[0][None, None]
+                    vc = jax.lax.dynamic_slice(
+                        v, (n, j * bs, 0, 0), (1, bs, kvh, hd))[0][None, None]
+                    kf = jax.lax.dynamic_update_slice(kf, kc, (li, blk, 0, 0, 0))
+                    vf = jax.lax.dynamic_update_slice(vf, vc, (li, blk, 0, 0, 0))
+                return kf, vf
+
+            return jax.lax.cond(valid[n], wr, lambda kv: kv, kv)
+
+        k_full, v_full = jax.lax.fori_loop(0, A, write_row, (k_full, v_full))
+        # phase 2: every row gathers context (sees all phase-1 writes)
+        k_layer = jax.lax.dynamic_index_in_dim(k_full, li, 0, keepdims=False)
+        v_layer = jax.lax.dynamic_index_in_dim(v_full, li, 0, keepdims=False)
+        ctx_k, ctx_v = _gather_block_ctx(k_layer, v_layer, adm_tables)
+        o = _gqa_attend_paged_prefill(q, ctx_k, ctx_v, positions, cfg)
+        x = x + o @ layer["wo"]
+        m = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu((m @ layer["w_gate"]).astype(jnp.float32)).astype(cfg.dtype)
+        x = x + (gate * (m @ layer["w_up"])) @ layer["w_down"]
+        return (x, k_full, v_full), None
+
+    (x, k_big, v_big), _ = jax.lax.scan(
+        body,
+        (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)),
+        unroll=True,
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits_all = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    last = jnp.take_along_axis(
+        logits_all, (jnp.maximum(lengths, 1) - 1)[:, None, None], axis=1
+    )[:, 0, :]
+    if sampled:
+        row_keys = jax.vmap(jax.random.PRNGKey)(seeds)
+        carried, sub = _split_slot_keys(row_keys)
+        first = sample_tokens(
+            last, temps[slots], top_ks[slots], top_ps[slots], sub
+        )
+    else:
+        carried = None  # greedy plans never consume slot keys
+        first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    first_stopped = jnp.any(first[:, None] == stop_ids[slots], axis=-1)
+
+    def write_one(n, state):
+        def wr(st):
+            pos, rem, fd, rng = st
+            s = jax.lax.dynamic_index_in_dim(slots, n, keepdims=False)
+            pos = pos.at[s].set(starts[n] + lengths[n])
+            rem = rem.at[s].set(jnp.where(first_stopped[n], 0, rems[n]))
+            fd = fd.at[s].set(first[n])
+            if sampled:
+                rng = rng.at[s].set(carried[n])
+            return (pos, rem, fd, rng)
+
+        return jax.lax.cond(valid[n], wr, lambda st: st, state)
+
+    pos, rem, feed, rng = jax.lax.fori_loop(
+        0, A, write_one,
+        (cache["pos"], cache["remaining"], feed, cache["rng"]),
+    )
+    cache = {"k": k_big, "v": v_big, "pos": pos, "remaining": rem, "rng": rng}
+    return first, cache, feed
+
+
+def macro_step_slots_paged(params, cache, feed, steps, has_admit, prompts,
+                           lengths, starts, slots, rems, seeds, tables, temps,
+                           top_ks, top_ps, stop_ids, chunk: int,
+                           cfg: LlamaConfig, sampled: bool = True):
+    """Paged macro-step: the macro_step_slots plan shape extended with
+    the paged/sampling plan arrays, still ONE jitted dispatch. Extra
+    per-phase arrays (K phases, B slots, A admission lanes, MB table
+    width, NS stop width):
+      starts   (K, A)        cached-prefix length per admission row
+                             (block-aligned; its blocks are reused, not
+                             re-prefilled)
+      seeds    (K, A) u32    per-request sampling seeds
+      tables   (K, B, MB)    per-phase block tables — admissions and
+                             plan-time evictions swap tables at exactly
+                             the phase boundary they were planned for
+      temps    (K, B) f32    0.0 => greedy argmax for that slot
+      top_ks   (K, B) i32    0 => disabled
+      top_ps   (K, B) f32    1.0 => disabled
+      stop_ids (K, B, NS)    -1-padded device-side stop sets
+
+    The plan is SPECULATIVE under sampling: a slot that samples a stop
+    token goes inactive device-side (writes aim at the null block, pos
+    freezes) while later planned phases still burn its lane — the host
+    bills those steps as speculative waste and repairs its plan when
+    the tokens resolve. `sampled` is STATIC (two compiled variants):
+    the host knows at plan time whether any resident request samples,
+    and an all-greedy plan must not pay the per-step sort/softmax/rng
+    pipeline. Returns (toks (K, chunk, B), firsts (K, A), feed,
+    cache)."""
+    A = prompts.shape[1]
+
+    def phase(carry, xs):
+        cache, feed = carry
+        (steps_k, admit_k, prompts_k, lengths_k, starts_k, slots_k, rems_k,
+         seeds_k, tables_k, temps_k, topk_k, topp_k, stop_k) = xs
+
+        def do_admit(op):
+            c, fd = op
+            return admit_slots_paged(
+                params, prompts_k, lengths_k, starts_k, slots_k, rems_k,
+                seeds_k, c, fd, tables_k, temps_k, topk_k, topp_k, stop_k,
+                cfg, sampled=sampled,
+            )
+
+        def no_admit(op):
+            c, fd = op
+            return jnp.zeros((A,), jnp.int32), c, fd
+
+        first, cache, feed = jax.lax.cond(admit_k, do_admit, no_admit, (cache, feed))
+
+        def step(c, t):
+            def run(op):
+                cc, fd = op
+                _, nxt, cc = decode_step_slots_paged(
+                    params, cc, fd, tables_k, temps_k, topk_k, topp_k,
+                    stop_k, cfg, sampled=sampled,
+                )
+                return cc, nxt
+
+            cc, fd = jax.lax.cond(t < steps_k, run, lambda op: op, c)
+            return (cc, fd), fd
+
+        (cache, feed), toks = jax.lax.scan(step, (cache, feed), jnp.arange(chunk))
+        return (cache, feed), (toks, first)
+
+    (cache, feed), (toks, firsts) = jax.lax.scan(
+        phase, (cache, feed),
+        (steps, has_admit, prompts, lengths, starts, slots, rems, seeds,
+         tables, temps, top_ks, top_ps, stop_ids),
+    )
+    return toks, firsts, feed, cache
+
+
 @functools.lru_cache(maxsize=64)
 def _jitted_prefill(cfg: LlamaConfig):
     return jax.jit(functools.partial(prefill, cfg=cfg))
+
+
+# engine-side jitted programs, memoized per (cfg, chunk) so every
+# ContinuousBatchingEngine with the same geometry shares ONE jit wrapper
+# (and therefore one compile cache) — a replica restart or an A/B pair
+# of engines used to recompile the whole macro program from scratch
+@functools.lru_cache(maxsize=16)
+def jitted_prefill_into_slots(cfg: LlamaConfig):
+    return jax.jit(functools.partial(prefill_into_slots, cfg=cfg))
+
+
+@functools.lru_cache(maxsize=16)
+def jitted_decode_chunk_slots(cfg: LlamaConfig, chunk: int):
+    return jax.jit(
+        functools.partial(decode_chunk_slots, chunk=chunk, cfg=cfg),
+        donate_argnums=(1,),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def jitted_macro_step_slots(cfg: LlamaConfig, chunk: int):
+    return jax.jit(
+        functools.partial(macro_step_slots, chunk=chunk, cfg=cfg),
+        donate_argnums=(1,),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def jitted_macro_step_slots_paged(cfg: LlamaConfig, chunk: int,
+                                  sampled: bool = True):
+    return jax.jit(
+        functools.partial(macro_step_slots_paged, chunk=chunk, cfg=cfg,
+                          sampled=sampled),
+        donate_argnums=(1,),
+    )
 
 
 @functools.lru_cache(maxsize=64)
@@ -459,12 +898,54 @@ def _jitted_decode_step(cfg: LlamaConfig):
     return jax.jit(functools.partial(decode_step, cfg=cfg), donate_argnums=(1,))
 
 
+def sample_loop(params, cache, logits, rng, temperature, top_k, top_p,
+                n_steps: int, cfg: LlamaConfig):
+    """Sampled decode of `n_steps` tokens as ONE device-side lax.scan —
+    the sampled twin of decode_loop (the old sampled path fell out of
+    the fused scan into a per-token host loop: one relay dispatch per
+    token). Carries (cache, logits, rng); each step splits the key,
+    draws categorical over temperature-scaled top-k/top-p-masked
+    logits, then advances the cache. temperature/top_k/top_p ride as
+    traced scalars so one compile serves every setting. Returns
+    (tokens (B, n_steps), cache)."""
+    B = logits.shape[0]
+
+    def body(carry, _):
+        cache, logits, rng = carry
+        rng, k = jax.random.split(rng)
+        masked = _topk_topp_mask(
+            logits / jnp.maximum(temperature, 1e-6),
+            jnp.broadcast_to(top_k, (B,)), jnp.broadcast_to(top_p, (B,)),
+        )
+        tok = jax.random.categorical(k, masked, axis=-1).astype(jnp.int32)
+        logits, cache = decode_step(params, cache, tok, cfg)
+        return (cache, logits, rng), tok
+
+    (cache, _, _), toks = jax.lax.scan(
+        body, (cache, logits, rng), None, length=n_steps
+    )
+    return jnp.moveaxis(toks, 0, 1), cache
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_sample_loop(cfg: LlamaConfig, n_steps: int):
+    return jax.jit(
+        functools.partial(sample_loop, cfg=cfg, n_steps=n_steps),
+        donate_argnums=(1,),
+    )
+
+
 def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int,
-             temperature: float = 0.0, rng=None, max_len: int = 0):
+             temperature: float = 0.0, rng=None, max_len: int = 0,
+             top_k: int = 0, top_p: float = 1.0):
     """Greedy (or sampled) generation. prompt: (B, T) int32 → (B,
     max_new_tokens) int32. Jitted callables are memoized per (cfg,
     n_steps) — repeat calls with the same shapes hit XLA's compile
-    cache instead of rebuilding jit wrappers (a serving hot path)."""
+    cache instead of rebuilding jit wrappers (a serving hot path).
+    BOTH paths run the whole decode as one device-side scan: greedy via
+    decode_loop, sampled via sample_loop (rng threaded through the scan
+    carry — a per-token host loop would pay one relay dispatch per
+    token)."""
     import numpy as np
 
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -481,12 +962,11 @@ def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int,
         rest, _ = _jitted_decode_loop(cfg, max_new_tokens - 1)(params, cache, first)
         return np.concatenate([np.asarray(first)[:, None], np.asarray(rest)], axis=1)
 
-    step = _jitted_decode_step(cfg)
-    out = []
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    for _ in range(max_new_tokens):
-        rng, k = jax.random.split(rng)
-        token = jax.random.categorical(k, logits / temperature, axis=-1)
-        out.append(np.asarray(token))
-        logits, cache = step(params, cache, token.astype(jnp.int32))
-    return np.stack(out, axis=1)
+    toks, _ = _jitted_sample_loop(cfg, max_new_tokens)(
+        params, cache, logits, rng,
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(top_k, jnp.int32),
+        jnp.asarray(top_p, jnp.float32),
+    )
+    return np.asarray(toks)

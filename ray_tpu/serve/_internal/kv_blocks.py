@@ -1,0 +1,147 @@
+"""Block-table KV allocator: the host side of paged attention.
+
+The device KV cache in paged mode is one global pool of fixed-size
+blocks, (L, n_blocks, block_size, kvh, hd); a sequence owns a LIST of
+block ids (its block table) instead of a contiguous (max_len,) stripe,
+so slot count decouples from sequence length — the PagedAttention idea
+(Kwon et al., SOSP '23), restated for static-shape TPU programs: block
+tables never live on device, they ride every dispatch as i32 program
+arguments exactly like prompt tokens do.
+
+This module is the allocator over that pool. Pure host bookkeeping —
+no jax imports, no device state:
+
+- block 0 is the NULL block, never allocated: device programs direct
+  every masked-off or inactive write at it (a finished slot's lanes, an
+  admission row's right-padding), so garbage writes land somewhere
+  harmless instead of corrupting a block that was freed and reused.
+- blocks are REFCOUNTED: a block can be owned by a running request and
+  simultaneously pinned by the radix prefix cache, or shared read-only
+  by any number of requests that matched it as a prompt prefix. It
+  returns to the free pool only when the last reference drops.
+- COPY-ON-WRITE: fork() shares every block of an existing table
+  (refcount bump, zero copies); ensure_writable() is the write barrier
+  — called before appending into a block that turned out to be shared,
+  it allocates a private replacement and reports the (src, dst) pair so
+  the caller can issue the device-side block copy. The serve path only
+  shares FULL prompt blocks (append positions never land inside them),
+  so COW triggers there exactly never — it exists for fork()-style
+  sequence splitting (beam/best-of) and is tested at that level.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+NULL_BLOCK = 0
+
+
+class BlockPoolExhausted(RuntimeError):
+    """alloc() could not be satisfied even after cache eviction."""
+
+
+class BlockAllocator:
+    """Refcounted allocator over `n_blocks` fixed-size KV blocks.
+
+    Not thread-safe by design: the engine mutates it only from the
+    planner (engine-loop) thread; metrics() reads integer snapshots,
+    which are atomic under the GIL.
+    """
+
+    def __init__(self, n_blocks: int, block_size: int):
+        if n_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is reserved null)")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.n_blocks = n_blocks
+        self.block_size = block_size
+        # LIFO free list: recently freed blocks are re-handed first, so a
+        # churned pool keeps touching the same HBM region (cache-warm)
+        self._free: List[int] = list(range(n_blocks - 1, 0, -1))
+        self._ref = [0] * n_blocks
+
+    # ------------------------------------------------------------ alloc
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return (self.n_blocks - 1) - len(self._free)
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        return -(-max(0, n_tokens) // self.block_size)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take `n` blocks (refcount 1 each). Raises BlockPoolExhausted
+        without side effects if fewer than n are free — admission
+        planning relies on all-or-nothing."""
+        if n > len(self._free):
+            raise BlockPoolExhausted(
+                f"need {n} KV blocks, {len(self._free)} free "
+                f"(pool {self.n_blocks - 1})"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        return out
+
+    def incref(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b == NULL_BLOCK:
+                continue
+            if self._ref[b] <= 0:
+                raise ValueError(f"incref on free block {b}")
+            self._ref[b] += 1
+
+    def decref(self, blocks: Sequence[int]) -> List[int]:
+        """Drop one reference per block; returns the blocks that reached
+        zero and went back to the pool."""
+        freed = []
+        for b in blocks:
+            if b == NULL_BLOCK:
+                continue
+            if self._ref[b] <= 0:
+                raise ValueError(f"decref on free block {b}")
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
+                freed.append(b)
+        return freed
+
+    def refcount(self, block: int) -> int:
+        return self._ref[block]
+
+    # -------------------------------------------------------------- COW
+    def fork(self, table: Sequence[int]) -> List[int]:
+        """Share an existing table: every block's refcount bumps, no
+        copies. The fork must go through ensure_writable() before any
+        in-place append."""
+        blocks = [b for b in table if b != NULL_BLOCK]
+        self.incref(blocks)
+        return list(blocks)
+
+    def ensure_writable(self, table: List[int], index: int
+                        ) -> Optional[Tuple[int, int]]:
+        """Copy-on-write barrier: make table[index] exclusively owned.
+        If the block is shared (refcount > 1), allocate a replacement,
+        swap it into the table, drop the shared reference, and return
+        (src, dst) so the caller can issue the device block copy.
+        Returns None when the block was already exclusive."""
+        b = table[index]
+        if b == NULL_BLOCK:
+            raise ValueError("ensure_writable on the null block")
+        if self._ref[b] == 1:
+            return None
+        dst = self.alloc(1)[0]
+        table[index] = dst
+        self.decref([b])
+        return (b, dst)
+
+    # ------------------------------------------------------------ audit
+    def leaked(self) -> Dict[int, int]:
+        """block -> refcount for every non-free block. Empty dict ==
+        every reference was returned (the CI block-leak audit)."""
+        return {b: r for b, r in enumerate(self._ref) if b != NULL_BLOCK and r > 0}
+
+    def check_zero(self) -> bool:
+        return not self.leaked()

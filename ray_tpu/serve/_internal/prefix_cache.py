@@ -1,0 +1,197 @@
+"""Radix prefix cache: committed prompt prefixes -> refcounted block runs.
+
+The RadixAttention idea (SGLang, Zheng et al.) at KV-block granularity:
+a token trie whose edges are FULL blocks of `block_size` prompt tokens
+and whose nodes pin the KV block holding that chunk's keys/values.
+An admission that shares a system prompt with any earlier request walks
+the trie, takes read-only references on the matched block run, and
+prefills only its suffix — the shared prefill is skipped entirely.
+
+Sharing rules (what keeps this correct without device-side locks):
+
+- FULL blocks only. A partial last block is private to its sequence
+  (decode appends into it), so it is never inserted; matched prefixes
+  are therefore always block-aligned, which is exactly the alignment
+  the device-side suffix prefill requires of its start positions.
+- A lookup is capped at len(prompt) - 1 tokens: even a 100% cached
+  prompt must prefill its final token, because the first output token
+  is sampled from the last prompt position's logits.
+- Insertion happens at admission PLAN time, not completion: the blocks
+  are filled by the same (or an earlier) phase of the very macro-step
+  the plan compiles to, and device phases execute in plan order, so a
+  later admission in the same dispatch can already share them. Within
+  one admission batch the layer body writes every row's suffix K/V
+  before any row gathers context, so even same-phase sharers read the
+  owner's writes.
+- Eviction is LRU over LEAF nodes whose block nobody but the cache
+  references (refcount 1): interior nodes are pinned by their children,
+  in-use blocks by their requests. evict() walks leaves until it freed
+  the requested count or ran out of evictable leaves.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.serve._internal.kv_blocks import BlockAllocator
+
+
+class _Node:
+    __slots__ = ("chunk", "block", "children", "parent", "tick")
+
+    def __init__(self, chunk: Tuple[int, ...], block: int,
+                 parent: Optional["_Node"]):
+        self.chunk = chunk
+        self.block = block
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.parent = parent
+        self.tick = 0
+
+
+class RadixPrefixCache:
+    """Block-granular token trie over a BlockAllocator.
+
+    Single-threaded like the allocator (engine-loop only). Counters are
+    plain ints read by metrics() under the GIL.
+    """
+
+    def __init__(self, allocator: BlockAllocator):
+        self._alloc = allocator
+        self._bs = allocator.block_size
+        self._root = _Node((), -1, None)
+        self._tick = 0
+        self._nodes = 0
+        # token-level counters: reuse rate = hit_tokens / lookup_tokens
+        self.hits = 0          # lookups that matched >= 1 block
+        self.misses = 0
+        self.evictions = 0     # blocks evicted
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+
+    # ----------------------------------------------------------- lookup
+    def lookup(self, prompt: Sequence[int], record: bool = True
+               ) -> Tuple[List[int], int]:
+        """Longest cached block-aligned proper prefix of `prompt`.
+        Returns (blocks, matched_tokens); every returned block carries a
+        NEW reference owned by the caller (released when the request's
+        table is freed). matched_tokens < len(prompt) always.
+
+        record=False skips the hit/miss counters (LRU ticks still
+        touch): the engine retries a pool-exhausted admission every plan
+        tick, and those repeats must not inflate the hit rate — it calls
+        record_lookup() once when the admission actually lands."""
+        n_full = (len(prompt) - 1) // self._bs  # proper prefix: >= 1 token left
+        node, blocks = self._root, []
+        self._tick += 1
+        for i in range(n_full):
+            chunk = tuple(prompt[i * self._bs:(i + 1) * self._bs])
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.tick = self._tick
+            blocks.append(child.block)
+            node = child
+        if record:
+            self.record_lookup(len(prompt), len(blocks))
+        if blocks:
+            self._alloc.incref(blocks)
+        return blocks, len(blocks) * self._bs
+
+    def record_lookup(self, n_prompt_tokens: int, n_matched_blocks: int) -> None:
+        """Count one lookup toward the hit/miss/reuse-rate stats."""
+        self.lookup_tokens += n_prompt_tokens
+        if n_matched_blocks:
+            self.hits += 1
+            self.hit_tokens += n_matched_blocks * self._bs
+        else:
+            self.misses += 1
+
+    # ----------------------------------------------------------- insert
+    def insert(self, prompt: Sequence[int], table: Sequence[int]) -> int:
+        """Commit `prompt`'s full blocks (backed by table[i]) into the
+        trie. Existing nodes are left alone (first writer wins — the
+        duplicate blocks stay private to their request and free when it
+        finishes); new nodes take one cache-owned reference. Returns the
+        number of newly committed blocks."""
+        n_full = len(prompt) // self._bs
+        node, added = self._root, 0
+        self._tick += 1
+        for i in range(n_full):
+            chunk = tuple(prompt[i * self._bs:(i + 1) * self._bs])
+            child = node.children.get(chunk)
+            if child is None:
+                child = _Node(chunk, table[i], node)
+                node.children[chunk] = child
+                self._alloc.incref([table[i]])
+                self._nodes += 1
+                added += 1
+            child.tick = self._tick
+            node = child
+        return added
+
+    # ------------------------------------------------------------ evict
+    def evict(self, n_blocks: int) -> int:
+        """Free up to `n_blocks` pool blocks by dropping LRU leaves whose
+        block only the cache still references. Returns blocks actually
+        freed (0 when nothing is evictable — callers must re-check the
+        pool, not assume success).
+
+        One DFS collects ALL evictable leaves, sorted LRU-first, and the
+        batch is consumed in order (a per-block full-trie walk would be
+        O(n_blocks x nodes) on the engine-loop admission path); the
+        outer loop only re-walks when evicting a leaf exposed its parent
+        as newly evictable."""
+        freed = 0
+        while freed < n_blocks:
+            leaves = self._evictable_leaves()
+            if not leaves:
+                break
+            for node in leaves:
+                if freed >= n_blocks:
+                    break
+                del node.parent.children[node.chunk]
+                self._nodes -= 1
+                self.evictions += 1
+                freed += len(self._alloc.decref([node.block]))
+        return freed
+
+    def _evictable_leaves(self) -> List[_Node]:
+        """Leaves whose block only the cache references, LRU-first."""
+        out = []
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node is self._root or node.children:
+                continue
+            if self._alloc.refcount(node.block) != 1:
+                continue  # a live request still reads it
+            out.append(node)
+        out.sort(key=lambda n: n.tick)
+        return out
+
+    def clear(self) -> int:
+        """Drop every node (cache references only). Returns blocks freed."""
+        freed = 0
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            freed += len(self._alloc.decref([node.block]))
+            self._nodes -= 1
+        self._root.children.clear()
+        return freed
+
+    # ----------------------------------------------------------- status
+    @property
+    def nodes(self) -> int:
+        return self._nodes
+
+    def stats(self) -> Dict[str, float]:
+        total = max(1, self.lookup_tokens)
+        return {
+            "prefix_cache_nodes": self._nodes,
+            "prefix_cache_hits": self.hits,
+            "prefix_cache_misses": self.misses,
+            "prefix_cache_evictions": self.evictions,
+            "prefix_cache_hit_rate": round(self.hit_tokens / total, 4),
+        }

@@ -9,14 +9,50 @@ group runs one prefill + one lax.scan decode with static shapes and no
 padding/masking complications. Shape churn is bounded by rounding
 prompt-group lengths up to a bucket multiple, so the jit cache stays
 small and warm.
+
+Requests on the continuous path are either a bare token list (greedy,
+engine defaults) or a dict carrying per-request SamplingParams fields:
+
+    handle.remote({"prompt": [1, 2, 3], "temperature": 0.7,
+                   "top_p": 0.9, "seed": 42, "stop": [2],
+                   "max_new_tokens": 64})
+
+temperature/top-k/top-p sampling and stop tokens require the paged
+engine (`paged=True`, the default for `continuous=True`) — they run
+device-side inside the decode scan (models/llama_decode.sample_tokens).
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu.serve._internal.sampling import SamplingParams
 from ray_tpu.serve.api import batch, deployment
+
+
+def _parse_request(req, default_max_new: int):
+    """Request-path coercion: bare prompt list or dict with sampling
+    fields -> (prompt, max_new_tokens, SamplingParams)."""
+    if isinstance(req, dict):
+        body = dict(req)
+        if "prompt" not in body:
+            raise ValueError(
+                f"dict request must carry a 'prompt' field "
+                f"(got keys {sorted(body)})"
+            )
+        prompt = [int(t) for t in body.pop("prompt")]
+        max_new = int(body.pop("max_new_tokens", default_max_new))
+        known = {f.name for f in dataclasses.fields(SamplingParams)}
+        unknown = set(body) - known
+        if unknown:
+            raise ValueError(
+                f"unknown request field(s) {sorted(unknown)}; valid "
+                f"sampling fields: {sorted(known)}"
+            )
+        return prompt, max_new, SamplingParams(**body)
+    return [int(t) for t in req], default_max_new, SamplingParams()
 
 
 class _LLMServer:
@@ -26,7 +62,9 @@ class _LLMServer:
     def __init__(self, cfg=None, params=None, max_new_tokens: int = 32,
                  checkpoint_dir: Optional[str] = None, seed: int = 0,
                  continuous: bool = False, n_slots: int = 8, chunk: int = 8,
-                 macro_phases: int = 8):
+                 macro_phases: int = 8, paged: Optional[bool] = None,
+                 block_size: int = 16, n_blocks: int = 0,
+                 prefix_cache: bool = True):
         import jax
 
         from ray_tpu.models import llama
@@ -44,12 +82,23 @@ class _LLMServer:
         self.engine = None
         if continuous:
             # continuous batching: requests admit/evict per decode chunk,
-            # with macro-step scheduling batching K chunks per dispatch
+            # with macro-step scheduling batching K chunks per dispatch;
+            # paged (default) decouples KV memory from slots x max_len
+            # and unlocks sampling + stop tokens + prefix reuse
             from ray_tpu.serve.llm_engine import ContinuousBatchingEngine
 
+            if paged is None:
+                # auto: paged whenever the macro scheduler runs; the
+                # legacy per-chunk path (macro_phases=0) stays dense.
+                # An EXPLICIT paged=True with macro_phases=0 is a config
+                # error the engine raises loudly — never a silent
+                # downgrade to dense.
+                paged = macro_phases > 0
             self.engine = ContinuousBatchingEngine(
                 self.params, self.cfg, n_slots=n_slots, chunk=chunk,
-                macro_phases=macro_phases,
+                macro_phases=macro_phases, paged=paged,
+                block_size=block_size, n_blocks=n_blocks,
+                prefix_cache=prefix_cache,
             )
 
     def metrics(self) -> Dict[str, Any]:
@@ -76,8 +125,11 @@ class _LLMServer:
                 out[i] = toks[row].tolist()
         return out
 
-    def __call__(self, prompt: List[int]) -> List[int]:
+    def __call__(self, request) -> List[int]:
         if self.engine is not None:
+            prompt, max_new, sampling = _parse_request(
+                request, self.max_new_tokens
+            )
             from ray_tpu.experimental.direct_transport import maybe_defer
 
             deferred = maybe_defer()
@@ -96,29 +148,41 @@ class _LLMServer:
                 # a submit() raise (dead engine, bad request) propagates:
                 # the transport surfaces it and disarms the deferred
                 self.engine.submit(
-                    [int(t) for t in prompt], self.max_new_tokens,
-                    on_done=_complete,
+                    prompt, max_new, on_done=_complete, sampling=sampling,
                 )
                 return None
-            return self.engine.generate(
-                [int(t) for t in prompt], self.max_new_tokens
+            return self.engine.generate(prompt, max_new, sampling=sampling)
+        if isinstance(request, dict):
+            raise ValueError(
+                "per-request sampling needs the continuous engine "
+                "(llm_deployment(continuous=True))"
             )
-        return self._generate([int(t) for t in prompt])
+        return self._generate([int(t) for t in request])
 
 
 def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
                    cfg=None, checkpoint_dir: Optional[str] = None,
                    continuous: bool = False, n_slots: int = 8,
-                   chunk: int = 8, macro_phases: int = 8, **deploy_kw):
+                   chunk: int = 8, macro_phases: int = 8,
+                   paged: Optional[bool] = None, block_size: int = 16,
+                   n_blocks: int = 0, prefix_cache: bool = True,
+                   **deploy_kw):
     """A ready-to-run LLM generation application:
 
         app = llm_deployment(num_replicas=2, max_new_tokens=16)
         handle = serve.run(app, name="llm")
         handle.remote([1, 2, 3]).result()
-    """
+
+    With continuous=True the replica runs the paged continuous-batching
+    engine: requests may be dicts carrying SamplingParams fields
+    (temperature/top_k/top_p/seed/stop/max_new_tokens); `block_size` /
+    `n_blocks` size the paged KV pool and `prefix_cache` toggles radix
+    prompt-prefix reuse."""
     dep = deployment(
         _LLMServer, name="LLMServer", num_replicas=num_replicas, **deploy_kw
     )
     return dep.bind(cfg=cfg, max_new_tokens=max_new_tokens,
                     checkpoint_dir=checkpoint_dir, continuous=continuous,
-                    n_slots=n_slots, chunk=chunk, macro_phases=macro_phases)
+                    n_slots=n_slots, chunk=chunk, macro_phases=macro_phases,
+                    paged=paged, block_size=block_size, n_blocks=n_blocks,
+                    prefix_cache=prefix_cache)

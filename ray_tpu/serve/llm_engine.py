@@ -7,9 +7,34 @@ engine to wrap, so this is the green-field TPU-native equivalent
 
 - A fixed pool of KV-cache SLOTS (models/llama_decode.py per-slot
   machinery): each slot is an independent sequence at its own position.
-- KEY INVARIANT: greedy decode to a requested length means scheduling
-  never depends on token VALUES — admission, eviction and chunk sizing
-  are all decidable from host-side counters alone.
+- PAGED KV (paged=True, the serving default path): KV memory is a
+  global pool of fixed-size blocks instead of slots x max_len stripes —
+  a host-side BlockAllocator (serve/_internal/kv_blocks.py) plans
+  refcounted per-slot block tables that ride each dispatch as i32
+  program arguments, a radix prefix cache
+  (serve/_internal/prefix_cache.py) lets admissions that share a
+  committed prompt prefix reuse its blocks and prefill only the
+  suffix, and REAL SAMPLING (temperature/top-k/top-p, per-request
+  seeds, device-side stop-token detection) runs inside the decode scan.
+- PLAN-AND-REPAIR replaces the old greedy-only invariant: with
+  sampling, token values CAN end a sequence early (stop tokens), so
+  the host keeps planning K phases ahead speculatively from counters,
+  the device zeroes a stopped slot's `remaining` the moment it samples
+  a stop, and the host repairs its plan when the resolved tokens
+  reveal it — truncating delivery at the stop, freeing the slot and
+  its blocks at the next plan boundary, and billing the discarded
+  planned steps as `speculative_waste_pct`. Block reuse under
+  speculation is safe by construction: tables are PER-DISPATCH host
+  plans, so a zombie lane (stopped or cancelled but still riding
+  already-planned phases) only ever writes blocks it owned at dispatch
+  time — every later dispatch points it at the null block, and a new
+  owner's admission prefill (always a later dispatch, device programs
+  serialize) overwrites before any read.
+- KEY INVARIANT (greedy requests — and the legacy dense mode's only
+  mode): greedy decode to a requested length means scheduling never
+  depends on token VALUES — admission, eviction and chunk sizing are
+  all decidable from host-side counters alone; a stop-free plan needs
+  zero repair.
 - MACRO-STEP SCHEDULING exploits that invariant to collapse dispatch
   count: the host plans K phases of admissions/evictions ahead, then
   executes the WHOLE plan as one jitted dispatch
@@ -180,14 +205,27 @@ class _LatencyHist:
 
 class _Request:
     __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
-                 "on_done", "_first_dev", "_remaining", "_t_submit",
-                 "_t_first", "_t_done", "_trace_ctx")
+                 "on_done", "sampling", "finish_reason", "_first_dev",
+                 "_remaining", "_t_submit", "_t_first", "_t_done",
+                 "_trace_ctx", "_start", "_blocks", "_blocks_freed",
+                 "_done_lock")
 
-    def __init__(self, prompt, max_new_tokens, on_done=None):
+    def __init__(self, prompt, max_new_tokens, on_done=None, sampling=None):
+        from ray_tpu.serve._internal.sampling import SamplingParams
+
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
+        self.sampling = sampling or SamplingParams()
+        # "length" | "stop" | "cancelled" | None (error/unfinished)
+        self.finish_reason: Optional[str] = None
         self.tokens: List[int] = []
         self.done = threading.Event()
+        # completion is a cross-thread event (engine loop delivers,
+        # caller threads cancel): _finish's test-and-set runs under this
+        self._done_lock = threading.Lock()
+        self._start = 0            # reused-prefix tokens (paged admissions)
+        self._blocks: List[int] = []   # KV blocks owned (paged mode)
+        self._blocks_freed = False
         # completion callback, fired (once) from the engine loop thread
         # right after done.set() — the serve direct-transport path
         # completes the caller's deferred reply here with one ring
@@ -208,24 +246,37 @@ class _Request:
         self._trace_ctx: Optional[Dict[str, str]] = None
 
 
-def _finish(req: "_Request") -> None:
-    """Complete a request: set the event, then fire on_done exactly once
-    (callback failures are logged, never poison the engine loop)."""
-    req.done.set()
-    cb = req.on_done
-    if cb is not None:
+def _finish(req: "_Request", error: Optional[str] = None,
+            reason: Optional[str] = None) -> bool:
+    """Complete a request ATOMICALLY: exactly one caller wins (the
+    engine loop delivering vs. a caller thread cancelling race here),
+    the final error/finish_reason are written before `done` is visible,
+    and on_done fires exactly once, outside the lock (callback failures
+    are logged, never poison the engine loop). Returns True for the
+    winner, False if the request was already complete."""
+    with req._done_lock:
+        if req.done.is_set():
+            return False
+        if error is not None:
+            req.error = error
+        if reason is not None:
+            req.finish_reason = reason
+        cb = req.on_done
         req.on_done = None
+        req.done.set()
+    if cb is not None:
         try:
             cb(req)
         except Exception:
             logger.exception("llm request on_done callback failed")
+    return True
 
 
 class ContinuousBatchingEngine:
     def __init__(self, params, cfg, n_slots: int = 8, max_len: int = 0,
-                 chunk: int = 8, macro_phases: int = 8, name: str = "default"):
-        import functools
-
+                 chunk: int = 8, macro_phases: int = 8, name: str = "default",
+                 paged: bool = False, block_size: int = 16,
+                 n_blocks: int = 0, prefix_cache: bool = True):
         import jax
 
         from ray_tpu.models import llama_decode as D
@@ -238,16 +289,42 @@ class ContinuousBatchingEngine:
         self.max_len = max_len or cfg.max_seq_len
         self.chunk = chunk
         self.macro_phases = macro_phases  # 0 => legacy per-chunk dispatching
-        self.cache = D.init_slot_cache(cfg, n_slots, self.max_len)
-        self._prefill_slots = jax.jit(functools.partial(D.prefill_into_slots, cfg=cfg))
-        self._chunk_fn = jax.jit(
-            functools.partial(D.decode_chunk_slots, chunk=chunk, cfg=cfg),
-            donate_argnums=(1,),
-        )
-        self._macro_fn = jax.jit(
-            functools.partial(D.macro_step_slots, chunk=chunk, cfg=cfg),
-            donate_argnums=(1,),
-        )
+        self.paged = bool(paged)
+        self._alloc = None
+        self._prefix = None
+        if self.paged:
+            if macro_phases < 1:
+                raise ValueError("paged KV requires macro_phases >= 1")
+            if block_size & (block_size - 1) or block_size < 1:
+                raise ValueError(f"block_size must be a power of two, got {block_size}")
+            from ray_tpu.serve._internal.kv_blocks import BlockAllocator
+            from ray_tpu.serve._internal.prefix_cache import RadixPrefixCache
+
+            self.block_size = block_size
+            # table width: blocks to cover max_len (per-slot ceiling)
+            self._mb = -(-self.max_len // block_size)
+            # default pool: same KV budget as the dense slots x max_len
+            # cache (+1 for the reserved null block) — paged wins by
+            # serving MORE slots from the SAME budget, not more memory
+            self.n_blocks = n_blocks or n_slots * self._mb + 1
+            self._alloc = BlockAllocator(self.n_blocks, block_size)
+            if prefix_cache:
+                self._prefix = RadixPrefixCache(self._alloc)
+            self.cache = D.init_paged_cache(cfg, n_slots, self.n_blocks,
+                                            block_size)
+            # greedy variant prebound; the sampled twin resolves lazily
+            # at the first plan that actually contains a sampled request
+            # (two static variants — all-greedy traffic must not pay the
+            # per-step sort/softmax/rng sampling pipeline)
+            self._macro_paged_fn = D.jitted_macro_step_slots_paged(
+                cfg, chunk, sampled=False)
+        else:
+            self.cache = D.init_slot_cache(cfg, n_slots, self.max_len)
+        # memoized per (cfg, chunk): same-geometry engines share one jit
+        # wrapper, so engine construction never recompiles warm programs
+        self._prefill_slots = D.jitted_prefill_into_slots(cfg)
+        self._chunk_fn = D.jitted_decode_chunk_slots(cfg, chunk)
+        self._macro_fn = D.jitted_macro_step_slots(cfg, chunk)
         self._slots: List[Optional[_Request]] = [None] * n_slots
         import jax.numpy as jnp
 
@@ -259,7 +336,9 @@ class ContinuousBatchingEngine:
         # serving metrics (monotonic counters + latency histograms)
         self.name = name
         self._m = {"dispatches": 0, "tokens_out": 0, "slot_steps": 0,
-                   "useful_slot_steps": 0}
+                   "useful_slot_steps": 0, "wasted_steps": 0,
+                   "prefill_tokens": 0, "reused_prefix_tokens": 0,
+                   "kv_blocks_peak_in_use": 0}
         shared = _engine_metrics()
         self._tags = {"engine": name}
         self._ttft = _LatencyHist(_TTFT_BOUNDS, shared["ttft"], self._tags)
@@ -280,7 +359,9 @@ class ContinuousBatchingEngine:
 
     # ------------------------------------------------------------- public
     def submit(self, prompt: List[int], max_new_tokens: int,
-               on_done=None) -> _Request:
+               on_done=None, sampling=None) -> _Request:
+        from ray_tpu.serve._internal.sampling import SamplingParams
+
         if self._dead is not None:
             raise RuntimeError(f"engine is dead: {self._dead}")
         if len(prompt) == 0:
@@ -294,7 +375,32 @@ class ContinuousBatchingEngine:
                 f"prompt+generation ({len(prompt)}+{max_new_tokens}) exceeds "
                 f"engine max_len {self.max_len}"
             )
-        req = _Request([int(t) for t in prompt], max_new_tokens, on_done=on_done)
+        sampling = SamplingParams.from_request(sampling)
+        if not sampling.greedy and sampling.seed is None:
+            # seedless sampled requests draw fresh entropy: two users
+            # omitting the seed must not share a token stream (an
+            # explicit seed — including 0 — stays fully reproducible)
+            import dataclasses as _dc
+            import os as _os
+
+            sampling = _dc.replace(
+                sampling, seed=int.from_bytes(_os.urandom(4), "little"))
+        if not self.paged and (not sampling.greedy or sampling.stop):
+            # dense mode has no device-side sampling/stop detection —
+            # its macro program is the greedy-invariant one
+            raise ValueError(
+                "temperature sampling and stop tokens require the paged "
+                "engine (paged=True)"
+            )
+        if self.paged:
+            need = self._alloc.blocks_for_tokens(len(prompt) + max_new_tokens)
+            if need > self.n_blocks - 1:
+                raise ValueError(
+                    f"request needs {need} KV blocks, pool only has "
+                    f"{self.n_blocks - 1}"
+                )
+        req = _Request([int(t) for t in prompt], max_new_tokens,
+                       on_done=on_done, sampling=sampling)
         try:
             from ray_tpu.util import tracing
 
@@ -306,20 +412,36 @@ class ContinuousBatchingEngine:
             # lost the race with the loop dying: the dead loop will never
             # drain the queue, so fail the request here instead of letting
             # the caller eat a generic timeout
-            req.error = f"engine is dead: {self._dead}"
-            _finish(req)
-            raise RuntimeError(req.error)
+            msg = f"engine is dead: {self._dead}"
+            _finish(req, error=msg)
+            raise RuntimeError(msg)
         self._wake.set()
         return req
 
     def generate(self, prompt: List[int], max_new_tokens: int,
-                 timeout: float = 120.0) -> List[int]:
-        req = self.submit(prompt, max_new_tokens)
+                 timeout: float = 120.0, sampling=None) -> List[int]:
+        req = self.submit(prompt, max_new_tokens, sampling=sampling)
         if not req.done.wait(timeout):
-            raise TimeoutError("generation timed out")
+            # CANCEL, don't abandon: a timed-out request left live would
+            # keep burning decode steps and (paged) holding KV blocks
+            # forever — cancellation frees the slot and its blocks at
+            # the engine's next plan boundary
+            self.cancel(req, "cancelled: generation timed out")
+            raise TimeoutError("generation timed out (request cancelled)")
         if req.error is not None:
             raise RuntimeError(f"generation failed: {req.error}")
         return req.tokens
+
+    def cancel(self, req: _Request, msg: str = "cancelled") -> None:
+        """Cancel an in-flight request (idempotent, any thread). The
+        request completes immediately with `error=msg`; the engine loop
+        reclaims its slot and KV blocks at the next plan boundary
+        (_repair). Device lanes it still rides in already-dispatched
+        plans emit discarded tokens, billed as speculative waste. A
+        cancel racing normal delivery loses cleanly: _finish's atomic
+        test-and-set makes whoever gets there first the sole completer."""
+        if _finish(req, error=msg, reason="cancelled"):
+            self._wake.set()
 
     def shutdown(self):
         self._running = False
@@ -339,6 +461,23 @@ class ContinuousBatchingEngine:
         m["lane_occupancy_pct"] = round(
             100.0 * m["useful_slot_steps"] / max(1, m["slot_steps"]), 1
         )
+        # plan-and-repair bill: % of PLANNED useful steps whose tokens
+        # were discarded (early stop / cancellation revealed after the
+        # speculative plan shipped)
+        m["speculative_waste_pct"] = round(
+            100.0 * m["wasted_steps"] / max(1, m["useful_slot_steps"]), 2
+        )
+        if self.paged:
+            total = self.n_blocks - 1  # block 0 is the reserved null
+            m["kv_blocks_total"] = total
+            m["kv_blocks_in_use"] = self._alloc.used_blocks
+            # peak utilization over the workload — the snapshot of record
+            # (in_use drains to the cache-pinned floor between requests)
+            m["kv_blocks_utilization_pct"] = round(
+                100.0 * m["kv_blocks_peak_in_use"] / max(1, total), 1
+            )
+            if self._prefix is not None:
+                m.update(self._prefix.stats())
         for key, hist in (("ttft", self._ttft), ("tpot", self._tpot)):
             p50, p95, p99 = hist.percentiles_ms()
             m[f"{key}_ms_p50"] = p50
@@ -357,6 +496,10 @@ class ContinuousBatchingEngine:
         self._ttft.reset()
         self._tpot.reset()
         self._tel.reset()
+        if self._prefix is not None:
+            for c in ("hits", "misses", "evictions", "hit_tokens",
+                      "lookup_tokens"):
+                setattr(self._prefix, c, 0)
 
     # ------------------------------------------------------------ engine
     def _bucket(self, n: int) -> int:
@@ -370,19 +513,114 @@ class ContinuousBatchingEngine:
         return min(b, self.max_len)
 
     # ---- macro-step scheduling ----------------------------------------
+    def _free_request_blocks(self, req: _Request) -> None:
+        """Return a request's KV blocks to the pool (idempotent — a
+        request can be planned-evicted AND repaired in either order).
+        Blocks the prefix cache committed stay pinned by its reference
+        until cache eviction."""
+        if not self.paged or req._blocks_freed:
+            return
+        req._blocks_freed = True
+        self._alloc.decref(req._blocks)
+
+    def _try_admit_paged(self, req: _Request) -> bool:
+        """Reserve blocks + block table for one admission. Full
+        reservation (prompt + max_new, minus the reused prefix) makes
+        the plan deadlock-free by construction: an admitted request can
+        always take every decode step it was promised. On exhaustion the
+        radix cache evicts LRU committed prefixes; False means the
+        caller must leave the request queued."""
+        shared: List[int] = []
+        matched = 0
+        if self._prefix is not None:
+            # record=False: a pool-exhausted admission retries every
+            # plan tick and must not inflate the hit-rate counters —
+            # record_lookup() fires once, on the admission that lands
+            shared, matched = self._prefix.lookup(req.prompt, record=False)
+        need_total = self._alloc.blocks_for_tokens(
+            len(req.prompt) + req.max_new_tokens
+        )
+        need = need_total - len(shared)
+        from ray_tpu.serve._internal.kv_blocks import BlockPoolExhausted
+
+        try:
+            private = self._alloc.alloc(need)
+        except BlockPoolExhausted:
+            if self._prefix is not None:
+                self._prefix.evict(need - self._alloc.free_blocks)
+            try:
+                private = self._alloc.alloc(need)
+            except BlockPoolExhausted:
+                if shared:
+                    self._alloc.decref(shared)
+                return False
+        req._start = matched
+        req._blocks = shared + private
+        req._blocks_freed = False
+        if self._prefix is not None:
+            self._prefix.record_lookup(len(req.prompt), len(shared))
+        self._m["reused_prefix_tokens"] += matched
+        self._m["prefill_tokens"] += len(req.prompt) - matched
+        self._m["kv_blocks_peak_in_use"] = max(
+            self._m["kv_blocks_peak_in_use"], self._alloc.used_blocks
+        )
+        if self._prefix is not None:
+            # commit the full prompt blocks NOW: the prefill that fills
+            # them rides the same (or an earlier) phase of the very
+            # dispatch this plan compiles to, and phases execute in plan
+            # order — so even a same-plan admission can share them
+            self._prefix.insert(req.prompt, req._blocks)
+        return True
+
+    def _table_row(self, req: Optional[_Request]) -> "np.ndarray":
+        row = np.zeros(self._mb, np.int32)  # null-block padded
+        if req is not None:
+            row[: len(req._blocks)] = req._blocks
+        return row
+
+    def _snapshot_phase(self) -> Dict[str, Any]:
+        """Per-phase device plan arrays from current slot occupancy:
+        block tables + sampling params. Freed slots stay all-null, so a
+        zombie lane (stopped/cancelled request still riding the plan)
+        can only write the null block from this phase on."""
+        from ray_tpu.serve._internal.sampling import MAX_STOP_TOKENS
+
+        B = self.n_slots
+        tables = np.zeros((B, self._mb), np.int32)
+        temps = np.zeros(B, np.float32)
+        top_ks = np.zeros(B, np.int32)
+        top_ps = np.ones(B, np.float32)
+        stops = np.full((B, MAX_STOP_TOKENS), -1, np.int32)
+        for s, r in enumerate(self._slots):
+            if r is None:
+                continue
+            tables[s] = self._table_row(r)
+            sp = r.sampling
+            temps[s] = sp.temperature
+            top_ks[s] = sp.top_k
+            top_ps[s] = sp.top_p
+            stops[s] = sp.stop_row()
+        return {"tables": tables, "temps": temps, "top_ks": top_ks,
+                "top_ps": top_ps, "stops": stops}
+
     def _plan(self) -> Optional[List[Dict[str, Any]]]:
         """Plan up to macro_phases phases of admissions + adaptive decode
-        chunks purely from host counters (the scheduling-never-depends-
-        on-token-values invariant). Mutates engine bookkeeping to the
-        post-macro-step state: slot assignments, per-request remaining
-        counters, evictions."""
+        chunks purely from host counters. Greedy requests make this
+        exact; sampled requests make it SPECULATIVE (a stop token can
+        end them early — _deliver/_repair reconcile). Mutates engine
+        bookkeeping to the post-macro-step state: slot assignments,
+        per-request remaining counters, evictions, block
+        allocations/frees."""
         phases = []
         while len(phases) < self.macro_phases:
             admissions = []
             free = [i for i, r in enumerate(self._slots) if r is None]
             while free and self._waiting:
+                req = self._waiting[0]
+                if self.paged and not self._try_admit_paged(req):
+                    break  # pool exhausted: stays queued, FIFO order kept
+                self._waiting.popleft()
                 slot = free.pop(0)
-                req = self._waiting.popleft()
                 req._remaining = req.max_new_tokens - 1
                 self._slots[slot] = req
                 admissions.append((slot, req))
@@ -390,6 +628,7 @@ class ContinuousBatchingEngine:
                     if r is not None and r._remaining > 0]
             if not live and not admissions:
                 break
+            snapshot = self._snapshot_phase() if self.paged else {}
             # adaptive chunk: decode exactly to the next scheduling event
             # (a slot finishing) so the freed lane re-admits immediately
             steps = min([self.chunk] + [r._remaining for _, r in live]) if live else 0
@@ -402,13 +641,26 @@ class ContinuousBatchingEngine:
             for s, r in enumerate(self._slots):
                 if r is not None and r._remaining == 0:
                     self._slots[s] = None  # evict: freed for the next phase
+                    self._free_request_blocks(r)
             phases.append({"steps": steps, "admissions": admissions,
-                           "takes": takes})
+                           "takes": takes, **snapshot})
         return phases or None
+
+    def _bucket_paged(self, n: int) -> int:
+        """Paged prompt bucket: power-of-two, at least one block, at
+        most the table span — always a multiple of block_size (the
+        suffix-prefill writes whole blocks)."""
+        b = 16
+        while b < n:
+            b *= 2
+        return min(max(b, self.block_size), self._mb * self.block_size)
 
     def _dispatch_macro(self, phases: List[Dict[str, Any]]) -> None:
         """Ship the plan as ONE jitted dispatch and append the result to
-        the fetch frontier (resolved one macro-step behind)."""
+        the fetch frontier (resolved one macro-step behind). In paged
+        mode admission rows carry only each prompt's SUFFIX beyond its
+        reused prefix, and the per-phase block tables + sampling plan
+        ride along as extra program arguments."""
         import jax.numpy as jnp
 
         K = self.macro_phases
@@ -416,30 +668,88 @@ class ContinuousBatchingEngine:
         A = 1
         while A < max(1, max_admit):
             A *= 2
-        P = self._bucket(max(
-            (len(r.prompt) for p in phases for _, r in p["admissions"]), default=1
-        ))
+        suffix_len = lambda r: len(r.prompt) - r._start  # noqa: E731
+        if self.paged:
+            P = self._bucket_paged(max(
+                (suffix_len(r) for p in phases for _, r in p["admissions"]),
+                default=1,
+            ))
+        else:
+            P = self._bucket(max(
+                (len(r.prompt) for p in phases for _, r in p["admissions"]),
+                default=1,
+            ))
         steps = np.zeros(K, np.int32)
         has_admit = np.zeros(K, bool)
         prompts = np.zeros((K, A, P), np.int32)
         lengths = np.zeros((K, A), np.int32)
         slots = np.zeros((K, A), np.int32)
         rems = np.zeros((K, A), np.int32)
+        starts = np.zeros((K, A), np.int32)
+        seeds = np.zeros((K, A), np.uint32)
         for k, ph in enumerate(phases):
             steps[k] = ph["steps"]
             for a, (slot, req) in enumerate(ph["admissions"]):
                 has_admit[k] = True
-                prompts[k, a, : len(req.prompt)] = req.prompt
-                lengths[k, a] = len(req.prompt)
+                if self.paged:
+                    suffix = req.prompt[req._start:]
+                    prompts[k, a, : len(suffix)] = suffix
+                    lengths[k, a] = len(suffix)
+                    starts[k, a] = req._start
+                    # greedy rows never consume their key; submit()
+                    # materialized a real seed for every sampled row
+                    seeds[k, a] = np.uint32(
+                        (req.sampling.seed or 0) & 0xFFFFFFFF)
+                else:
+                    prompts[k, a, : len(req.prompt)] = req.prompt
+                    lengths[k, a] = len(req.prompt)
                 slots[k, a] = slot
                 rems[k, a] = req.max_new_tokens - 1
         t0 = time.perf_counter()
         try:
-            toks_dev, firsts_dev, self._next_dev, self.cache = self._macro_fn(
-                self.params, self.cache, self._next_dev,
-                jnp.asarray(steps), jnp.asarray(has_admit), jnp.asarray(prompts),
-                jnp.asarray(lengths), jnp.asarray(slots), jnp.asarray(rems),
-            )
+            if self.paged:
+                from ray_tpu.serve._internal.sampling import MAX_STOP_TOKENS
+
+                # static variant selection: only pay the device sampling
+                # pipeline when a sampled request actually rides the plan
+                plan_sampled = any(
+                    not r.sampling.greedy
+                    for p in phases
+                    for r in ([r for _, r in p["admissions"]]
+                              + [r for _, r, _ in p["takes"]])
+                )
+                self._macro_paged_fn = self._D.jitted_macro_step_slots_paged(
+                    self.cfg, self.chunk, sampled=plan_sampled)
+                B, MB = self.n_slots, self._mb
+                tables = np.zeros((K, B, MB), np.int32)
+                temps = np.zeros((K, B), np.float32)
+                top_ks = np.zeros((K, B), np.int32)
+                top_ps = np.ones((K, B), np.float32)
+                stops = np.full((K, B, MAX_STOP_TOKENS), -1, np.int32)
+                for k, ph in enumerate(phases):
+                    tables[k] = ph["tables"]
+                    temps[k] = ph["temps"]
+                    top_ks[k] = ph["top_ks"]
+                    top_ps[k] = ph["top_ps"]
+                    stops[k] = ph["stops"]
+                toks_dev, firsts_dev, self._next_dev, self.cache = (
+                    self._macro_paged_fn(
+                        self.params, self.cache, self._next_dev,
+                        jnp.asarray(steps), jnp.asarray(has_admit),
+                        jnp.asarray(prompts), jnp.asarray(lengths),
+                        jnp.asarray(starts), jnp.asarray(slots),
+                        jnp.asarray(rems), jnp.asarray(seeds),
+                        jnp.asarray(tables), jnp.asarray(temps),
+                        jnp.asarray(top_ks), jnp.asarray(top_ps),
+                        jnp.asarray(stops),
+                    )
+                )
+            else:
+                toks_dev, firsts_dev, self._next_dev, self.cache = self._macro_fn(
+                    self.params, self.cache, self._next_dev,
+                    jnp.asarray(steps), jnp.asarray(has_admit), jnp.asarray(prompts),
+                    jnp.asarray(lengths), jnp.asarray(slots), jnp.asarray(rems),
+                )
         except Exception:
             # park the plan so _die can fail requests whose ONLY remaining
             # reference is this plan (admitted AND fully planned-out slots
@@ -447,7 +757,8 @@ class ContinuousBatchingEngine:
             self._pending.append(("macro", None, None, phases))
             raise
         self._record_dispatch(
-            t0, time.perf_counter(), self._macro_fn,
+            t0, time.perf_counter(),
+            self._macro_paged_fn if self.paged else self._macro_fn,
             [r for p in phases for _, r in p["admissions"]]
             + [r for p in phases for _, r, _ in p["takes"]],
         )
@@ -457,12 +768,29 @@ class ContinuousBatchingEngine:
             self._m["useful_slot_steps"] += sum(t for _, _, t in ph["takes"])
         self._pending.append(("macro", toks_dev, firsts_dev, phases))
 
+    def _repair(self) -> None:
+        """Plan repair: reconcile host bookkeeping with requests that
+        ended ahead of the speculative plan (device-side stop token,
+        cancellation, timeout). Frees their slots and KV blocks so the
+        very next _plan() can admit into them; drops finished stragglers
+        from the wait queue. Runs on the engine loop thread at plan
+        boundaries — the only place slot/block state is mutated."""
+        for s, r in enumerate(self._slots):
+            if r is not None and r.done.is_set():
+                self._slots[s] = None
+                self._free_request_blocks(r)
+        if any(r.done.is_set() for r in self._waiting):
+            self._waiting = deque(
+                r for r in self._waiting if not r.done.is_set())
+
     def _loop_macro(self) -> None:
         while self._running:
             self._drain_queue()
+            self._repair()
             if not self._waiting and not any(r is not None for r in self._slots):
                 while self._pending:
                     self._resolve(self._pending.popleft())
+                self._repair()
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
@@ -527,6 +855,7 @@ class ContinuousBatchingEngine:
     def _loop_chunked(self) -> None:
         while self._running:
             self._drain_queue()
+            self._repair()  # timeout/cancel: free the slot before admitting
             self._admit()
             active = [(s, r) for s, r in enumerate(self._slots) if r is not None]
             if not active:
@@ -615,7 +944,25 @@ class ContinuousBatchingEngine:
                 return
 
     def _deliver(self, req: _Request, toks) -> None:
-        if req._t_first is None and (req.tokens or toks):
+        if req.done.is_set():
+            # the speculative plan outran this request (stop token,
+            # cancel, timeout): these planned steps produced tokens
+            # nobody wants — the plan-and-repair bill
+            self._m["wasted_steps"] += len(toks)
+            return
+        stopped = False
+        stop_set = req.sampling.stop
+        if stop_set:
+            for i, t in enumerate(toks):
+                if t in stop_set:
+                    # truncate AT the stop: the stop token itself is not
+                    # delivered; tokens speculatively decoded beyond it
+                    # are waste
+                    self._m["wasted_steps"] += len(toks) - i - 1
+                    toks = toks[:i]
+                    stopped = True
+                    break
+        if req._t_first is None and (req.tokens or toks or stopped):
             req._t_first = time.perf_counter()
             self._ttft.observe(req._t_first - req._t_submit)
         req.tokens.extend(toks)
@@ -624,13 +971,14 @@ class ContinuousBatchingEngine:
             _engine_metrics()["tokens"].inc(len(toks), tags=self._tags)
         except Exception:
             pass
-        if len(req.tokens) >= req.max_new_tokens and not req.done.is_set():
+        if stopped or len(req.tokens) >= req.max_new_tokens:
             req._t_done = time.perf_counter()
-            if req._t_first is not None and len(req.tokens) > 1:
-                self._tpot.observe(
-                    (req._t_done - req._t_first) / (len(req.tokens) - 1)
-                )
-            _finish(req)
+            if _finish(req, reason="stop" if stopped else "length"):
+                if req._t_first is not None and len(req.tokens) > 1:
+                    self._tpot.observe(
+                        (req._t_done - req._t_first) / (len(req.tokens) - 1)
+                    )
+                self._wake.set()  # repair promptly: slot + blocks are free
 
     def _resolve(self, entry) -> None:
         """Fetch one macro-step's (or legacy chunk's) tokens — the only
@@ -690,8 +1038,8 @@ class ContinuousBatchingEngine:
             except queue.Empty:
                 break
         for req in doomed:
-            req.error = msg
-            _finish(req)
+            self._free_request_blocks(req)
+            _finish(req, error=msg)
 
     def _loop(self) -> None:
         try:

@@ -1,0 +1,192 @@
+"""Lint: the PAGED decode program must not smuggle the dense KV cache
+back in. Walks the full macro_step_slots_paged jaxpr (including
+scan/cond sub-jaxprs) and rejects any aval whose shape contains the
+(n_slots, max_len) dim pair — the signature of a slots x max_len KV
+stripe (the per-layer dense cache is (n_slots, max_len, kvh, hd); the
+stacked one adds a leading n_layers). Dims are chosen so the legal
+paged shapes can't collide: max_len=40 is NOT a multiple of
+block_size=16, so the per-layer gather workspace is (n_slots, 48, ...),
+never (n_slots, 40, ...).
+
+Plus the allocator block-leak audit companion (the engine-level one —
+the pure-allocator audit lives in test_paged_kv.py): a real engine
+serving a mixed admit/evict/prefix-hit/stop workload must return every
+non-cache block reference by the time the requests finish.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+N_SLOTS, MAX_LEN, BLOCK = 3, 40, 16  # 40 % 16 != 0 on purpose
+MB = -(-MAX_LEN // BLOCK)  # 3 blocks -> gather span 48 != 40
+N_BLOCKS = 10
+K_PHASES, A_ROWS, P_WIDTH, NS = 2, 1, 16, 4
+CHUNK = 4
+
+
+def _cfg_params():
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, attn_impl="blockwise",
+                                 remat=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _walk_avals(jaxpr):
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            if hasattr(v, "aval"):
+                yield v.aval
+        for p in eqn.params.values():
+            for sub in _sub_jaxprs(p):
+                yield from _walk_avals(sub)
+
+
+def _sub_jaxprs(p):
+    if isinstance(p, jax.core.ClosedJaxpr):
+        yield p.jaxpr
+    elif isinstance(p, jax.core.Jaxpr):
+        yield p
+    elif isinstance(p, (list, tuple)):
+        for item in p:
+            yield from _sub_jaxprs(item)
+
+
+def test_paged_macro_jaxpr_has_no_dense_cache_aval():
+    from ray_tpu.models import llama_decode as D
+
+    cfg, params = _cfg_params()
+    cache = D.init_paged_cache(cfg, N_SLOTS, N_BLOCKS, BLOCK)
+    args = (
+        params, cache,
+        jnp.zeros(N_SLOTS, jnp.int32),                       # feed
+        jnp.zeros(K_PHASES, jnp.int32),                      # steps
+        jnp.zeros(K_PHASES, bool),                           # has_admit
+        jnp.zeros((K_PHASES, A_ROWS, P_WIDTH), jnp.int32),   # prompts
+        jnp.zeros((K_PHASES, A_ROWS), jnp.int32),            # lengths
+        jnp.zeros((K_PHASES, A_ROWS), jnp.int32),            # starts
+        jnp.zeros((K_PHASES, A_ROWS), jnp.int32),            # slots
+        jnp.zeros((K_PHASES, A_ROWS), jnp.int32),            # rems
+        jnp.zeros((K_PHASES, A_ROWS), jnp.uint32),           # seeds
+        jnp.zeros((K_PHASES, N_SLOTS, MB), jnp.int32),       # tables
+        jnp.zeros((K_PHASES, N_SLOTS), jnp.float32),         # temps
+        jnp.zeros((K_PHASES, N_SLOTS), jnp.int32),           # top_ks
+        jnp.ones((K_PHASES, N_SLOTS), jnp.float32),          # top_ps
+        jnp.full((K_PHASES, N_SLOTS, NS), -1, jnp.int32),    # stop_ids
+    )
+    jaxpr = jax.make_jaxpr(
+        lambda *a: D.macro_step_slots_paged(*a, chunk=CHUNK, cfg=cfg)
+    )(*args)
+    bad = []
+    for aval in _walk_avals(jaxpr.jaxpr):
+        shape = tuple(getattr(aval, "shape", ()))
+        for i in range(len(shape) - 1):
+            if shape[i] == N_SLOTS and shape[i + 1] == MAX_LEN:
+                bad.append(shape)
+    assert not bad, (
+        f"dense (n_slots={N_SLOTS}, max_len={MAX_LEN}) KV avals survived "
+        f"behind the paged flag: {bad}"
+    )
+    # the paged pool itself IS in the program
+    pool = (cfg.n_layers, N_BLOCKS, BLOCK, cfg.n_kv_heads, cfg.head_dim)
+    assert any(tuple(getattr(a, "shape", ())) == pool
+               for a in _walk_avals(jaxpr.jaxpr)), "paged pool aval missing"
+
+
+def test_greedy_variant_has_no_sampling_pipeline():
+    """The sampled flag is a STATIC program split: the all-greedy macro
+    variant (what a default bare-list workload compiles) must contain
+    no vocab sort and no rng traffic — greedy serving pays exactly the
+    pre-sampling per-step cost. The sampled variant keeps both."""
+    from ray_tpu.models import llama_decode as D
+
+    cfg, params = _cfg_params()
+
+    def prims(sampled):
+        cache = D.init_paged_cache(cfg, N_SLOTS, N_BLOCKS, BLOCK)
+        args = (
+            params, cache, jnp.zeros(N_SLOTS, jnp.int32),
+            jnp.zeros(K_PHASES, jnp.int32), jnp.zeros(K_PHASES, bool),
+            jnp.zeros((K_PHASES, A_ROWS, P_WIDTH), jnp.int32),
+            jnp.zeros((K_PHASES, A_ROWS), jnp.int32),
+            jnp.zeros((K_PHASES, A_ROWS), jnp.int32),
+            jnp.zeros((K_PHASES, A_ROWS), jnp.int32),
+            jnp.zeros((K_PHASES, A_ROWS), jnp.int32),
+            jnp.zeros((K_PHASES, A_ROWS), jnp.uint32),
+            jnp.zeros((K_PHASES, N_SLOTS, MB), jnp.int32),
+            jnp.zeros((K_PHASES, N_SLOTS), jnp.float32),
+            jnp.zeros((K_PHASES, N_SLOTS), jnp.int32),
+            jnp.ones((K_PHASES, N_SLOTS), jnp.float32),
+            jnp.full((K_PHASES, N_SLOTS, NS), -1, jnp.int32),
+        )
+        jaxpr = jax.make_jaxpr(
+            lambda *a: D.macro_step_slots_paged(
+                *a, chunk=CHUNK, cfg=cfg, sampled=sampled)
+        )(*args)
+        names = set()
+
+        def walk(jx):
+            for eqn in jx.eqns:
+                names.add(eqn.primitive.name)
+                for p in eqn.params.values():
+                    for sub in _sub_jaxprs(p):
+                        walk(sub)
+
+        walk(jaxpr.jaxpr)
+        return names
+
+    greedy = prims(sampled=False)
+    assert not any("sort" in n for n in greedy), sorted(greedy)
+    assert not any("threefry" in n or "random" in n for n in greedy), \
+        sorted(greedy)
+    sampled = prims(sampled=True)
+    assert any("sort" in n for n in sampled)
+
+
+def test_engine_block_leak_audit_mixed_workload():
+    """Engine-level leak audit: mixed greedy / sampled / stop-token /
+    prefix-hit traffic through a REAL paged engine; after all requests
+    finish, the only live references belong to the radix cache, and
+    clearing it zeroes the allocator."""
+    from ray_tpu.models import llama, llama_decode as D
+    from ray_tpu.serve._internal.sampling import SamplingParams
+    from ray_tpu.serve.llm_engine import ContinuousBatchingEngine
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, attn_impl="blockwise",
+                                 remat=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = ContinuousBatchingEngine(params, cfg, n_slots=3, chunk=4,
+                                   macro_phases=4, max_len=64, paged=True,
+                                   block_size=8)
+    try:
+        rng = np.random.default_rng(0)
+        shared = [int(t) for t in rng.integers(1, cfg.vocab_size, size=8)]
+        w = D.generate(params, jnp.asarray([shared + [3]], jnp.int32), cfg,
+                       max_new_tokens=8)[0].tolist()
+        reqs = []
+        for i in range(10):
+            kind = i % 4
+            if kind == 0:
+                reqs.append(eng.submit(shared + [3 + i], 6))
+            elif kind == 1:
+                reqs.append(eng.submit(
+                    [int(t) for t in rng.integers(1, cfg.vocab_size, size=5)],
+                    8, sampling=SamplingParams(temperature=0.9, seed=i)))
+            elif kind == 2:
+                reqs.append(eng.submit(
+                    shared + [3], 8, sampling=SamplingParams(stop=(w[1],))))
+            else:
+                reqs.append(eng.submit([1, 2], 3))
+        for r in reqs:
+            assert r.done.wait(300), "mixed workload stalled"
+            assert r.error is None, r.error
+        # every non-cache reference returned
+        leaked = eng._alloc.leaked()
+        assert all(r == 1 for r in leaked.values()), leaked
+        assert len(leaked) == eng._prefix.nodes, (leaked, eng._prefix.nodes)
+    finally:
+        eng.shutdown()
+    eng._prefix.clear()
+    assert eng._alloc.check_zero(), eng._alloc.leaked()

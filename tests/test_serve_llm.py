@@ -253,6 +253,40 @@ def test_continuous_llm_deployment(ray_start_regular):
         serve.delete("llm_cont")
 
 
+def test_continuous_llm_deployment_sampling_request_path(ray_start_regular):
+    """Dict requests carry SamplingParams through the serve surface:
+    greedy list requests behave as before, seeded sampled requests are
+    reproducible, stop-token requests truncate — all on the paged
+    engine (the continuous default)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import llm_deployment
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, attn_impl="blockwise", remat=False)
+    app = llm_deployment(num_replicas=1, max_new_tokens=6, cfg=cfg,
+                         continuous=True, block_size=8)
+    handle = serve.run(app, name="llm_sampled")
+    try:
+        greedy = handle.remote([1, 2, 3]).result(timeout=180)
+        assert len(greedy) == 6
+        s1 = handle.remote({"prompt": [1, 2, 3], "temperature": 0.9,
+                            "seed": 11}).result(timeout=120)
+        s2 = handle.remote({"prompt": [1, 2, 3], "temperature": 0.9,
+                            "seed": 11}).result(timeout=120)
+        s3 = handle.remote({"prompt": [1, 2, 3], "temperature": 0.9,
+                            "seed": 12, "max_new_tokens": 4}).result(timeout=120)
+        assert s1 == s2 and len(s1) == 6
+        assert len(s3) == 4
+        # stop on the greedy stream's 2nd token: truncation at its
+        # FIRST occurrence in the stream
+        stopped = handle.remote({"prompt": [1, 2, 3],
+                                 "stop": [greedy[1]]}).result(timeout=120)
+        assert stopped == greedy[: greedy.index(greedy[1])], (stopped, greedy)
+    finally:
+        serve.delete("llm_sampled")
+
+
 def test_engine_latency_histograms_and_concurrent_metrics():
     """TTFT/TPOT percentiles come from the real latency histograms
     (p50/p95/p99 present, ordered, finite) and metrics() stays safe
