@@ -36,10 +36,28 @@ __all__ = [
     "get",
     "all_telemetries",
     "publish_snapshot",
+    "fetch_snapshots",
     "flush",
     "flush_async",
     "snapshot",
 ]
+
+
+def fetch_snapshots(kind: str, timeout: float = 5.0) -> Dict[str, Dict[str, Any]]:
+    """Every live reporter's latest published snapshot for `kind` from
+    the GCS telemetry table ({reporter_id12: snapshot} — the data the
+    dashboard's /api/<kind> serves; stale reporters already pruned
+    server-side). {} when no cluster is reachable. The read half of
+    publish_snapshot: consumers (the serve autoscaler, the load
+    harness) share this one contract with the table."""
+    try:
+        from ray_tpu._private.worker import get_global_core
+
+        return get_global_core().gcs_request(
+            "telemetry.get", {"kind": kind}, timeout=timeout
+        ) or {}
+    except Exception:
+        return {}
 
 # driver-side extras merged into the published snapshot per kind
 # (e.g. the trainer's per-report metrics, an engine's serving counters)

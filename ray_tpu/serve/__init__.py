@@ -19,6 +19,11 @@ from ray_tpu.serve.api import (  # noqa: F401
     shutdown,
     status,
 )
+from ray_tpu.serve import loadgen  # noqa: F401
+from ray_tpu.serve._internal.autoscaler import (  # noqa: F401
+    AffinityConfig,
+    AutoscalingConfig,
+)
 from ray_tpu.serve._internal.sampling import SamplingParams  # noqa: F401
 from ray_tpu.serve.config import build_app, deploy_config  # noqa: F401
 from ray_tpu.serve.grpc_proxy import start_grpc_proxy  # noqa: F401

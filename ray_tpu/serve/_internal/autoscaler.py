@@ -1,0 +1,292 @@
+"""Traffic-driven replica autoscaling policy.
+
+Equivalent of the reference's autoscaling policy + config
+(reference: serve/_private/autoscaling_policy.py — scale toward
+``total_ongoing_requests / target_ongoing_requests`` clamped to
+``[min_replicas, max_replicas]``; serve/config.py AutoscalingConfig).
+
+Split deliberately in two:
+
+- ``AutoscalingConfig`` — the user-facing knobs, validated ONCE at
+  ``serve.deployment()`` time (unknown keys, ``min > max``,
+  non-positive targets all raise a named ``ValueError`` instead of
+  riding silently in the deployment record until the control loop
+  trips over them).
+- ``AutoscalerState`` — the per-deployment decision engine. Pure host
+  logic over ``(now, load)`` observations: a smoothing window over
+  recent load samples, then upscale/downscale DELAY gates so bursty
+  arrivals don't flap the replica set (a decision must hold
+  continuously for the whole delay window before it fires). Every
+  method takes ``now`` explicitly, so unit tests drive synthetic
+  queue-depth traces through it with a fake clock.
+
+The controller feeds this from the PR-4 telemetry path (per-replica
+queue depth + in-flight counts published into the ``serve`` snapshot,
+the same table ``/api/serve`` serves) — the autoscaler never calls
+into a replica synchronously.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import deque
+from typing import Any, Dict, Optional
+
+# every key a user may put in autoscaling_config
+_CONFIG_KEYS = (
+    "min_replicas",
+    "max_replicas",
+    "initial_replicas",
+    "target_ongoing_requests",
+    "upscale_delay_s",
+    "downscale_delay_s",
+    "metrics_window_s",
+    "upscale_smoothing_factor",
+    "downscale_smoothing_factor",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalingConfig:
+    """Queue-depth autoscaling knobs (reference: serve AutoscalingConfig).
+
+    target_ongoing_requests: per-replica load the policy steers toward —
+        desired replicas = ceil(total_load / target).
+    upscale_delay_s / downscale_delay_s: how long a scale decision must
+        hold CONTINUOUSLY before it fires (flap guard; downscale is
+        slower by default so a burst's tail doesn't thrash).
+    metrics_window_s: load samples are averaged over this window before
+        the policy sees them (smoothing against sampling noise).
+    upscale/downscale_smoothing_factor: fraction of the replica-count
+        gap closed per decision (1.0 = jump straight to desired).
+    min_replicas may be 0 (scale-to-zero): handles then PARK incoming
+        requests and nudge the controller, which scales back to 1.
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    initial_replicas: Optional[int] = None
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 8.0
+    metrics_window_s: float = 3.0
+    upscale_smoothing_factor: float = 1.0
+    downscale_smoothing_factor: float = 1.0
+
+    def __post_init__(self):
+        if self.min_replicas < 0:
+            raise ValueError(
+                f"autoscaling_config: min_replicas must be >= 0, got "
+                f"{self.min_replicas}"
+            )
+        if self.max_replicas < 1:
+            raise ValueError(
+                f"autoscaling_config: max_replicas must be >= 1, got "
+                f"{self.max_replicas}"
+            )
+        if self.min_replicas > self.max_replicas:
+            raise ValueError(
+                f"autoscaling_config: min_replicas ({self.min_replicas}) > "
+                f"max_replicas ({self.max_replicas})"
+            )
+        if self.initial_replicas is not None and not (
+            self.min_replicas <= self.initial_replicas <= self.max_replicas
+        ):
+            raise ValueError(
+                f"autoscaling_config: initial_replicas "
+                f"({self.initial_replicas}) outside "
+                f"[{self.min_replicas}, {self.max_replicas}]"
+            )
+        if self.target_ongoing_requests <= 0:
+            raise ValueError(
+                f"autoscaling_config: target_ongoing_requests must be "
+                f"positive, got {self.target_ongoing_requests}"
+            )
+        for knob in ("upscale_delay_s", "downscale_delay_s", "metrics_window_s"):
+            if getattr(self, knob) < 0:
+                raise ValueError(
+                    f"autoscaling_config: {knob} must be >= 0, got "
+                    f"{getattr(self, knob)}"
+                )
+        for knob in ("upscale_smoothing_factor", "downscale_smoothing_factor"):
+            if not (0.0 < getattr(self, knob) <= 1.0):
+                raise ValueError(
+                    f"autoscaling_config: {knob} must be in (0, 1], got "
+                    f"{getattr(self, knob)}"
+                )
+
+    @property
+    def start_replicas(self) -> int:
+        if self.initial_replicas is not None:
+            return self.initial_replicas
+        return max(self.min_replicas, 1)
+
+
+def validate_autoscaling_config(cfg: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Validate a user autoscaling_config dict at deployment() time.
+
+    Returns the normalized dict (defaults filled in, JSON-safe) or None.
+    Raises ValueError naming the offending key — never lets a bad config
+    ride silently in the deployment record.
+    """
+    if cfg is None:
+        return None
+    if not isinstance(cfg, dict):
+        raise ValueError(
+            f"autoscaling_config must be a dict, got {type(cfg).__name__}"
+        )
+    unknown = set(cfg) - set(_CONFIG_KEYS)
+    if unknown:
+        raise ValueError(
+            f"autoscaling_config: unknown key(s) {sorted(unknown)}; valid "
+            f"keys: {sorted(_CONFIG_KEYS)}"
+        )
+    return dataclasses.asdict(AutoscalingConfig(**cfg))
+
+
+# ---------------------------------------------------------------- affinity
+_AFFINITY_KEYS = ("prefix_len", "spill_threshold", "vnodes", "mode")
+
+
+@dataclasses.dataclass(frozen=True)
+class AffinityConfig:
+    """Cache-affinity routing knobs (handle/proxy consistent-hash ring).
+
+    prefix_len: how much of the prompt feeds the affinity digest —
+        leading tokens for list prompts, leading characters for string
+        prompts. Must cover the shared system prompt for repeat traffic
+        to land on the cache-hot replica.
+    spill_threshold: outstanding requests on the preferred replica at
+        which routing spills to least-loaded instead (cache affinity
+        must not become a hotspot amplifier).
+    vnodes: virtual nodes per replica on the hash ring (built once per
+        membership refresh; more = smoother key redistribution).
+    mode: "auto" (session_id when the request carries one, else prompt
+        prefix), "session" (session_id only), "prefix" (prompt only).
+    """
+
+    prefix_len: int = 32
+    spill_threshold: int = 8
+    vnodes: int = 32
+    mode: str = "auto"
+
+    def __post_init__(self):
+        if self.prefix_len < 1:
+            raise ValueError(
+                f"affinity_config: prefix_len must be >= 1, got {self.prefix_len}"
+            )
+        if self.spill_threshold < 1:
+            raise ValueError(
+                f"affinity_config: spill_threshold must be >= 1, got "
+                f"{self.spill_threshold}"
+            )
+        if self.vnodes < 1:
+            raise ValueError(
+                f"affinity_config: vnodes must be >= 1, got {self.vnodes}"
+            )
+        if self.mode not in ("auto", "session", "prefix"):
+            raise ValueError(
+                f"affinity_config: mode must be one of 'auto', 'session', "
+                f"'prefix', got {self.mode!r}"
+            )
+
+
+def validate_affinity_config(cfg: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Validate a user affinity_config dict at deployment() time."""
+    if cfg is None:
+        return None
+    if not isinstance(cfg, dict):
+        raise ValueError(
+            f"affinity_config must be a dict, got {type(cfg).__name__}"
+        )
+    unknown = set(cfg) - set(_AFFINITY_KEYS)
+    if unknown:
+        raise ValueError(
+            f"affinity_config: unknown key(s) {sorted(unknown)}; valid "
+            f"keys: {sorted(_AFFINITY_KEYS)}"
+        )
+    return dataclasses.asdict(AffinityConfig(**cfg))
+
+
+# ------------------------------------------------------------ decision state
+class AutoscalerState:
+    """Per-deployment autoscaling decision engine.
+
+    ``decide(total_load, current, now)`` is the whole protocol: feed it
+    the deployment's summed load (queue depth + in-flight across
+    replicas) and the current replica count; it returns the replica
+    count to scale to (== current when no change should happen yet).
+
+    Flap guard: raw desired != current starts a directional timer; the
+    decision fires only after desired stays on that side of current for
+    the full up/downscale delay. Any tick where the direction flips (or
+    equals current) resets the timers, so an oscillating load signal
+    holds the replica set steady instead of thrashing it.
+    """
+
+    def __init__(self, cfg: AutoscalingConfig):
+        if isinstance(cfg, dict):
+            cfg = AutoscalingConfig(**cfg)
+        self.cfg = cfg
+        self._window: deque = deque()  # (now, load) samples
+        self._above_since: Optional[float] = None
+        self._below_since: Optional[float] = None
+        # decision bookkeeping for introspection / status endpoints
+        self.last_load: float = 0.0
+        self.last_desired: int = 0
+
+    # -- observations ---------------------------------------------------
+    def _observe(self, load: float, now: float) -> float:
+        """Append a sample, trim the window, return the smoothed load."""
+        self._window.append((now, float(load)))
+        horizon = now - self.cfg.metrics_window_s
+        while self._window and self._window[0][0] < horizon:
+            self._window.popleft()
+        return sum(s for _, s in self._window) / len(self._window)
+
+    # -- policy ---------------------------------------------------------
+    def _raw_desired(self, avg_load: float, current: int) -> int:
+        """ceil(load/target), smoothing factors applied to the delta,
+        clamped to [min, max]."""
+        cfg = self.cfg
+        want = math.ceil(avg_load / cfg.target_ongoing_requests - 1e-9)
+        if want > current:
+            step = math.ceil((want - current) * cfg.upscale_smoothing_factor)
+            want = current + max(1, step)
+        elif want < current:
+            step = math.ceil((current - want) * cfg.downscale_smoothing_factor)
+            want = current - max(1, step)
+        return max(cfg.min_replicas, min(cfg.max_replicas, want))
+
+    def decide(self, total_load: float, current: int, now: float) -> int:
+        """One autoscaler tick. Returns the target replica count."""
+        avg = self._observe(total_load, now)
+        desired = self._raw_desired(avg, current)
+        self.last_load = avg
+        self.last_desired = desired
+        if desired > current:
+            self._below_since = None
+            if self._above_since is None:
+                self._above_since = now
+            if now - self._above_since >= self.cfg.upscale_delay_s:
+                self._above_since = None
+                return desired
+            return current
+        if desired < current:
+            self._above_since = None
+            if self._below_since is None:
+                self._below_since = now
+            if now - self._below_since >= self.cfg.downscale_delay_s:
+                self._below_since = None
+                return desired
+            return current
+        self._above_since = None
+        self._below_since = None
+        return current
+
+    def reset(self) -> None:
+        """Forget history (called after an external scale event such as
+        a redeploy, so stale samples don't drive the next decision)."""
+        self._window.clear()
+        self._above_since = None
+        self._below_since = None

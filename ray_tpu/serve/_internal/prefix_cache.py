@@ -194,4 +194,8 @@ class RadixPrefixCache:
             "prefix_cache_misses": self.misses,
             "prefix_cache_evictions": self.evictions,
             "prefix_cache_hit_rate": round(self.hit_tokens / total, 4),
+            # raw token counters so multi-replica aggregation can compute
+            # a token-weighted hit rate instead of averaging ratios
+            "prefix_cache_hit_tokens": self.hit_tokens,
+            "prefix_cache_lookup_tokens": self.lookup_tokens,
         }

@@ -59,7 +59,13 @@ class Deployment:
         ray_actor_options: Optional[dict] = None,
         max_ongoing_requests: int = 16,
         autoscaling_config: Optional[dict] = None,
+        affinity_config: Optional[dict] = None,
     ):
+        from ray_tpu.serve._internal.autoscaler import (
+            validate_affinity_config,
+            validate_autoscaling_config,
+        )
+
         self._callable = cls_or_fn
         self.name = name or getattr(cls_or_fn, "__name__", "deployment")
         self.num_replicas = num_replicas
@@ -67,9 +73,16 @@ class Deployment:
         self.ray_actor_options = ray_actor_options or {}
         self.max_ongoing_requests = max_ongoing_requests
         # {"min_replicas", "max_replicas", "target_ongoing_requests",
-        #  "initial_replicas"} — queue-depth autoscaling
-        # (reference: serve autoscaling_config on @serve.deployment)
-        self.autoscaling_config = autoscaling_config
+        #  "initial_replicas", delay/smoothing knobs} — traffic-driven
+        # autoscaling (reference: serve autoscaling_config on
+        # @serve.deployment). Validated HERE: unknown keys, min > max or
+        # non-positive targets raise at deployment() time, not after the
+        # record already shipped to the controller.
+        self.autoscaling_config = validate_autoscaling_config(autoscaling_config)
+        # {"prefix_len", "spill_threshold", "vnodes", "mode"} —
+        # cache-affinity routing: same-prefix/same-session traffic
+        # consistently hashes to the replica whose radix cache is hot
+        self.affinity_config = validate_affinity_config(affinity_config)
 
     def options(self, **kw) -> "Deployment":
         merged = dict(
@@ -79,6 +92,7 @@ class Deployment:
             ray_actor_options=self.ray_actor_options,
             max_ongoing_requests=self.max_ongoing_requests,
             autoscaling_config=self.autoscaling_config,
+            affinity_config=self.affinity_config,
         )
         merged.update(kw)
         return Deployment(self._callable, **merged)
@@ -135,6 +149,7 @@ def _deploy_tree(controller, app_name: str, app: Application, *, is_root: bool,
             dep.ray_actor_options,
             dep.autoscaling_config,
             bool(getattr(dep._callable, "__serve_is_ingress__", False)),
+            dep.affinity_config,
         )
     )
     seen[id(app)] = dep.name

@@ -14,9 +14,20 @@ Config shape (dict, or YAML text/file path)::
         import_path: my_module:app    # module:attr -> bound Application
         deployments:                  # optional per-deployment overrides
           - name: Model
-            num_replicas: 2
+            num_replicas: 2           # ignored once autoscaling is on
             ray_actor_options: {num_cpus: 1}
-            autoscaling_config: {min_replicas: 1, max_replicas: 4}
+            # traffic-driven autoscaling (consumed by the controller's
+            # control loop; validated at deployment() time — see
+            # serve/_internal/autoscaler.py for every knob):
+            autoscaling_config:
+              min_replicas: 1
+              max_replicas: 4
+              target_ongoing_requests: 2
+              upscale_delay_s: 2.0
+              downscale_delay_s: 8.0
+            # cache-affinity routing (prompt-prefix / session_id
+            # consistent hashing with spill-to-least-loaded):
+            affinity_config: {prefix_len: 32, spill_threshold: 8}
 """
 from __future__ import annotations
 

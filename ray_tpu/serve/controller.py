@@ -22,13 +22,40 @@ import ray_tpu
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
+# replica stats older than this are treated as missing by the autoscaler
+# (a hung replica must not pin the load average with its last snapshot)
+STATS_STALE_S = 5.0
+# how recently a handle must have reported starvation (zero replicas,
+# parked requests) for the autoscaler to scale a 0-replica deployment up
+STARVED_WINDOW_S = 5.0
+
+
+def _fetch_replica_stats() -> Dict[str, Dict[str, Any]]:
+    """Merged per-replica load stats from the GCS `serve` telemetry
+    table — the same last-write-wins-per-reporter snapshots `/api/serve`
+    serves (each Replica publishes `replica:<name>` entries from its own
+    process). ONE GCS round trip (observability.fetch_snapshots) covers
+    every replica of every deployment; the autoscaler never calls into a
+    replica synchronously.
+    """
+    from ray_tpu.observability import fetch_snapshots
+
+    out: Dict[str, Dict[str, Any]] = {}
+    for snap in fetch_snapshots("serve", timeout=2.0).values():
+        if not isinstance(snap, dict):
+            continue
+        for key, val in snap.items():
+            if isinstance(key, str) and key.startswith("replica:") and isinstance(val, dict):
+                out[key[len("replica:"):]] = val
+    return out
+
 
 @ray_tpu.remote(max_concurrency=16)
 class Replica:
     """Wraps one instance of the user's deployment class
     (reference: serve/_private/replica.py)."""
 
-    def __init__(self, cls_or_fn, init_args, init_kwargs):
+    def __init__(self, cls_or_fn, init_args, init_kwargs, replica_name=None):
         import inspect
         import threading
 
@@ -52,6 +79,66 @@ class Replica:
         self.num_requests = 0
         self._ongoing = 0
         self._ongoing_lock = threading.Lock()
+        self._name = replica_name
+        if replica_name:
+            # stat reporter: queue depth + in-flight counts ride the
+            # PR-4 telemetry path into the GCS `serve` table (and thus
+            # /api/serve), where the controller's autoscaler reads them
+            # — no synchronous controller→replica stat RPCs
+            t = threading.Thread(
+                target=self._report_loop, daemon=True, name="serve-replica-stats"
+            )
+            t.start()
+
+    def _instance_load(self) -> float:
+        """Deployment-reported load (e.g. the LLM engine's queued +
+        resident request count via `__serve_load__`), 0 when the
+        deployment doesn't expose one."""
+        fn = getattr(self.instance, "__serve_load__", None)
+        if fn is None:
+            return 0.0
+        try:
+            return float(fn())
+        except Exception:
+            return 0.0
+
+    def _load(self) -> float:
+        """The autoscaling load signal. Deployments that track their own
+        request lifecycle (async engines completing requests after
+        handle_request returns) report through `__serve_load__` and that
+        number IS the load — summing it with `_ongoing` would double-
+        count the blocking-path requests that appear in both."""
+        inst = self._instance_load()
+        return inst if inst > 0 else float(self._ongoing)
+
+    def _report_loop(self, period_s: float = 0.5, idle_period_s: float = 2.0):
+        from ray_tpu import observability
+
+        key = f"replica:{self._name}"
+        last_sig = None
+        while True:
+            period = period_s
+            try:
+                payload = {
+                    "t": time.time(),
+                    "load": self._load(),
+                    "ongoing": self._ongoing,
+                    "queued": self._instance_load(),
+                    "num_requests": self.num_requests,
+                }
+                # idle backoff: an unchanged zero-load signal still
+                # publishes (the autoscaler treats >5s-stale stats as
+                # missing, which would BLOCK downscale-to-min) but at a
+                # quarter of the active rate — R idle replicas stop
+                # costing 2R GCS pushes/s
+                sig = (payload["load"], payload["queued"], payload["num_requests"])
+                if sig == last_sig and payload["load"] == 0:
+                    period = idle_period_s
+                last_sig = sig
+                observability.publish_snapshot("serve", {key: payload})
+            except Exception:
+                pass
+            time.sleep(period)
 
     def handle_request(self, method: str, args, kwargs):
         with self._ongoing_lock:
@@ -85,7 +172,12 @@ class Replica:
         return True
 
     def stats(self):
-        return {"num_requests": self.num_requests, "ongoing": self._ongoing}
+        return {
+            "num_requests": self.num_requests,
+            "ongoing": self._ongoing,
+            "queued": self._instance_load(),
+            "load": self._load(),
+        }
 
 
 @ray_tpu.remote
@@ -105,6 +197,9 @@ class ServeControllerActor:
         self._versions: Dict[str, int] = {}
         self._events: Dict[str, Any] = {}
         self._loop_started = False
+        # per-deployment autoscaler decision state (flap-guard timers +
+        # smoothing windows), reset on redeploy
+        self._autoscalers: Dict[tuple, Any] = {}
 
     # ------------------------------------------------------------ long poll
     def _bump(self, key: str):
@@ -156,7 +251,16 @@ class ServeControllerActor:
             return dict(self.routes)
         if key.startswith("replicas::"):
             _, app, dep = key.split("::", 2)
-            return self.apps.get(app, {}).get(dep, {}).get("replicas", [])
+            rec = self.apps.get(app, {}).get(dep)
+            if rec is None:
+                return []
+            # membership + routing config in one long-poll payload, so a
+            # handle learns the deployment's affinity policy the same
+            # push that tells it which replicas exist
+            return {
+                "replicas": list(rec["replicas"]),
+                "affinity": rec.get("affinity"),
+            }
         return None
 
     # ------------------------------------------------------------ deploy
@@ -172,10 +276,21 @@ class ServeControllerActor:
         ray_actor_options: Optional[dict] = None,
         autoscaling_config: Optional[dict] = None,
         is_ingress: bool = False,
+        affinity_config: Optional[dict] = None,
     ):
         import cloudpickle
 
+        from ray_tpu.serve._internal.autoscaler import (
+            AutoscalingConfig,
+            validate_affinity_config,
+            validate_autoscaling_config,
+        )
+
         cls = cloudpickle.loads(cls_blob)
+        # normalize here too (defense in depth — serve.deployment()
+        # already validated, but the controller RPC is also a surface)
+        autoscaling_config = validate_autoscaling_config(autoscaling_config)
+        affinity_config = validate_affinity_config(affinity_config)
         app = self.apps.setdefault(app_name, {})
         old = app.get(deployment_name)
         rec = {
@@ -187,13 +302,17 @@ class ServeControllerActor:
             "route_prefix": route_prefix,
             "ray_actor_options": dict(ray_actor_options or {}),
             "autoscaling": autoscaling_config,
+            "affinity": affinity_config,
             "is_ingress": is_ingress,
             "deploy_time": time.time(),
         }
+        # fresh decision state on EVERY redeploy (also when autoscaling
+        # was just turned off — status() must stop reporting the stale
+        # autoscaler block): old flap-guard timers and load samples must
+        # not drive the first decisions against the new replica set
+        self._autoscalers.pop((app_name, deployment_name), None)
         if autoscaling_config:
-            rec["num_replicas"] = autoscaling_config.get(
-                "initial_replicas", autoscaling_config.get("min_replicas", 1)
-            )
+            rec["num_replicas"] = AutoscalingConfig(**autoscaling_config).start_replicas
         # stage new replicas BEFORE committing the record: a failed deploy
         # (e.g. __init__ raises) must leave the previous version serving
         import asyncio
@@ -236,7 +355,8 @@ class ServeControllerActor:
         self._bump(f"replicas::{app_name}::{deployment_name}")
         return True
 
-    def _scale_to(self, app_name: str, deployment_name: str, target: int, rec=None):
+    def _scale_to(self, app_name: str, deployment_name: str, target: int,
+                  rec=None, loads: Optional[Dict[str, float]] = None):
         import asyncio
 
         rec = rec if rec is not None else self.apps[app_name][deployment_name]
@@ -248,27 +368,54 @@ class ServeControllerActor:
             # (reference: serve/_private/deployment_scheduler.py)
             opts = self._scheduler.place(name, rec["ray_actor_options"])
             Replica.options(name=name, max_concurrency=16, **opts).remote(
-                rec["cls"], rec["init_args"], rec["init_kwargs"]
+                rec["cls"], rec["init_args"], rec["init_kwargs"], name
             )
             cur.append(name)
-        while len(cur) > target:
-            name = cur.pop()
-            # drain before killing: the replica may still be serving
-            # accepted requests (reference: graceful_shutdown_wait_loop_s)
-            asyncio.ensure_future(self._drain_and_kill(name))
+        if len(cur) > target:
+            # victim selection: least-loaded first (shortest drain, and
+            # the requests it strands are fewest), newest first on ties
+            # (the oldest replicas carry the hottest radix caches —
+            # affinity traffic keeps landing there)
+            n_kill = len(cur) - target
+            victims = self._scheduler.downscale_order(cur, loads)[:n_kill]
+            for name in victims:
+                cur.remove(name)
+                # drain before killing: the replica may still be serving
+                # accepted requests (reference:
+                # graceful_shutdown_wait_loop_s)
+                asyncio.ensure_future(self._drain_and_kill(name))
         rec["replicas"] = cur
         rec["num_replicas"] = target
 
-    async def _drain_and_kill(self, name: str, timeout_s: float = 15.0):
+    async def _drain_and_kill(self, name: str, timeout_s: Optional[float] = None):
         import asyncio
 
+        if timeout_s is None:
+            # the cap exists for WEDGED replicas, not as a routine drop
+            # window: autoscaler downscales are an everyday event, so a
+            # request merely slower than the cap (long generation, cold
+            # compile) must survive it — 60s default, env-overridable
+            import os
+
+            timeout_s = float(
+                os.environ.get("RAY_TPU_SERVE_DRAIN_TIMEOUT_S", "60.0")
+            )
         deadline = time.monotonic() + timeout_s
         try:
             h = ray_tpu.get_actor(name)
             while time.monotonic() < deadline:
                 stats = await h.stats.remote()
-                if stats["ongoing"] == 0:
-                    break
+                # queued covers async engines whose requests outlive
+                # handle_request (in-flight work handle_request already
+                # returned from must finish before the kill)
+                if stats["ongoing"] == 0 and stats.get("queued", 0) == 0:
+                    # double-check after a grace beat: a request routed
+                    # in the membership-swap window may still be in
+                    # transit toward this replica
+                    await asyncio.sleep(0.3)
+                    stats = await h.stats.remote()
+                    if stats["ongoing"] == 0 and stats.get("queued", 0) == 0:
+                        break
                 await asyncio.sleep(0.25)
         except Exception:
             pass
@@ -280,41 +427,108 @@ class ServeControllerActor:
 
     # ------------------------------------------------------ autoscale loop
     async def run_control_loop(self, period_s: float = 1.0):
-        """Queue-depth autoscaling (fire-and-forget from serve.run)."""
+        """Traffic-driven autoscaling (fire-and-forget from serve.run).
+
+        Each tick makes ONE GCS round trip for the merged per-replica
+        stat snapshots (published by the replicas themselves through the
+        telemetry path — the loop never calls into a replica
+        synchronously, so a wedged replica can't stall scaling for the
+        whole cluster), then runs every autoscaled deployment's policy
+        on host-side state only."""
         import asyncio
 
         if self._loop_started:
             return
         self._loop_started = True
+        loop = asyncio.get_running_loop()
         while True:
             await asyncio.sleep(period_s)
-            for app_name, deps in list(self.apps.items()):
-                for dep_name, rec in list(deps.items()):
-                    cfg = rec.get("autoscaling")
-                    if not cfg:
-                        continue
-                    try:
-                        await self._autoscale_one(app_name, dep_name, rec, cfg)
-                    except Exception:
-                        import logging
+            targets = [
+                (app_name, dep_name, rec)
+                for app_name, deps in list(self.apps.items())
+                for dep_name, rec in list(deps.items())
+                if rec.get("autoscaling")
+            ]
+            if not targets:
+                continue
+            # blocking GCS RPC off the actor's event loop
+            stats = await loop.run_in_executor(None, _fetch_replica_stats)
+            now = time.time()
+            for app_name, dep_name, rec in targets:
+                try:
+                    self._autoscale_one(app_name, dep_name, rec, stats, now)
+                except Exception:
+                    import logging
 
-                        logging.getLogger("ray_tpu.serve").warning(
-                            "autoscale cycle failed for %s::%s", app_name, dep_name, exc_info=True
-                        )
+                    logging.getLogger("ray_tpu.serve").warning(
+                        "autoscale cycle failed for %s::%s", app_name, dep_name, exc_info=True
+                    )
 
-    async def _autoscale_one(self, app_name, dep_name, rec, cfg):
-        import asyncio
+    def _autoscale_one(self, app_name, dep_name, rec, stats, now):
+        """One deployment's autoscaling decision — synchronous, fed
+        entirely from the telemetry snapshot (`stats`): no replica RPCs,
+        no awaits. Scale-downs hand the policy's per-replica loads to
+        the scheduler so the least-loaded replicas drain first."""
+        from ray_tpu.serve._internal.autoscaler import AutoscalerState
 
-        stats = await asyncio.gather(
-            *(ray_tpu.get_actor(n).stats.remote() for n in rec["replicas"])
-        )
-        ongoing = sum(s["ongoing"] for s in stats)
-        target_per = max(1e-6, cfg.get("target_ongoing_requests", 2))
-        desired = int(ongoing / target_per + 0.999)
-        desired = max(cfg.get("min_replicas", 1), min(cfg.get("max_replicas", 8), desired))
-        if desired != len(rec["replicas"]):
-            self._scale_to(app_name, dep_name, desired)
+        key = (app_name, dep_name)
+        state = self._autoscalers.get(key)
+        if state is None:
+            state = self._autoscalers[key] = AutoscalerState(rec["autoscaling"])
+        cfg = state.cfg
+        current = len(rec["replicas"])
+        if current == 0:
+            # scaled to zero: handles PARK requests and report
+            # starvation; a recent report is the demand signal that
+            # wakes the deployment back up
+            if cfg.min_replicas > 0 or (
+                now - rec.get("starved_at", 0.0) <= STARVED_WINDOW_S
+            ):
+                self._scale_to(app_name, dep_name, max(cfg.min_replicas, 1))
+                state.reset()
+                self._bump(f"replicas::{app_name}::{dep_name}")
+            return
+        loads: Dict[str, float] = {}
+        total = 0.0
+        for name in rec["replicas"]:
+            s = stats.get(name)
+            if s and now - float(s.get("t", 0.0)) <= STATS_STALE_S:
+                load = float(s.get("load", 0.0))
+            else:
+                # missing/stale stats are NEUTRAL: the replica counts as
+                # exactly at target, so absent data never drives a scale
+                # decision in either direction
+                load = cfg.target_ongoing_requests
+            loads[name] = load
+            total += load
+        desired = state.decide(total, current, now)
+        if desired != current:
+            self._scale_to(app_name, dep_name, desired, loads=loads)
             self._bump(f"replicas::{app_name}::{dep_name}")
+        try:
+            from ray_tpu import observability
+
+            observability.publish_snapshot("serve", {
+                f"autoscaler:{app_name}::{dep_name}": {
+                    "t": now,
+                    "replicas": len(rec["replicas"]),
+                    "load": round(state.last_load, 3),
+                    "desired": state.last_desired,
+                    "min_replicas": cfg.min_replicas,
+                    "max_replicas": cfg.max_replicas,
+                    "target_ongoing_requests": cfg.target_ongoing_requests,
+                }
+            })
+        except Exception:
+            pass
+
+    async def notify_starved(self, app_name: str, dep_name: str):
+        """A handle is parking requests against an empty replica set —
+        the scale-from-zero demand signal (rate-limited caller-side)."""
+        rec = self.apps.get(app_name, {}).get(dep_name)
+        if rec is not None:
+            rec["starved_at"] = time.time()
+        return True
 
     # ------------------------------------------------------------- queries
     async def get_replicas_versioned(self, app_name: str, deployment_name: str):
@@ -336,6 +550,8 @@ class ServeControllerActor:
         app = self.apps.pop(app_name, None)
         if not app:
             return False
+        for key in [k for k in self._autoscalers if k[0] == app_name]:
+            self._autoscalers.pop(key, None)
         for dep_name, dep in app.items():
             for name in dep["replicas"]:
                 try:
@@ -351,12 +567,22 @@ class ServeControllerActor:
     async def status(self) -> Dict[str, Any]:
         out = {}
         for app_name, deps in self.apps.items():
-            out[app_name] = {
-                name: {
+            out[app_name] = {}
+            for name, d in deps.items():
+                entry = {
                     "num_replicas": len(d["replicas"]),
                     "route_prefix": d["route_prefix"],
                     "autoscaling": bool(d.get("autoscaling")),
                 }
-                for name, d in deps.items()
-            }
+                state = self._autoscalers.get((app_name, name))
+                if state is not None:
+                    entry["autoscaler"] = {
+                        "load": round(state.last_load, 3),
+                        "desired": state.last_desired,
+                        "min_replicas": state.cfg.min_replicas,
+                        "max_replicas": state.cfg.max_replicas,
+                    }
+                if d.get("affinity"):
+                    entry["affinity"] = dict(d["affinity"])
+                out[app_name][name] = entry
         return out

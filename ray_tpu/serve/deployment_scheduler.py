@@ -91,6 +91,18 @@ class DeploymentScheduler:
     def forget(self, replica_name: str) -> None:
         self._placed.pop(replica_name, None)
 
+    @staticmethod
+    def downscale_order(names: List[str], loads: Optional[Dict[str, float]] = None) -> List[str]:
+        """Victim order for a scale-down: least-loaded first (fewest
+        stranded requests, shortest drain), newest first on ties — the
+        oldest replicas have the hottest caches and the affinity ring
+        keeps steering repeat traffic at them, so they die last."""
+        ranked = sorted(
+            enumerate(names),
+            key=lambda item: ((loads or {}).get(item[1], 0.0), -item[0]),
+        )
+        return [name for _, name in ranked]
+
     def drain_groups(self, replica_names: List[str]) -> List[List[str]]:
         """Group replicas by node for node-by-node draining; replicas with
         no tracked node drain last, together."""

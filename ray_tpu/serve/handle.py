@@ -7,12 +7,35 @@ replicas, send to the one with fewer outstanding requests; replica-set
 freshness via long-poll, serve/_private/long_poll.py LongPollClient —
 the controller pushes membership changes the moment they happen instead
 of the handle polling or waiting for a routing failure).
+
+Cache-affinity routing: when the deployment carries an
+``affinity_config``, the membership push also builds a consistent-hash
+ring (``vnodes`` virtual points per replica, hashed ONCE per refresh).
+Each request then takes one digest of its prompt prefix (or explicit
+``session_id``) and one bisect on the ring — repeat traffic lands on
+the replica whose radix prefix cache is already hot, and a membership
+change only remaps the keys that lived on the changed replicas. When
+the preferred replica's outstanding count exceeds ``spill_threshold``
+the request falls back to power-of-two least-loaded (affinity must not
+amplify a hotspot); hits/spills/misses are counted per handle
+(``routing_stats()``).
+
+Zero-replica windows (scale-to-zero, a scale-down refresh mid-swap)
+PARK the request: ``_reserve`` waits on the membership condition until
+the next long-poll bump repopulates the replica set, bounded by
+``no_replica_timeout_s`` with an actionable error. An empty set also
+pings the controller (rate-limited) — the scale-from-zero demand
+signal.
 """
 from __future__ import annotations
 
+import bisect
+import hashlib
 import logging
+import os
 import random
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
@@ -66,13 +89,35 @@ class DeploymentHandle:
         self._outstanding: Dict[str, int] = {}  # replica name -> in flight
         self._version = 0
         self._lock = threading.Lock()
+        # membership condition: parked requests (zero-replica window)
+        # wake on the long-poll bump that repopulates the replica set
+        self._member_cv = threading.Condition(self._lock)
         self._method = "__call__"
         self._model_id = ""  # multiplexing: routes with model affinity
         self._poller: Optional[threading.Thread] = None
         self._closed = False
+        # cache-affinity routing state (all rebuilt per membership push)
+        self._affinity: Optional[Dict[str, Any]] = None
+        self._ring_points: List[int] = []   # sorted vnode hash points
+        self._ring_names: List[str] = []    # replica name per ring point
+        self._name_to_idx: Dict[str, int] = {}
+        self._astats = {"hits": 0, "spills": 0, "misses": 0}
+        self._last_starve_ping = 0.0
+        self.no_replica_timeout_s = float(
+            os.environ.get("RAY_TPU_SERVE_NO_REPLICA_TIMEOUT_S", "30.0")
+        )
 
     # -- replica set management ----------------------------------------
-    def _apply_replicas(self, names: List[str], version: int):
+    def _apply_replicas(self, data, version: int):
+        # payload forms: {"replicas": [...], "affinity": cfg|None} from
+        # the controller, or a bare name list (legacy/tests — keeps the
+        # current affinity config)
+        if isinstance(data, dict):
+            names = list(data.get("replicas") or ())
+            affinity = data.get("affinity")
+        else:
+            names = list(data or ())
+            affinity = self._affinity
         handles, ok_names, submits = [], [], []
         for name in names:
             try:
@@ -95,7 +140,19 @@ class DeploymentHandle:
             # (the fast path negotiates lazily per (caller, replica) and
             # falls back to RPC whenever the transport refuses)
             submits.append(h.handle_request.options(direct=True))
-        with self._lock:
+        # consistent-hash ring built ONCE per membership change: vnode
+        # hashing happens here so the per-request affinity path is one
+        # prefix digest + one bisect, nothing else
+        ring: List[tuple] = []
+        if affinity and ok_names:
+            for name in ok_names:
+                for v in range(affinity.get("vnodes", 32)):
+                    point = int.from_bytes(
+                        hashlib.md5(f"{name}#{v}".encode()).digest()[:8], "big"
+                    )
+                    ring.append((point, name))
+            ring.sort()
+        with self._member_cv:
             old = self._outstanding
             # parallel lists stay index-aligned even when some names
             # failed to resolve (names/handles previously diverged)
@@ -108,6 +165,13 @@ class DeploymentHandle:
             # membership change
             self._outstanding = {n: old.get(n, 0) for n in ok_names}
             self._version = version
+            self._affinity = affinity
+            self._ring_points = [p for p, _ in ring]
+            self._ring_names = [n for _, n in ring]
+            self._name_to_idx = {n: i for i, n in enumerate(ok_names)}
+            # wake parked requests: the zero-replica window just closed
+            if ok_names:
+                self._member_cv.notify_all()
 
     def _refresh(self):
         from ray_tpu.serve.api import _get_controller
@@ -160,6 +224,11 @@ class DeploymentHandle:
             h._submits = list(self._submits)
             h._outstanding = dict(self._outstanding)
             h._version = self._version
+            h._affinity = self._affinity
+            h._ring_points = list(self._ring_points)
+            h._ring_names = list(self._ring_names)
+            h._name_to_idx = dict(self._name_to_idx)
+            h.no_replica_timeout_s = self.no_replica_timeout_s
         if h._replicas:
             # the snapshot needs its own long-poll subscription or it
             # would route to killed replicas after the next redeploy
@@ -191,21 +260,136 @@ class DeploymentHandle:
         na, nb = self._replica_names[a], self._replica_names[b]
         return a if self._outstanding.get(na, 0) <= self._outstanding.get(nb, 0) else b
 
-    def _reserve(self):
+    def _affinity_digest(self, args: tuple) -> Optional[int]:
+        """The ONE per-request hash of the affinity routing path: digest
+        the request's session id (when present) or prompt prefix into a
+        ring point. Returns None when affinity is off or the request has
+        no routable key (counted as a miss by _reserve)."""
+        cfg = self._affinity
+        if not cfg:
+            return None
+        req = args[0] if args else None
+        if self._method == "__serve_http_request__" and len(args) >= 3:
+            req = args[2]  # ingress form: (http_method, subpath, body, query)
+        mode = cfg.get("mode", "auto")
+        key = None
+        if isinstance(req, dict):
+            sid = req.get("session_id")
+            if sid is not None and mode in ("auto", "session"):
+                key = str(sid).encode()
+            else:
+                req = req.get("prompt")
+        if key is None and mode != "session":
+            n = cfg.get("prefix_len", 32)
+            if isinstance(req, str):
+                key = req[:n].encode()
+            elif isinstance(req, (list, tuple)) and req:
+                key = b" ".join(str(t).encode() for t in req[:n])
+        if key is None:
+            return None
+        return int.from_bytes(hashlib.md5(key).digest()[:8], "big")
+
+    def _route_affinity(self, akey: int):
+        """Ring lookup (lock held): returns (idx, 'hits') for the
+        preferred replica, or (None, 'spills') when its outstanding
+        count exceeds the spill threshold and least-loaded routing
+        should take over. Per-request cost is one bisect — the ring was
+        hashed at membership-refresh time."""
+        i = bisect.bisect_left(self._ring_points, akey)
+        if i >= len(self._ring_points):
+            i = 0  # wrap: the ring is circular
+        name = self._ring_names[i]
+        idx = self._name_to_idx.get(name)
+        if idx is None:
+            return None, "misses"
+        spill_at = self._affinity.get("spill_threshold", 8)
+        if self._outstanding.get(name, 0) < spill_at:
+            return idx, "hits"
+        return None, "spills"
+
+    def _park_for_members(self):
+        """Wait (lock held, via the membership condition) for the
+        zero-replica window to close: a scale-down refresh swap or a
+        scale-from-zero. Bounded; the timeout error says what to check."""
+        deadline = time.monotonic() + self.no_replica_timeout_s
+        while not self._replicas:
+            if self._closed:
+                raise RuntimeError(
+                    f"handle for {self.app_name}/{self.deployment_name} is closed"
+                )
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"deployment {self.app_name}/{self.deployment_name} has "
+                    f"had no replicas for {self.no_replica_timeout_s:.1f}s — "
+                    f"scaled to zero without an autoscaler to wake it "
+                    f"(set autoscaling_config min_replicas >= 1 or keep the "
+                    f"control loop running), or a redeploy is stuck; "
+                    f"serve.status() shows replica counts. Raise "
+                    f"handle.no_replica_timeout_s to wait longer."
+                )
+            self._member_cv.wait(timeout=min(remaining, 1.0))
+            if not self._replicas:
+                # re-ping each wakeup tick (rate-limited inside): ONE
+                # lost fire-and-forget starvation ping must not strand
+                # a parked request on a controller that recovered —
+                # outside the lock, the ping is an actor submit
+                self._member_cv.release()
+                try:
+                    self._notify_starved()
+                finally:
+                    self._member_cv.acquire()
+
+    def _notify_starved(self):
+        """Rate-limited fire-and-forget demand signal to the controller:
+        this handle is parking requests against an empty replica set."""
+        now = time.monotonic()
+        if now - self._last_starve_ping < 1.0:
+            return
+        self._last_starve_ping = now
+        try:
+            from ray_tpu.serve.api import _get_controller
+
+            _get_controller().notify_starved.remote(
+                self.app_name, self.deployment_name
+            )
+        except Exception:
+            pass
+
+    def _reserve(self, akey: Optional[int] = None):
         """Pick a replica and charge it one in-flight request — pick AND
         read under one lock (the long-poll thread can swap _replicas for
-        a shorter list at any moment). Returns (name, submit_method)."""
-        with self._lock:
+        a shorter list at any moment). An empty replica set PARKS the
+        request on the membership condition instead of raising; affinity
+        keys route via the consistent-hash ring with spill-to-
+        least-loaded. Returns (name, submit_method)."""
+        with self._member_cv:
             if not self._replicas:
-                raise RuntimeError(f"no replicas for {self.deployment_name}")
-            idx = self._pick()
+                self._park_for_members()
+            idx = None
+            if self._affinity is not None:
+                # keyless requests (no routable prompt/session) count as
+                # misses too, so hits+spills+misses == affinity-routed
+                # requests and the A/B counters don't understate traffic
+                if akey is not None and self._ring_points:
+                    idx, kind = self._route_affinity(akey)
+                else:
+                    kind = "misses"
+                self._astats[kind] += 1
+            if idx is None:
+                idx = self._pick()
             name = self._replica_names[idx]
             self._outstanding[name] = self._outstanding.get(name, 0) + 1
             return name, self._submits[idx]
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         if not self._replicas:
-            self._refresh()
+            try:
+                self._refresh()
+            except Exception:
+                pass  # controller briefly unreachable: _reserve parks
+            if not self._replicas:
+                self._notify_starved()
         picked: Dict[str, str] = {}
 
         def done():
@@ -219,7 +403,8 @@ class DeploymentHandle:
 
         if self._model_id:
             kwargs = {**kwargs, "_serve_multiplexed_model_id": self._model_id}
-        picked["name"], submit = self._reserve()
+        akey = self._affinity_digest(args) if self._affinity else None
+        picked["name"], submit = self._reserve(akey)
         try:
             # the prebound method rides the shm-ring direct transport
             # when negotiated, the RPC path otherwise — same call shape
@@ -227,9 +412,24 @@ class DeploymentHandle:
         except Exception:
             done()
             self._refresh()
-            picked["name"], submit = self._reserve()
+            picked["name"], submit = self._reserve(akey)
             ref = submit.remote(self._method, args, kwargs)
         return DeploymentResponse(ref, on_done=done)
 
+    def routing_stats(self) -> Dict[str, Any]:
+        """Affinity routing counters (transport_stats-style): hits =
+        preferred replica taken, spills = preferred over the spill
+        threshold so least-loaded took over, misses = affinity on but
+        the request carried no routable key."""
+        with self._lock:
+            out = dict(self._astats)
+            out["total"] = sum(self._astats.values())
+            out["affinity_enabled"] = self._affinity is not None
+            out["ring_points"] = len(self._ring_points)
+            out["replicas"] = len(self._replica_names)
+            return out
+
     def close(self):
         self._closed = True
+        with self._member_cv:
+            self._member_cv.notify_all()  # unpark waiters with the closed error

@@ -15,7 +15,11 @@ engine defaults) or a dict carrying per-request SamplingParams fields:
 
     handle.remote({"prompt": [1, 2, 3], "temperature": 0.7,
                    "top_p": 0.9, "seed": 42, "stop": [2],
-                   "max_new_tokens": 64})
+                   "max_new_tokens": 64, "session_id": "user-7"})
+
+`session_id` is routing-only: with an `affinity_config` on the
+deployment, the handle hashes it (or the prompt prefix) so a session's
+repeat traffic lands on the replica whose radix cache is hot.
 
 temperature/top-k/top-p sampling and stop tokens require the paged
 engine (`paged=True`, the default for `continuous=True`) — they run
@@ -44,6 +48,9 @@ def _parse_request(req, default_max_new: int):
             )
         prompt = [int(t) for t in body.pop("prompt")]
         max_new = int(body.pop("max_new_tokens", default_max_new))
+        # routing-only field: the handle/proxy affinity layer hashes it
+        # to pick a cache-hot replica; the engine itself ignores it
+        body.pop("session_id", None)
         known = {f.name for f in dataclasses.fields(SamplingParams)}
         unknown = set(body) - known
         if unknown:
@@ -94,17 +101,32 @@ class _LLMServer:
                 # error the engine raises loudly — never a silent
                 # downgrade to dense.
                 paged = macro_phases > 0
+            import os
+
             self.engine = ContinuousBatchingEngine(
                 self.params, self.cfg, n_slots=n_slots, chunk=chunk,
                 macro_phases=macro_phases, paged=paged,
                 block_size=block_size, n_blocks=n_blocks,
                 prefix_cache=prefix_cache,
+                # pid-unique name: each replica's engine publishes its
+                # own `engine:<name>` telemetry entry, so /api/serve
+                # shows PER-REPLICA serving metrics (same-named engines
+                # collide last-write-wins in the merged table)
+                name=f"llm-{os.getpid()}",
             )
 
     def metrics(self) -> Dict[str, Any]:
         """Engine serving metrics (dispatches/token, lane occupancy,
         TTFT/TPOT percentiles); empty for the static-batching path."""
         return self.engine.metrics() if self.engine is not None else {}
+
+    def __serve_load__(self) -> int:
+        """Autoscaling load signal: the engine's resident + queued
+        request count. The Replica wrapper publishes this through the
+        telemetry path — with the direct-transport deferred-completion
+        path, `handle_request` returns before generation finishes, so
+        the replica's own in-flight counter can't see engine load."""
+        return self.engine.load() if self.engine is not None else 0
 
     @batch(max_batch_size=32, batch_wait_timeout_s=0.02)
     def _generate(self, prompts: List[List[int]]) -> List[List[int]]:

@@ -448,6 +448,17 @@ class ContinuousBatchingEngine:
         self._wake.set()
         self._thread.join(timeout=10)
 
+    def load(self) -> int:
+        """Resident + queued request count — the autoscaling load
+        signal a Replica publishes through the telemetry path. Counter
+        reads only (the slot list and wait queue belong to the loop
+        thread; a momentarily torn read just shifts one load sample)."""
+        return (
+            self._queue.qsize()
+            + len(self._waiting)
+            + sum(1 for s in self._slots if s is not None)
+        )
+
     def metrics(self) -> Dict[str, Any]:
         """Serving metrics since construction (or reset_metrics()):
         dispatch counts, dispatches/token, lane occupancy %, TTFT/TPOT
@@ -456,6 +467,7 @@ class ContinuousBatchingEngine:
         loop's concurrent appends). Tokens count at DELIVERY, so read
         after requests complete for exact ratios."""
         m = dict(self._m)
+        m["queue_depth"] = self.load()  # live gauge, not a counter
         toks = max(1, m["tokens_out"])
         m["dispatches_per_token"] = round(m["dispatches"] / toks, 4)
         m["lane_occupancy_pct"] = round(

@@ -1,0 +1,393 @@
+"""Open-loop load harness for serve deployments.
+
+The missing piece between "fast single engine" and "serves heavy
+traffic": an OPEN-LOOP Poisson-arrival generator (arrivals fire on the
+exponential clock regardless of completions — closed-loop generators
+self-throttle exactly when the system saturates, hiding the latency
+cliff the measurement exists to find) that drives a
+``DeploymentHandle`` through configurable phases (steady state, a
+traffic burst that trips the autoscaler's scale-up, a drain window
+that trips scale-down) and reports:
+
+- client-side request latency p50/p99 and goodput tokens/s per phase,
+- zero-drop accounting (every arrival is tracked to completion or a
+  counted error — a scale event that strands a request is visible),
+- the replica-count timeline sampled during the run (scale-up /
+  scale-down events land in the report),
+- engine-side TTFT/TPOT percentiles and per-replica prefix-cache hit
+  rates, read back through the same telemetry table ``/api/serve``
+  serves (plus an exact per-replica metrics scrape for tests).
+
+Workloads mix prompt/output lengths from uniform ranges and carry an
+optional SHARED SYSTEM PROMPT mixture: ``shared_fraction`` of requests
+start with ``shared_prefix``, which is what cache-affinity routing and
+the radix prefix cache are for — the aggregate hit rate with affinity
+on vs off is the headline A/B.
+
+Requests ride asyncio (one event loop, thousands of in-flight awaits —
+no thread per client), submitting through the handle's normal
+``remote()`` path so routing, affinity and the direct transport all
+engage exactly as production traffic would.
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Workload",
+    "Phase",
+    "run_load",
+    "serve_snapshot",
+    "aggregate_prefix_cache",
+    "replica_metrics",
+]
+
+
+@dataclasses.dataclass
+class Workload:
+    """What each arrival sends.
+
+    rate_hz: base Poisson arrival rate (phases scale it).
+    prompt_len / max_new_tokens: uniform [lo, hi] per request.
+    shared_prefix + shared_fraction: that fraction of prompts starts
+        with the shared token prefix (the "system prompt" mixture).
+    session_count: > 0 tags requests with one of N session ids
+        (session-affinity routing exercises the session path).
+    session_prefixes + session_prefix_len: K DISTINCT per-session
+        prefixes (each session's prompts share session-specific leading
+        tokens, and carry that session's id). This is the workload
+        where cache-affinity routing matters most: with K prefixes
+        spread over R replicas, affinity partitions them K/R per
+        replica while unaffinitized routing makes every replica cache
+        (and under pool pressure, evict) all K.
+    request_fn: escape hatch — build the request yourself (rng ->
+        request object); everything above is ignored. Use for non-LLM
+        deployments.
+    count_tokens: result -> generated-token count for goodput (defaults
+        to len(result) for list results, else 0).
+    """
+
+    rate_hz: float = 20.0
+    prompt_len: Tuple[int, int] = (4, 12)
+    max_new_tokens: Tuple[int, int] = (4, 8)
+    vocab: int = 50
+    shared_prefix: Sequence[int] = ()
+    shared_fraction: float = 0.0
+    session_count: int = 0
+    session_prefixes: int = 0
+    session_prefix_len: int = 16
+    seed: int = 0
+    request_fn: Optional[Callable[[random.Random], Any]] = None
+    count_tokens: Optional[Callable[[Any], int]] = None
+
+
+@dataclasses.dataclass
+class Phase:
+    """One load phase: `rate_multiplier` scales the workload's base
+    rate (0.0 = send nothing, just observe — the drain window)."""
+
+    name: str
+    duration_s: float
+    rate_multiplier: float = 1.0
+
+
+def _make_request(w: Workload, rng: random.Random):
+    if w.request_fn is not None:
+        return w.request_fn(rng)
+    plen = rng.randint(*w.prompt_len)
+    body = [rng.randrange(1, w.vocab) for _ in range(max(1, plen))]
+    req: Dict[str, Any] = {
+        "max_new_tokens": rng.randint(*w.max_new_tokens),
+    }
+    if w.session_prefixes > 0:
+        # per-session distinct prefixes: session s always opens with its
+        # own session_prefix_len tokens (deterministic, disjoint from
+        # the random-body vocab so sessions never alias)
+        s = rng.randrange(w.session_prefixes)
+        req["prompt"] = [w.vocab + s] * w.session_prefix_len + body
+        req["session_id"] = f"session-{s}"
+        return req
+    if w.shared_prefix and rng.random() < w.shared_fraction:
+        req["prompt"] = list(w.shared_prefix) + body
+    else:
+        req["prompt"] = body
+    if w.session_count > 0:
+        req["session_id"] = f"session-{rng.randrange(w.session_count)}"
+    return req
+
+
+def _count_tokens(w: Workload, result: Any) -> int:
+    if w.count_tokens is not None:
+        try:
+            return int(w.count_tokens(result))
+        except Exception:
+            return 0
+    return len(result) if isinstance(result, (list, tuple)) else 0
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+# ------------------------------------------------------------- telemetry
+def serve_snapshot() -> Dict[str, Any]:
+    """The merged `serve` telemetry table — the same data `/api/serve`
+    serves: `replica:<name>` load stats, `engine:<name>` serving
+    metrics, `autoscaler:<app>::<dep>` decisions."""
+    from ray_tpu.observability import fetch_snapshots
+
+    merged: Dict[str, Any] = {}
+    for snap in fetch_snapshots("serve").values():
+        if not isinstance(snap, dict):
+            continue
+        for key, val in snap.items():
+            if key in ("time", "steps"):
+                continue
+            merged[key] = val
+    return merged
+
+
+def aggregate_prefix_cache(snapshot: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Token-weighted aggregate prefix-cache hit rate across every
+    engine entry in a serve snapshot (or per-replica metrics dict)."""
+    snapshot = serve_snapshot() if snapshot is None else snapshot
+    hit = lookup = hits = misses = 0
+    per_replica: Dict[str, float] = {}
+    for key, m in snapshot.items():
+        if not isinstance(m, dict) or "prefix_cache_hit_rate" not in m:
+            continue
+        hit += int(m.get("prefix_cache_hit_tokens", 0))
+        lookup += int(m.get("prefix_cache_lookup_tokens", 0))
+        hits += int(m.get("prefix_cache_hits", 0))
+        misses += int(m.get("prefix_cache_misses", 0))
+        per_replica[key] = m["prefix_cache_hit_rate"]
+    return {
+        "hit_tokens": hit,
+        "lookup_tokens": lookup,
+        "hits": hits,
+        "misses": misses,
+        # token-weighted (how much prefill FLOP the cache absorbed) and
+        # request-weighted (how many admissions found their prefix hot —
+        # the affinity A/B discriminator: off-routing misses once PER
+        # REPLICA a prefix visits, on-routing once total)
+        "hit_rate": round(hit / max(1, lookup), 4),
+        "request_hit_rate": round(hits / max(1, hits + misses), 4),
+        "per_replica": per_replica,
+    }
+
+
+def replica_metrics(app_name: str, deployment_name: str) -> Dict[str, Dict[str, Any]]:
+    """Exact per-replica `metrics()` scrape (driver-side harness tool —
+    one RPC per replica; the controller's autoscaler never does this).
+    Returns {replica_name: metrics dict} for replicas whose deployment
+    exposes a `metrics` method."""
+    import ray_tpu
+    from ray_tpu.serve.api import _get_controller
+
+    controller = _get_controller()
+    info = ray_tpu.get(
+        controller.get_replicas_versioned.remote(app_name, deployment_name)
+    )
+    data = info["data"]
+    names = data["replicas"] if isinstance(data, dict) else (data or [])
+    out: Dict[str, Dict[str, Any]] = {}
+    for name in names:
+        try:
+            h = ray_tpu.get_actor(name)
+            out[name] = ray_tpu.get(
+                h.handle_request.remote("metrics", (), {}), timeout=30
+            )
+        except Exception:
+            continue
+    return out
+
+
+# ------------------------------------------------------------ the harness
+async def _run_async(handle, workload: Workload, phases: List[Phase],
+                     request_timeout_s: float, track: Optional[Tuple[str, str]],
+                     drain_timeout_s: float) -> Dict[str, Any]:
+    rng = random.Random(workload.seed)
+    records: List[Dict[str, Any]] = []
+    in_flight: set = set()
+    t_start = time.monotonic()
+    replica_timeline: List[Tuple[float, int]] = []
+    stop_sampler = asyncio.Event()
+
+    async def _sample_replicas():
+        from ray_tpu.serve import api as serve_api
+
+        loop = asyncio.get_running_loop()
+        while not stop_sampler.is_set():
+            try:
+                st = await loop.run_in_executor(None, serve_api.status)
+                n = st.get(track[0], {}).get(track[1], {}).get("num_replicas")
+                if n is not None:
+                    replica_timeline.append((time.monotonic() - t_start, n))
+            except Exception:
+                pass
+            try:
+                await asyncio.wait_for(stop_sampler.wait(), timeout=0.5)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _one(req, phase_name: str):
+        rec = {"phase": phase_name, "t_submit": time.monotonic(), "ok": False,
+               "tokens": 0, "error": None}
+        records.append(rec)
+        try:
+            # handle.remote() is cheap in steady state (pick + ring
+            # write) but can BLOCK during the scale events this harness
+            # exists to measure (zero-replica parking, an empty-set
+            # controller refresh) — submit on a worker thread so one
+            # parked request never stalls the arrival clock or other
+            # requests' completion timestamps
+            resp = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: handle.remote(req)
+            )
+            result = await resp.async_result(request_timeout_s)
+            rec["tokens"] = _count_tokens(workload, result)
+            rec["ok"] = True
+            # the result itself is NOT retained: a multi-minute run at
+            # open-loop rates would otherwise hold every generated token
+            # list until the report builds
+        except Exception as e:  # a DROPPED request — the harness counts it
+            rec["error"] = f"{type(e).__name__}: {e}"
+        rec["t_done"] = time.monotonic()
+
+    sampler = asyncio.ensure_future(_sample_replicas()) if track else None
+    for phase in phases:
+        rate = workload.rate_hz * phase.rate_multiplier
+        phase_end = time.monotonic() + phase.duration_s
+        if rate <= 0:
+            # observation window (drain): no arrivals
+            await asyncio.sleep(phase.duration_s)
+            continue
+        while True:
+            now = time.monotonic()
+            if now >= phase_end:
+                break
+            gap = rng.expovariate(rate)
+            if now + gap >= phase_end:
+                await asyncio.sleep(phase_end - now)
+                break
+            await asyncio.sleep(gap)
+            task = asyncio.ensure_future(
+                _one(_make_request(workload, rng), phase.name)
+            )
+            in_flight.add(task)
+            task.add_done_callback(in_flight.discard)
+    # final drain: every arrival runs to completion or a counted error
+    if in_flight:
+        await asyncio.wait(list(in_flight), timeout=drain_timeout_s)
+    for task in list(in_flight):
+        task.cancel()
+    if sampler is not None:
+        stop_sampler.set()
+        await sampler
+    return _build_report(records, replica_timeline, time.monotonic() - t_start)
+
+
+def _phase_stats(recs: List[Dict[str, Any]], wall_s: float) -> Dict[str, Any]:
+    lat = sorted(
+        (r["t_done"] - r["t_submit"]) * 1e3 for r in recs if r.get("ok")
+    )
+    tokens = sum(r["tokens"] for r in recs if r.get("ok"))
+    return {
+        "sent": len(recs),
+        "completed": sum(1 for r in recs if r.get("ok")),
+        "dropped": sum(1 for r in recs if not r.get("ok")),
+        "latency_ms_p50": round(_percentile(lat, 0.50), 2),
+        "latency_ms_p99": round(_percentile(lat, 0.99), 2),
+        "tokens_out": tokens,
+        "goodput_tok_s": round(tokens / max(1e-9, wall_s), 2),
+    }
+
+
+def _build_report(records, replica_timeline, wall_s) -> Dict[str, Any]:
+    by_phase: Dict[str, List[Dict[str, Any]]] = {}
+    for r in records:
+        if "t_done" not in r:  # cancelled straggler past drain timeout
+            r["t_done"] = r["t_submit"]
+            r["ok"] = False
+            r.setdefault("error", "TimeoutError: still in flight at drain timeout")
+        by_phase.setdefault(r["phase"], []).append(r)
+    phase_wall: Dict[str, float] = {}
+    for name, recs in by_phase.items():
+        t0 = min(r["t_submit"] for r in recs)
+        t1 = max(r["t_done"] for r in recs)
+        phase_wall[name] = max(1e-9, t1 - t0)
+    report = {
+        "total": _phase_stats(records, wall_s),
+        "phases": {
+            name: _phase_stats(recs, phase_wall[name])
+            for name, recs in by_phase.items()
+        },
+        "errors": sorted({r["error"] for r in records if r.get("error")})[:8],
+        "wall_s": round(wall_s, 2),
+    }
+    if replica_timeline:
+        report["replicas_timeline"] = [
+            (round(t, 2), n) for t, n in replica_timeline
+        ]
+        report["replicas_peak"] = max(n for _, n in replica_timeline)
+        report["replicas_final"] = replica_timeline[-1][1]
+    return report
+
+
+def run_load(handle, workload: Workload, phases: Optional[List[Phase]] = None,
+             *, request_timeout_s: float = 60.0,
+             track: Optional[Tuple[str, str]] = None,
+             drain_timeout_s: float = 120.0,
+             collect_serve_metrics: bool = True) -> Dict[str, Any]:
+    """Drive `handle` with the workload through the phases (default: one
+    steady phase of 5s) and return the report dict. `track=(app, dep)`
+    samples that deployment's replica count through the run (the
+    scale-up/scale-down record). With `collect_serve_metrics`, the
+    report carries the post-run `/api/serve`-path telemetry snapshot
+    (engine TTFT/TPOT percentiles, aggregate prefix-cache hit rate)."""
+    phases = phases or [Phase("steady", 5.0)]
+    report = asyncio.run(
+        _run_async(handle, workload, phases, request_timeout_s, track,
+                   drain_timeout_s)
+    )
+    if collect_serve_metrics:
+        time.sleep(0.5)  # let the last engine/replica publishes land
+        snap = serve_snapshot()
+        # prefix-cache headline from an EXACT live-replica scrape when
+        # the handle names the deployment: the GCS telemetry table keeps
+        # a dead reporter's last snapshot for up to 120s, so a deleted
+        # deployment's engines would otherwise contaminate an A/B rerun.
+        # Custom request_fn workloads (non-LLM deployments) skip the
+        # scrape — probing `metrics` on a deployment without one spews
+        # remote AttributeErrors into the worker logs.
+        if workload.request_fn is None:
+            try:
+                report["prefix_cache"] = aggregate_prefix_cache(
+                    replica_metrics(handle.app_name, handle.deployment_name)
+                )
+            except Exception:
+                report["prefix_cache"] = aggregate_prefix_cache(snap)
+        else:
+            report["prefix_cache"] = aggregate_prefix_cache(snap)
+        report["engines"] = {
+            k: {
+                m: v[m]
+                for m in ("ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
+                          "tpot_ms_p99", "queue_depth",
+                          "prefix_cache_hit_rate", "tokens_out")
+                if m in v
+            }
+            for k, v in snap.items()
+            if isinstance(v, dict) and k.startswith("engine:")
+        }
+        report["autoscaler"] = {
+            k: v for k, v in snap.items() if k.startswith("autoscaler:")
+        }
+    return report

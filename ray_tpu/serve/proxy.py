@@ -21,10 +21,19 @@ class ProxyActor:
     (or blocks) the CoreWorker IO loop."""
 
     def __init__(self, port: int = 8000):
+        import concurrent.futures
+
         self.port = port
         self.routes: Dict[str, tuple] = {}
         self._routes_version = 0
         self._handles = {}
+        # DEDICATED submit pool: handle.remote can park (zero-replica
+        # window), and parked submits must neither block the event loop
+        # nor exhaust the loop's shared default executor that route
+        # building and stats fetches ride on
+        self._submit_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="serve-proxy-submit"
+        )
         self._runner = None
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._loop.run_forever, daemon=True, name="serve-proxy")
@@ -119,9 +128,17 @@ class ProxyActor:
             from ray_tpu.serve.handle import DeploymentHandle
 
             def _build():
+                import os as _os
+
                 h = DeploymentHandle(dep_name, app_name)
                 if is_ingress:  # route-dispatch method baked in ONCE
                     h._method = "__serve_http_request__"
+                # HTTP clients shouldn't wait the full library default on
+                # a scaled-to-zero deployment, and short parks recycle
+                # the submit pool's threads quickly
+                h.no_replica_timeout_s = float(
+                    _os.environ.get("RAY_TPU_PROXY_NO_REPLICA_TIMEOUT_S", "5.0")
+                )
                 h._refresh()  # blocking controller round trips — off-loop
                 return h
 
@@ -131,15 +148,35 @@ class ProxyActor:
             body = await request.json() if request.can_read_body else {}
         except json.JSONDecodeError:
             body = {"raw": await request.text()}
+        # session affinity over HTTP: an X-Serve-Session-Id header (or
+        # the body's own session_id) feeds the handle's consistent-hash
+        # routing so a session keeps hitting its cache-hot replica
+        sid = request.headers.get("X-Serve-Session-Id")
+        if sid and isinstance(body, dict):
+            body.setdefault("session_id", sid)
         try:
+            # handle.remote can BLOCK (zero-replica parking waits on the
+            # membership condition; an empty-set refresh is a controller
+            # round trip) — park it on the dedicated submit pool so one
+            # scaled-to-zero deployment can't freeze the proxy loop or
+            # starve the loop's shared default executor; parks are
+            # bounded by the proxy's short no_replica_timeout_s, so pool
+            # threads recycle fast and steady-state submits (µs) never
+            # queue for long
+            loop = asyncio.get_running_loop()
             if is_ingress:
                 # path routing inside the deployment: forward (method,
                 # subpath, body, query) to the replica's route dispatcher
                 # (reference: proxy → mounted FastAPI app in the replica)
                 sub = path[len(prefix):] or "/"
-                resp = handle.remote(request.method, sub, body, dict(request.query))
+                resp = await loop.run_in_executor(
+                    self._submit_pool, lambda: handle.remote(
+                        request.method, sub, body, dict(request.query))
+                )
             else:
-                resp = handle.remote(body)
+                resp = await loop.run_in_executor(
+                    self._submit_pool, lambda: handle.remote(body)
+                )
             # native await (no executor-thread hop per request): resolves
             # on the CoreWorker loop and bridges here
             result = await resp.async_result(60)
@@ -157,6 +194,14 @@ class ProxyActor:
 
     def ready(self):
         return self.port
+
+    def routing_stats(self):
+        """Per-route affinity counters from the proxy's cached handles
+        (hits / spills / misses — transport_stats-style)."""
+        return {
+            f"{app}/{dep}": h.routing_stats()
+            for (app, dep, _), h in list(self._handles.items())
+        }
 
 
 def start_proxy(port: int = 8000):

@@ -1,0 +1,126 @@
+"""Repo lint: serving-at-scale hot paths stay cheap and decoupled.
+
+The rules, enforced on source (no cluster):
+
+- ROUTING decisions use only the handle's cached membership state. The
+  per-request path (`remote` → `_reserve` → `_pick`/`_route_affinity`)
+  makes NO controller RPCs in steady state (the only controller touch
+  is the empty-replica refresh/starvation path) and the affinity path's
+  per-request hashing is exactly ONE digest (`_affinity_digest`);
+  `_route_affinity` itself is a bisect over the ring that
+  `_apply_replicas` hashed at membership-refresh time.
+- The AUTOSCALER loop never calls into a replica synchronously: its
+  load signal is the merged telemetry snapshot (one GCS round trip),
+  and the per-deployment decision function is plain sync host code.
+- Replicas publish their load stats through the telemetry path
+  (publish_snapshot), not via controller polling.
+"""
+import inspect
+import re
+
+from ray_tpu.serve import controller as ctl
+from ray_tpu.serve.handle import DeploymentHandle
+
+_CONTROLLER_RPC = re.compile(
+    r"_get_controller|listen_for_change|get_replicas_versioned"
+)
+_REPLICA_CALL = re.compile(r"get_actor\(|\.stats\.remote|\.health\.remote")
+
+
+def test_routing_hot_path_no_controller_rpcs():
+    """Steady-state routing reads only cached membership; a controller
+    round trip per request would reintroduce the dispatch floor the
+    direct transport removed."""
+    for fn in (DeploymentHandle._reserve, DeploymentHandle._pick,
+               DeploymentHandle._route_affinity,
+               DeploymentHandle._affinity_digest):
+        src = inspect.getsource(fn)
+        assert not _CONTROLLER_RPC.search(src), (
+            f"{fn.__name__} talks to the controller per request — membership "
+            f"is pushed via long-poll, routing must use the cached table"
+        )
+
+
+def test_affinity_per_request_hashing_is_one_digest():
+    """Per-request affinity cost: one prefix/session digest, then a
+    bisect on the membership-time ring. Rendezvous-style per-replica
+    hashing per request is exactly the allocation creep this pins."""
+    digest_src = inspect.getsource(DeploymentHandle._affinity_digest)
+    assert digest_src.count("hashlib.") == 1, (
+        "_affinity_digest must take exactly ONE hash of the request key"
+    )
+    route_src = inspect.getsource(DeploymentHandle._route_affinity)
+    assert "hashlib" not in route_src and "md5" not in route_src, (
+        "_route_affinity must not hash per request — the ring carries the "
+        "membership-time hashes"
+    )
+    assert "bisect" in route_src, (
+        "_route_affinity must look the key up on the prebuilt ring"
+    )
+    apply_src = inspect.getsource(DeploymentHandle._apply_replicas)
+    assert "hashlib.md5" in apply_src and "ring.sort()" in apply_src, (
+        "_apply_replicas must build the consistent-hash ring at membership "
+        "refresh (vnode hashing happens once per membership change)"
+    )
+
+
+def test_reserve_parks_instead_of_raising():
+    src = inspect.getsource(DeploymentHandle._reserve)
+    assert "_park_for_members" in src, (
+        "_reserve must park on the membership condition during zero-replica "
+        "windows (scale-to-zero / scale-down refresh), not raise"
+    )
+    park_src = inspect.getsource(DeploymentHandle._park_for_members)
+    assert "TimeoutError" in park_src and "no_replica_timeout_s" in park_src, (
+        "parking must be bounded with an actionable timeout error"
+    )
+
+
+def test_autoscaler_loop_never_calls_replicas_synchronously():
+    """The control loop's only I/O is ONE GCS telemetry fetch; the
+    decision function is sync host code over that snapshot. A per-tick
+    RPC fan-out to replicas would stall scaling behind the slowest
+    (or wedged) replica."""
+    decision_src = inspect.getsource(ctl.ServeControllerActor._cls._autoscale_one)
+    assert not _REPLICA_CALL.search(decision_src), (
+        "_autoscale_one must consume the telemetry snapshot, not call "
+        "replicas"
+    )
+    assert not inspect.iscoroutinefunction(ctl.ServeControllerActor._cls._autoscale_one), (
+        "_autoscale_one must be synchronous — decisions are host-side math "
+        "over the snapshot, with nothing to await"
+    )
+    loop_src = inspect.getsource(ctl.ServeControllerActor._cls.run_control_loop)
+    assert not _REPLICA_CALL.search(loop_src), (
+        "run_control_loop must not fan RPCs out to replicas"
+    )
+    assert "_fetch_replica_stats" in loop_src, (
+        "run_control_loop must read replica load from the telemetry table"
+    )
+    fetch_src = inspect.getsource(ctl._fetch_replica_stats)
+    assert "fetch_snapshots" in fetch_src, (
+        "_fetch_replica_stats must read the GCS telemetry table through "
+        "observability.fetch_snapshots (the /api/serve data path)"
+    )
+
+
+def test_replica_stats_ride_the_telemetry_path():
+    src = inspect.getsource(ctl.Replica._cls._report_loop)
+    assert "publish_snapshot" in src, (
+        "Replica load stats must publish through the telemetry path "
+        "(observability.publish_snapshot), where /api/serve and the "
+        "autoscaler read them"
+    )
+
+
+def test_scale_down_is_drain_aware():
+    src = inspect.getsource(ctl.ServeControllerActor._cls._scale_to)
+    assert "_drain_and_kill" in src and "downscale_order" in src, (
+        "_scale_to must drain victims (no dropped in-flight requests) and "
+        "pick them via the scheduler's downscale order"
+    )
+    drain_src = inspect.getsource(ctl.ServeControllerActor._cls._drain_and_kill)
+    assert "queued" in drain_src, (
+        "_drain_and_kill must wait for async-engine queued work, not just "
+        "the replica's blocking in-flight counter"
+    )
