@@ -111,6 +111,31 @@ def bench_runtime(extra):
     log(f"[bench] put bandwidth: {gib:.2f} GiB/s (baseline {BASELINES['put_gib_per_s']}; "
         f"single-threaded DRAM memcpy on this box ~2.5 GiB/s)")
 
+    # large-object zero-copy path: 64 MiB puts exercise the native
+    # multi-threaded arena copy (serializer writes oob buffers straight
+    # into the allocation); gets must alias the arena mmap (no copy)
+    big64 = np.ones(64 * 1024 * 1024 // 8, np.float64)
+    ray_tpu.put(big64)
+    gib64 = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        n64 = 6
+        for _ in range(n64):
+            ray_tpu.put(big64)
+        gib64 = max(gib64, n64 * big64.nbytes / (1 << 30) / (time.perf_counter() - t0))
+    extra["put64_gib_per_s"] = round(gib64, 2)
+    ref64 = ray_tpu.put(big64)
+    get64 = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out64 = ray_tpu.get(ref64)
+        get64 = max(get64, big64.nbytes / (1 << 30) / (time.perf_counter() - t0))
+        del out64
+    extra["get64_gib_per_s"] = round(get64, 2)
+    del ref64
+    log(f"[bench] 64 MiB object put/get: {gib64:.2f} / {get64:.2f} GiB/s "
+        f"(get is a zero-copy arena alias)")
+
     # multi-client puts: 2 worker processes putting 16 MiB objects
     # concurrently (reference: multi_client_put_* axes, ray_perf.py —
     # its rig has a core per client; here all clients share the one
@@ -844,7 +869,45 @@ def bench_data_pipeline(extra):
                 f"{nb * block_bytes / (1 << 30) / dtb:.2f} GiB/s")
         finally:
             ctx.arena_usage_budget_bytes = prev_budget
+
+        # end-to-end shuffle throughput: the streaming exchange (ring
+        # transport, per-partition finalize merge) vs the legacy 2-stage
+        # shuffle, same 64 MiB dataset — A/B inside ONE run because this
+        # box's absolute bandwidth swings run to run
+        shuf_blocks, shuf_rows = 8, 1_048_576  # 8 x 8 MiB = 64 MiB
+        total_bytes = shuf_blocks * shuf_rows * 8
+
+        def _make_shuffle_ds():
+            return ray_tpu.data.range(
+                shuf_blocks, parallelism=shuf_blocks
+            ).map_batches(lambda b: {"x": np.arange(shuf_rows, dtype=np.float64)})
+
+        def _run_shuffle():
+            t0 = time.perf_counter()
+            n = 0
+            for batch in _make_shuffle_ds().random_shuffle(seed=1).iter_batches(
+                batch_size=shuf_rows
+            ):
+                n += len(batch["x"])
+            assert n == shuf_blocks * shuf_rows
+            return time.perf_counter() - t0
+
+        _run_shuffle()  # warm (reducer pool spawn, jit-free but imports)
+        dt_stream = min(_run_shuffle() for _ in range(2))
+        ctx.use_streaming_exchange = False
+        try:
+            dt_legacy = min(_run_shuffle() for _ in range(2))
+        finally:
+            ctx.use_streaming_exchange = True
+        extra["shuffle_gib_s"] = round(total_bytes / (1 << 30) / dt_stream, 3)
+        extra["shuffle_legacy_gib_s"] = round(total_bytes / (1 << 30) / dt_legacy, 3)
+        extra["shuffle_stream_speedup"] = round(dt_legacy / dt_stream, 2)
+        log(f"[bench] random_shuffle end-to-end ({total_bytes >> 20} MiB): "
+            f"streaming {total_bytes / (1 << 30) / dt_stream:.3f} GiB/s vs "
+            f"legacy {total_bytes / (1 << 30) / dt_legacy:.3f} GiB/s "
+            f"({dt_legacy / dt_stream:.2f}x)")
         ray_tpu.shutdown()
+        _bench_shuffle_oversubscribed(extra)
     except Exception as e:
         log(f"[bench] data pipeline bench failed: {e}")
         try:
@@ -853,6 +916,57 @@ def bench_data_pipeline(extra):
             ray_tpu.shutdown()
         except Exception:
             pass
+
+
+def _bench_shuffle_oversubscribed(extra):
+    """The regime the streaming exchange exists for: a shuffle LARGER
+    than the object-store arena. The legacy 2-stage shuffle materializes
+    N×M parts plus every output simultaneously (driver-held refs pin
+    them — spilling cannot relieve pinned pressure) and dies with
+    ObjectStoreFullError; the streaming exchange rides rings + bounded
+    finalize admission and completes."""
+    import numpy as np
+
+    import ray_tpu
+    import ray_tpu.data
+    from ray_tpu.data.context import DataContext
+
+    ray_tpu.init(num_cpus=8, object_store_memory=64 * 1024 * 1024)
+    _settle(2.0)
+    ctx = DataContext.get_current()
+    nb, rows = 12, 1_048_576  # 12 x 8 MiB = 96 MiB through a 64 MiB arena
+    total = nb * rows * 8
+
+    def _run():
+        t0 = time.perf_counter()
+        n = 0
+        ds = ray_tpu.data.range(nb, parallelism=nb).map_batches(
+            lambda b: {"x": np.arange(rows, dtype=np.float64)}
+        )
+        for batch in ds.random_shuffle(seed=1).iter_batches(batch_size=rows):
+            n += len(batch["x"])
+        assert n == nb * rows
+        return time.perf_counter() - t0
+
+    try:
+        _run()  # warm
+        dt_stream = min(_run() for _ in range(2))
+        extra["shuffle_oversub_gib_s"] = round(total / (1 << 30) / dt_stream, 3)
+        ctx.use_streaming_exchange = False
+        try:
+            dt_legacy = min(_run() for _ in range(2))
+            legacy = f"{total / (1 << 30) / dt_legacy:.3f} GiB/s"
+            extra["shuffle_oversub_legacy_gib_s"] = round(total / (1 << 30) / dt_legacy, 3)
+        except Exception as e:
+            legacy = f"FAILED ({type(e).__name__})"
+            extra["shuffle_oversub_legacy_gib_s"] = 0.0
+        finally:
+            ctx.use_streaming_exchange = True
+        log(f"[bench] oversubscribed shuffle ({total >> 20} MiB through a 64 MiB "
+            f"arena): streaming {total / (1 << 30) / dt_stream:.3f} GiB/s, "
+            f"legacy {legacy}")
+    finally:
+        ray_tpu.shutdown()
 
 
 def bench_telemetry_overhead(extra):

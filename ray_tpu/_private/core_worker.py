@@ -1009,9 +1009,7 @@ class CoreWorker:
             self._ensure_registered(roids)
         total = serialization.serialized_size(pickled, buffers)
         if total <= RayConfig.object_store_inline_max_bytes or self._shm is None:
-            data = bytearray(total)
-            n = serialization.write_to(memoryview(data), pickled, buffers)
-            env = _env_inline(bytes(data[:n]))
+            env = _env_inline(serialization.to_wire_sized(pickled, buffers, total))
             if refs:
                 env["rf"] = roids
             self._deliver(oid, env)
@@ -1669,7 +1667,7 @@ class CoreWorker:
                 nested.extend(r.binary() for r in refs)
         total = serialization.serialized_size(pickled, buffers)
         if total <= RayConfig.object_store_inline_max_bytes or self._shm is None:
-            return {"v": serialization.to_wire(pickled, buffers)}
+            return {"v": serialization.to_wire_sized(pickled, buffers, total)}
         # large arg → promote to an owned shm object, pass by ref. _owned
         # BEFORE _deliver: _deliver's pin check is `oid in self._owned`,
         # and with handoff=False that pin is the ONLY thing keeping the

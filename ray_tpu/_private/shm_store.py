@@ -99,8 +99,41 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
         ]
         lib.shm_store_dump_entries.restype = ctypes.c_int
+        lib.shm_copy_mt.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.shm_copy_mt.restype = None
         _lib = lib
     return _lib
+
+
+_COPY_THREADS: Optional[int] = None
+
+
+def copy_threads() -> int:
+    """Thread count for the parallel put-path copy: enough to saturate
+    DRAM, never more than the cores that exist (extra threads only add
+    spawn + contention cost)."""
+    global _COPY_THREADS
+    if _COPY_THREADS is None:
+        env = os.environ.get("RAY_TPU_PUT_COPY_THREADS")
+        if env:
+            _COPY_THREADS = max(1, int(env))
+        else:
+            _COPY_THREADS = max(1, min(4, os.cpu_count() or 1))
+    return _COPY_THREADS
+
+
+def parallel_copy(dst_addr: int, src_addr: int, n: int, threads: Optional[int] = None) -> bool:
+    """memcpy `n` bytes via the native library (multi-threaded for large
+    spans), releasing the GIL for the duration. Returns False when the
+    native library is unavailable — callers fall back to a python copy."""
+    try:
+        lib = _load()
+    except Exception:
+        return False
+    lib.shm_copy_mt(dst_addr, src_addr, n, copy_threads() if threads is None else threads)
+    return True
 
 
 class ShmBuffer:

@@ -534,9 +534,11 @@ class Executor:
             roids = [r.binary() for r in refs]
             self.core._ensure_registered(roids)
             self.core.escrow_refs(roids)
+        # size computed ONCE: to_wire used to re-walk (and re-join) the
+        # same buffers serialized_size just measured
         total = serialization.serialized_size(pickled, buffers)
         if total <= RayConfig.object_store_inline_max_bytes or self.core._shm is None:
-            env = _env_inline(serialization.to_wire(pickled, buffers))
+            env = _env_inline(serialization.to_wire_sized(pickled, buffers, total))
         else:
             env = self.core.put_serialized_to_shm(bytes(oid), pickled, buffers)
         if refs:
@@ -554,7 +556,7 @@ class Executor:
                 self.core.escrow_refs(roids)
             total = serialization.serialized_size(pickled, buffers)
             if total <= RayConfig.object_store_inline_max_bytes or self.core._shm is None:
-                env = _env_inline(serialization.to_wire(pickled, buffers))
+                env = _env_inline(serialization.to_wire_sized(pickled, buffers, total))
             else:
                 env = self.core.put_serialized_to_shm(bytes(oid), pickled, buffers)
             if refs:

@@ -35,6 +35,7 @@ import ray_tpu
 from ray_tpu.data._internal import backpressure_policy as bp
 from ray_tpu.data._internal.optimizer import (
     ActorStage,
+    ExchangeStage,
     LimitStage,
     Stage,
     TaskStage,
@@ -169,12 +170,15 @@ class _ExecState:
         self._pending_input: List[Any] = []
         self._est: Dict[str, float] = {}
         self._last_sample = 0.0
+        self._last_relief = 0.0
         self._shm = None
+        self._core = None
         try:
             from ray_tpu._private.worker import get_global_core
 
             core = get_global_core()
             self._shm = getattr(core, "_shm", None)
+            self._core = core
         except Exception:
             self._shm = None
 
@@ -255,8 +259,26 @@ class _ExecState:
         for p in self.policies:
             if not p.can_launch(stage, u):
                 self.stats.throttled(stage, p.name)
+                if p.name == bp.ArenaUsagePolicy.name:
+                    self._relieve_pressure()
                 return False
         return True
+
+    def _relieve_pressure(self):
+        """Arena refusal: sweep dead refs NOW instead of waiting out the
+        0.1s ref-gc cadence. Consumed blocks the driver has already
+        dropped otherwise inflate `used_bytes` for a full gc tick while
+        admission spins — reclaiming them immediately is what holds peak
+        occupancy near the budget rather than budget + a gc-latency's
+        worth of dead blocks."""
+        now = time.perf_counter()
+        if self._core is None or now - self._last_relief < self.poll_interval:
+            return
+        self._last_relief = now
+        try:
+            self._core.force_ref_gc()
+        except Exception:
+            pass
 
     def launched(self, stage: str, meta_ref=None, input_ref=None):
         self.inflight[stage] = self.inflight.get(stage, 0) + 1
@@ -268,6 +290,12 @@ class _ExecState:
 
     def consumed(self, stage: str):
         self.inflight[stage] = self.inflight.get(stage, 0) - 1
+
+    def seed_estimate(self, stage: str, nbytes: float):
+        """Pre-teach a stage's output size (a stage that KNOWS its
+        geometry — e.g. the exchange — skips the unsized slow-start
+        probe). Learned metas still ratchet the estimate upward."""
+        self._est[stage] = max(self._est.get(stage, 0.0), float(nbytes))
 
 
 def _input_stage(block_refs: List[Any], state: _ExecState, input_name: str) -> Iterator:
@@ -394,6 +422,9 @@ def _default_policies(ctx: DataContext, plan: List[Stage], per_stage_window: int
             # the actor stage's own n*per_actor cap is enforced in-stage;
             # this cap only keeps the shared policy view consistent
             caps[s.name] = int(s.op.num_actors) * ctx.actor_max_tasks_in_flight
+        elif isinstance(s, ExchangeStage):
+            caps[s.map_name] = per_stage_window
+            caps[s.name] = per_stage_window
     policies: List[bp.BackpressurePolicy] = [
         bp.ConcurrencyCapPolicy(caps, default_cap=per_stage_window)
     ]
@@ -430,15 +461,28 @@ def execute_streaming(
         max_in_flight = ctx.max_in_flight_blocks
     plan = build_plan(ops, fusion=ctx.operator_fusion,
                       limit_pushdown=ctx.limit_pushdown)
-    n_windows = 1 + sum(1 for s in plan if isinstance(s, TaskStage))
+    n_windows = 1 + sum(1 for s in plan if isinstance(s, TaskStage)) \
+        + 2 * sum(1 for s in plan if isinstance(s, ExchangeStage))
     per = max(1, max_in_flight // max(1, n_windows))
-    stats = StatsBuilder([input_name] + [s.name for s in plan])
+    # an ExchangeStage owns two launch windows (mappers, finalizes) —
+    # both participate in stage ordering, stats, and meta learning
+    stage_names: List[str] = [input_name]
+    meta_stages: List[str] = []
+    for s in plan:
+        if isinstance(s, ExchangeStage):
+            stage_names.extend([s.map_name, s.name])
+            meta_stages.extend([s.map_name, s.name])
+        else:
+            stage_names.append(s.name)
+            if isinstance(s, (TaskStage, ActorStage)):
+                meta_stages.append(s.name)
+    stats = StatsBuilder(stage_names)
     state = _ExecState(
         _default_policies(ctx, plan, per, input_name),
         stats,
         ctx.backpressure_poll_interval_s,
-        [input_name] + [s.name for s in plan],
-        meta_stages=[s.name for s in plan if isinstance(s, (TaskStage, ActorStage))],
+        stage_names,
+        meta_stages=meta_stages,
     )
     if owner is not None:
         owner._stats_builder = stats
@@ -450,6 +494,10 @@ def execute_streaming(
                 it = _task_stage(it, stage, state)
             elif isinstance(stage, ActorStage):
                 it = _actor_stage(it, stage, state, ctx.actor_max_tasks_in_flight)
+            elif isinstance(stage, ExchangeStage):
+                from ray_tpu.data._internal.exchange import run_exchange_stage
+
+                it = run_exchange_stage(it, stage, state, ctx)
             else:
                 it = _limit_stage(it, stage, state)
         try:

@@ -1,0 +1,548 @@
+"""Streaming shuffle exchange — push-based all-to-all on the ring substrate.
+
+The seed-era shuffle (`data/_shuffle.py`) materializes N×M part refs
+through the object store and only then reduces. This operator is the
+Magnet/Exoshuffle shape instead (PAPERS.md [5][14]): mappers PUSH
+partition chunks to reducer actors *as they are produced* (no N×M
+part-ref materialization), and the whole exchange is planned by the
+optimizer as a first-class stage whose launches ride the executor's
+backpressure policies — a shuffle larger than the arena budget streams
+instead of OOMing. The bounded resource is ARENA occupancy: chunks
+bypass the arena on the ring and outputs seal into it only as the
+arena policy admits finalizes. Reducer-side, chunks accumulate in the
+reducer's private heap until `finalize(j)` merges that partition — so
+reducer RSS scales with the partitions it owns (dataset/R), not with
+the arena budget.
+
+Transport matrix (per mapper-task × reducer pair):
+
+  colocated (reducer ring openable on this node) → `RingChannel`
+      chunks move through one multi-producer /dev/shm byte ring per
+      (reducer, exchange); they never touch the shm arena at all.
+      Ring-full blocks the writer (slow-reader backpressure, counted).
+  cross-node / ring unavailable / record > ring  → put/get fallback
+      the chunk rides a normal actor call (`add_part`), i.e. the object
+      plane — the same path `_shuffle.py`'s hierarchical fan-in uses.
+
+Completion protocol: every mapper sends exactly one DONE marker per
+reducer (ring record, or an acked `mapper_done` call) AFTER all its
+chunks for that reducer are delivered (fallback chunks are acked before
+the marker ships, so DONE really means "everything of mine is there").
+`finalize(j)` waits until all `n_mappers` markers arrived, merges the
+partition's chunks in deterministic (mapper, seq) order, applies the
+mode finalization (permute / sort / optional reduce_fn) and returns the
+block — launched per partition by the executor, gated by the arena
+policy, so outputs seal into the arena only as the consumer drains them.
+
+Reducer actors are pooled per driver (spawning R processes per shuffle
+would dominate small exchanges); per-exchange state is keyed by a random
+exchange id, and `end_exchange` unlinks the rings so nothing litters
+/dev/shm between shuffles.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import struct
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import ray_tpu
+from ray_tpu.data import block as B
+from ray_tpu.data._shuffle import finalize_partition, partition_block
+
+# ring record: kind, partition j, mapper idx, per-(mapper, partition) seq.
+# Format string + calcsize (not a struct.Struct instance): the reducer
+# class and mapper function are cloudpickled BY VALUE into the function
+# table (the @remote wrapper shadows their module names), and a Struct
+# object in their globals is not picklable. Padded to 24 bytes so the
+# wire payload (whose oob buffers are 64-aligned RELATIVE to it) stays
+# 8-aligned absolute — arrow rejects/warns on misaligned buffer views.
+_REC_FMT = "<BIIQ7x"
+_REC_SIZE = struct.calcsize(_REC_FMT)
+K_DATA, K_DONE, K_WAKE = 1, 2, 3
+
+_FINALIZE_TIMEOUT_S = 300.0
+
+
+def _apply_mapper_ops(blk, ops):
+    """Apply the fused upstream run inside the mapper, timing per op
+    (the chain arrives via the ONE spec put — never re-pickled per
+    chunk)."""
+    from ray_tpu.data._internal.logical_ops import as_op
+
+    per_op: Dict[str, float] = {}
+    for op in ops or []:
+        o = as_op(op)
+        ta = time.perf_counter()
+        blk = o.apply_block(blk)
+        per_op[o.name] = per_op.get(o.name, 0.0) + time.perf_counter() - ta
+    return blk, per_op
+
+
+def _iter_chunks(tbl, chunk_bytes: int):
+    """Row-slice a partition part into ring-sized chunks. Empty parts
+    still yield once: the (schema-carrying) empty table is what keeps
+    empty partitions schema-stable after the merge."""
+    if tbl.num_rows == 0 or tbl.nbytes <= chunk_bytes:
+        yield tbl
+        return
+    n_chunks = -(-tbl.nbytes // chunk_bytes)
+    per = max(1, -(-tbl.num_rows // n_chunks))
+    for off in range(0, tbl.num_rows, per):
+        yield tbl.slice(off, per)
+
+
+def _pack_data_record(j: int, midx: int, seq: int, tbl, capacity=None):
+    """One ring record: header + the object-plane wire format of the
+    chunk. The table's arrow buffers travel OUT-OF-BAND (pickle5 buffer
+    callbacks) and land via the serializer's native bulk copy — an
+    inline-buffer pickle of a 4 MiB table measured ~100x slower because
+    it byte-copies every buffer through the pickle stream. One
+    allocation, no header/payload concat. Returns None when the record
+    could never fit a ring of `capacity` — decided from the size alone,
+    BEFORE the payload copy, so an oversize chunk costs no wasted
+    memcpy on its way to the object-plane fallback."""
+    from ray_tpu._private import serialization
+    from ray_tpu.experimental.channel import RingChannel
+
+    pickled, buffers, _ = serialization.serialize(tbl)
+    total = serialization.serialized_size(pickled, buffers)
+    if capacity is not None and RingChannel._rec_size(_REC_SIZE + total) > capacity:
+        return None
+    rec = bytearray(_REC_SIZE + total)
+    struct.pack_into(_REC_FMT, rec, 0, K_DATA, j, midx, seq)
+    serialization.write_to(memoryview(rec)[_REC_SIZE:], pickled, buffers)
+    return rec
+
+
+def _unpack_data_record(rec) -> Any:
+    """Decode a ring record ZERO-COPY: the returned table's buffers
+    alias the record bytes (which the table keeps alive), so the merge
+    path pays no decode copy — arrow's concat is chunked/zero-copy and
+    only the mode finalization (permute/sort) materializes rows."""
+    from ray_tpu._private import serialization
+
+    return serialization.from_buffer(memoryview(rec)[_REC_SIZE:], zero_copy=True)
+
+
+@ray_tpu.remote
+class _ExchangeReducer:
+    """Pooled reducer endpoint: owns one multi-producer ring + one drain
+    thread per active exchange, merges chunks per partition, finalizes
+    on demand. Thread-safe (the actor runs with max_concurrency > 1 so
+    `finalize`'s wait cannot block fallback `add_part` deliveries)."""
+
+    def __init__(self):
+        self._ex: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    # -- lifecycle per exchange -----------------------------------------
+    def begin_exchange(self, xid: str, parts: List[int], ring_capacity: int,
+                       mode: str, reduce_arg, seed: int, reduce_fn) -> Dict[str, Any]:
+        from ray_tpu._private.worker import get_global_core
+
+        st: Dict[str, Any] = {
+            "parts": {j: [] for j in parts},
+            "done": set(),
+            "cv": threading.Condition(),
+            "ring": None,
+            "drain": None,
+            "closed": False,
+            "mode": mode,
+            "reduce_arg": reduce_arg,
+            "seed": seed,
+            "reduce_fn": reduce_fn,
+            "ring_bytes": 0,
+            "fallback_bytes": 0,
+            "chunks": 0,
+            "counters_reported": False,
+        }
+        path = None
+        if ring_capacity:
+            try:
+                from ray_tpu.experimental.channel import RingChannel
+
+                # multi_producer also on the CREATE side: end_exchange's
+                # K_WAKE write must take the same cross-process fcntl
+                # lock as the mappers' writes — an aborted exchange tears
+                # down while mappers may still be mid-push, and a native
+                # single-producer handle would race their head updates
+                st["ring"] = RingChannel.create(
+                    f"xch_{xid[:12]}", ring_capacity, multi_producer=True
+                )
+                path = st["ring"].path
+                st["drain"] = threading.Thread(
+                    target=self._drain_loop, args=(st,), daemon=True,
+                    name=f"xch-drain-{xid[:8]}",
+                )
+                st["drain"].start()
+            except Exception:
+                st["ring"] = None
+                path = None
+        with self._lock:
+            self._ex[xid] = st
+        core = get_global_core()
+        return {"node_id": core.node_id, "path": path}
+
+    def end_exchange(self, xid: str) -> bool:
+        with self._lock:
+            st = self._ex.pop(xid, None)
+        if st is None:
+            return False
+        st["closed"] = True
+        if st["ring"] is not None:
+            try:
+                # wake the drain thread NOW: it re-checks `closed` only
+                # when read() returns, so without a nudge every shuffle
+                # pays up to a full 0.2s read-timeout at teardown
+                st["ring"].write(struct.pack(_REC_FMT, K_WAKE, 0, 0, 0), timeout=0)
+            except Exception:
+                pass  # ring full/torn: the read timeout covers exit
+        if st["drain"] is not None:
+            st["drain"].join(timeout=5)
+        if st["ring"] is not None:
+            st["ring"].unlink()
+        return True
+
+    # -- ring ingest ----------------------------------------------------
+    def _drain_loop(self, st):
+        from ray_tpu.experimental.channel import ChannelTimeoutError
+
+        ring = st["ring"]
+        while not st["closed"]:
+            try:
+                rec = ring.read(timeout=0.2)
+            except ChannelTimeoutError:
+                continue
+            except Exception:
+                return  # ring torn down under us: exchange is over
+            kind, j, midx, seq = struct.unpack_from(_REC_FMT, rec, 0)
+            with st["cv"]:
+                if kind == K_DATA:
+                    # decode deferred to finalize: the drain thread only
+                    # appends, so a fast mapper burst never backs up the
+                    # ring behind arrow work
+                    st["parts"].setdefault(j, []).append((midx, seq, rec))
+                    st["ring_bytes"] += len(rec) - _REC_SIZE
+                    st["chunks"] += 1
+                elif kind == K_DONE:
+                    st["done"].add(midx)
+                    st["cv"].notify_all()
+                # K_WAKE: teardown nudge — loop back to the closed check
+
+    # -- fallback ingest (cross-node / oversize / ring-less) -------------
+    def add_part(self, xid: str, j: int, midx: int, seq: int, tbl) -> bool:
+        with self._lock:
+            st = self._ex.get(xid)
+        if st is None:
+            raise RuntimeError(f"exchange {xid} is not active on this reducer")
+        with st["cv"]:
+            st["parts"].setdefault(j, []).append((midx, seq, tbl))
+            st["fallback_bytes"] += tbl.nbytes
+            st["chunks"] += 1
+        return True
+
+    def mapper_done(self, xid: str, midx: int) -> bool:
+        with self._lock:
+            st = self._ex.get(xid)
+        if st is None:
+            raise RuntimeError(f"exchange {xid} is not active on this reducer")
+        with st["cv"]:
+            st["done"].add(midx)
+            st["cv"].notify_all()
+        return True
+
+    # -- output ---------------------------------------------------------
+    def finalize(self, xid: str, j: int, n_mappers: int):
+        with self._lock:
+            st = self._ex.get(xid)
+        if st is None:
+            raise RuntimeError(f"exchange {xid} is not active on this reducer")
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + _FINALIZE_TIMEOUT_S
+        with st["cv"]:
+            while len(st["done"]) < n_mappers:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RuntimeError(
+                        f"exchange {xid} partition {j}: only {len(st['done'])}"
+                        f"/{n_mappers} mappers reported done within "
+                        f"{_FINALIZE_TIMEOUT_S:.0f}s (mapper failure or lost ring?)"
+                    )
+                st["cv"].wait(timeout=min(remaining, 1.0))
+            entries = st["parts"].pop(j, [])
+            report = not st["counters_reported"]
+            st["counters_reported"] = True
+            ring_bytes, fb_bytes, chunks = st["ring_bytes"], st["fallback_bytes"], st["chunks"]
+        # deterministic merge order — chunks arrive interleaved across
+        # mappers, but (mapper idx, seq) reconstructs submission order,
+        # which is what makes seeded shuffles reproducible
+        entries.sort(key=lambda e: (e[0], e[1]))
+        tables = [
+            _unpack_data_record(e[2]) if isinstance(e[2], (bytes, bytearray))
+            else e[2]
+            for e in entries
+        ]
+        blk = B.concat_blocks(tables) if tables else B.to_block([])
+        rows_in, bytes_in = blk.num_rows, blk.nbytes
+        blk = finalize_partition(blk, st["mode"], st["reduce_arg"], st["seed"] + 31 * j + 7)
+        if st["reduce_fn"] is not None:
+            blk = st["reduce_fn"](blk)
+        meta = {
+            "rows_in": rows_in,
+            "rows_out": blk.num_rows,
+            "bytes_in": bytes_in,
+            "bytes_out": blk.nbytes,
+            "task_s": time.perf_counter() - t0,
+            "per_op_s": {},
+            # per-exchange transport counters ride the FIRST finalize of
+            # this reducer only (they are reducer-wide; attaching them to
+            # every partition would multiply them in the stats sum)
+            "exchange_ring_bytes": ring_bytes if report else 0,
+            "exchange_fallback_bytes": fb_bytes if report else 0,
+            "exchange_chunks": chunks if report else 0,
+        }
+        return blk, meta
+
+
+@ray_tpu.remote
+def _exchange_map(blk, spec, midx: int):
+    """One mapper: apply the fused upstream ops, partition the block,
+    push every partition's chunks to its reducer — ring when colocated,
+    acked actor-call fallback otherwise — then mark this mapper done on
+    every reducer. Returns ONLY a meta dict (the data already moved)."""
+    from ray_tpu._private.worker import get_global_core
+    from ray_tpu.experimental.channel import RingChannel, RingFullError
+
+    t0 = time.perf_counter()
+    rows_in, bytes_in = blk.num_rows, blk.nbytes
+    blk, per_op = _apply_mapper_ops(blk, spec.get("ops"))
+    mode, M = spec["mode"], spec["M"]
+    pm = spec.get("per_map_args")
+    arg = pm[midx] if pm is not None else spec.get("arg")
+    parts = partition_block(blk, mode, M, arg, spec["seed"] + 17 * midx + 1)
+    node_id = get_global_core().node_id
+    ring_bytes = fallback_bytes = chunks = throttled = 0
+    for rinfo, handle in zip(spec["reducers"], spec["handles"]):
+        ring = None
+        if rinfo["path"] and rinfo["node_id"] == node_id:
+            try:
+                # opening the reducer's /dev/shm path IS the colocation
+                # check (same contract as the direct actor transport)
+                ring = RingChannel.open(rinfo["path"], multi_producer=True)
+            except Exception:
+                ring = None
+        try:
+            pending = []
+            for j in rinfo["parts"]:
+                seq = 0
+                for chunk in _iter_chunks(parts[j], spec["chunk_bytes"]):
+                    sent = False
+                    if ring is not None:
+                        rec = _pack_data_record(j, midx, seq, chunk, capacity=ring.capacity)
+                        if rec is not None:
+                            try:
+                                ring.write(rec, timeout=0)
+                                sent = True
+                            except RingFullError:
+                                # slow-reader backpressure: count the
+                                # throttle, then block until there's room
+                                throttled += 1
+                                ring.write(rec, timeout=120.0)
+                                sent = True
+                        # else: record can never fit — object-plane fallback
+                    if sent:
+                        ring_bytes += len(rec) - _REC_SIZE
+                    else:
+                        pending.append(handle.add_part.remote(spec["xid"], j, midx, seq, chunk))
+                        fallback_bytes += chunk.nbytes
+                    chunks += 1
+                    seq += 1
+            if pending:
+                # fallback chunks must be RECORDED before the done marker
+                # ships (get: a failed delivery fails this mapper loudly)
+                ray_tpu.get(pending)
+            if ring is not None:
+                ring.write(struct.pack(_REC_FMT, K_DONE, 0, midx, 0), timeout=120.0)
+            else:
+                ray_tpu.get(handle.mapper_done.remote(spec["xid"], midx))
+        finally:
+            if ring is not None:
+                ring.close()
+    return {
+        "rows_in": rows_in,
+        "rows_out": sum(p.num_rows for p in parts),
+        "bytes_in": bytes_in,
+        # mapper output bytes that actually land in the ARENA: only the
+        # fallback chunks (ring bytes bypass the object plane entirely).
+        # The executor's pending-output estimate keys off this, so ring
+        # transport doesn't phantom-charge the arena budget.
+        "bytes_out": fallback_bytes,
+        "task_s": time.perf_counter() - t0,
+        "per_op_s": per_op,
+        "exchange_ring_bytes": ring_bytes,
+        "exchange_fallback_bytes": fallback_bytes,
+        "exchange_chunks": chunks,
+        "exchange_ring_throttled": throttled,
+    }
+
+
+# ---------------------------------------------------------------- driver side
+
+_POOL: Dict[str, List[Any]] = {}  # core worker_id -> reducer handles
+
+
+def _reducer_pool(n: int) -> List[Any]:
+    """Per-driver pool of reducer actors (spawned lazily, reused across
+    exchanges — an actor spawn per shuffle would dominate small ones)."""
+    from ray_tpu._private.worker import get_global_core
+
+    key = get_global_core().worker_id
+    for k in list(_POOL):
+        if k != key:
+            _POOL.pop(k, None)  # stale pool from a previous init cycle
+    handles = _POOL.setdefault(key, [])
+    while len(handles) < n:
+        handles.append(_ExchangeReducer.options(max_concurrency=8).remote())
+    return handles[:n]
+
+
+def _begin(xid: str, op, owned: List[List[int]], ring_cap: int) -> tuple:
+    """Spawn/reuse reducers and open the exchange on each; one retry
+    with a fresh pool when a pooled reducer died since the last use."""
+    from ray_tpu._private.worker import get_global_core
+
+    seed = 0 if op.seed is None else op.seed
+    for attempt in range(2):
+        handles = _reducer_pool(len(owned))
+        try:
+            infos = ray_tpu.get([
+                h.begin_exchange.remote(xid, owned[r], ring_cap, op.mode,
+                                        op.reduce_arg, seed, op.reduce_fn)
+                for r, h in enumerate(handles)
+            ])
+            return handles, infos
+        except Exception:
+            if attempt:
+                raise
+            _POOL.pop(get_global_core().worker_id, None)
+    raise RuntimeError("unreachable")
+
+
+def _reap(pending: List[Any], state, name: str, timeout: float) -> List[Any]:
+    """Consume any resolved mapper metas from the stage window."""
+    if not pending:
+        return pending
+    try:
+        ready, rest = ray_tpu.wait(pending, num_returns=len(pending), timeout=timeout)
+    except Exception:
+        return pending
+    for _ in ready:
+        state.consumed(name)
+    return rest
+
+
+def _map_phase(upstream: Iterator, spec_ref, stage, state) -> tuple:
+    """Launch one mapper task per upstream block, policy-gated; returns
+    (mapper count, total bytes pushed) once every mapper has COMPLETED
+    (reducers need the exact count before any partition can finalize;
+    the byte total seeds the finalize stage's output-size estimate)."""
+    name = stage.map_name
+    pending: List[Any] = []
+    launched: List[Any] = []
+    n = 0
+    for ref in upstream:
+        while not state.admit(name):
+            got = _reap(pending, state, name, timeout=0)
+            if got is pending or len(got) == len(pending):
+                time.sleep(state.poll_interval)
+            pending = got
+        meta_ref = _exchange_map.remote(ref, spec_ref, n)
+        state.launched(name, meta_ref)
+        state.stats.add_meta(name, meta_ref)
+        pending.append(meta_ref)
+        launched.append(meta_ref)
+        n += 1
+    while pending:
+        pending = _reap(pending, state, name, timeout=0.05)
+    total_pushed = 0
+    if launched:
+        # tiny meta dicts, ONE bulk fetch — this is the error barrier: a
+        # failed mapper raises here instead of wedging finalize() for
+        # its full done-marker timeout
+        for m in ray_tpu.get(launched):
+            total_pushed += m.get("exchange_ring_bytes", 0) \
+                + m.get("exchange_fallback_bytes", 0)
+    return n, total_pushed
+
+
+def _reduce_phase(xid: str, handles, M: int, n_mappers: int, stage, state) -> Iterator:
+    """Finalize partitions one by one, gated by the backpressure
+    policies — outputs seal into the arena only as the consumer drains,
+    which is what keeps a larger-than-arena shuffle inside its budget.
+    No driver-side get here: finalize results stream to the consumer as
+    refs."""
+    from ray_tpu.data._executor import _gated
+
+    name = stage.name
+    R = len(handles)
+    fin = [h.finalize.options(num_returns=2) for h in handles]
+    buf: collections.deque = collections.deque()
+    for j in range(M):
+        yield from _gated(state, name, buf)
+        out, meta = fin[j % R].remote(xid, j, n_mappers)
+        state.launched(name, meta)
+        state.stats.add_meta(name, meta)
+        buf.append(out)
+    while buf:
+        state.consumed(name)
+        yield buf.popleft()
+
+
+def run_exchange_stage(upstream: Iterator, stage, state, ctx) -> Iterator:
+    """Execute one ExchangeStage inside the streaming executor."""
+    op = stage.op
+    M = op.M
+    xid = os.urandom(8).hex()
+    R = max(1, min(M, int(ctx.exchange_num_reducers)))
+    owned = [list(range(r, M, R)) for r in range(R)]
+    ring_cap = int(ctx.exchange_ring_capacity) if ctx.exchange_use_rings else 0
+    handles, infos = _begin(xid, op, owned, ring_cap)
+    spec = {
+        "xid": xid,
+        "mode": op.mode,
+        "M": M,
+        "arg": op.arg,
+        "seed": 0 if op.seed is None else op.seed,
+        "per_map_args": op.per_map_args,
+        "chunk_bytes": int(ctx.exchange_chunk_bytes),
+        "ops": stage.mapper_ops,
+        "reducers": [
+            {"parts": owned[r], "path": infos[r]["path"], "node_id": infos[r]["node_id"]}
+            for r in range(R)
+        ],
+        "handles": handles,
+    }
+    # ONE put carries the whole exchange plan (ops chain included) to
+    # every mapper — nothing is re-pickled per block or per chunk
+    spec_ref = ray_tpu.put(spec)
+    # ring-borne mapper output never lands in the arena: seed the size
+    # estimate so the arena policy's unsized slow-start (meant for
+    # arena-writing stages) does not serialize mapper launches while the
+    # first meta is still in flight
+    state.seed_estimate(stage.map_name, 0.0)
+    try:
+        n_mappers, total_pushed = _map_phase(upstream, spec_ref, stage, state)
+        # finalize outputs DO seal into the arena at ~total/M bytes each;
+        # seeding that honest size skips the unsized probe stall AND
+        # gives admission a real number to charge per in-flight finalize
+        state.seed_estimate(stage.name, total_pushed / max(1, M))
+        yield from _reduce_phase(xid, handles, M, n_mappers, stage, state)
+    finally:
+        try:
+            done = [h.end_exchange.remote(xid) for h in handles]
+            ray_tpu.wait(done, num_returns=len(done), timeout=30)
+        except Exception:
+            pass

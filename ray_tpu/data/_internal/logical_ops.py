@@ -230,6 +230,49 @@ class Limit(LogicalOp):
         return blk.slice(0, min(self.n, blk.num_rows))
 
 
+class Exchange(LogicalOp):
+    """All-to-all repartition barrier — the streaming shuffle exchange
+    (data/_internal/exchange.py). NOT fusable as a narrow op: the planner
+    lowers it to an ExchangeStage whose mappers push partition chunks to
+    reducer actors over shm rings (put/get refs across nodes) as blocks
+    arrive, and whose reducers buffer chunks heap-side and merge each
+    partition at finalize — no N×M part-ref materialization (the
+    seed-era 2-stage shuffle in data/_shuffle.py survives only as the
+    legacy/cross-node fallback path).
+
+    mode: "random" (shuffle), "range" (sort), "chunk" (repartition),
+    "hash" (groupby placement). `arg` is per-mode (range boundaries /
+    hash key), `per_map_args` per-mapper (chunk offsets), `reduce_fn` an
+    optional post-merge transform applied reducer-side (groupby
+    aggregates there instead of rematerializing every partition)."""
+
+    kind = "exchange"
+    fusable = False
+
+    def __init__(self, mode: str, num_partitions: int, arg=None, reduce_arg=None,
+                 seed: Optional[int] = None, per_map_args: Optional[List] = None,
+                 reduce_fn: Optional[Callable] = None):
+        if mode not in ("random", "range", "chunk", "hash"):
+            raise ValueError(f"unknown exchange mode {mode}")
+        self.mode = mode
+        self.M = int(num_partitions)
+        self.arg = arg
+        self.reduce_arg = reduce_arg
+        self.seed = seed
+        self.per_map_args = per_map_args
+        self.reduce_fn = reduce_fn
+
+    @property
+    def name(self):
+        return f"Exchange[{self.mode}]"
+
+    def apply_block(self, blk):
+        raise RuntimeError(
+            "Exchange is a barrier operator — it cannot apply per block; "
+            "execute through the plan (materialize/iter_batches)"
+        )
+
+
 _LEGACY = {
     "map": lambda fn, kw: MapRows(fn),
     "map_batches": lambda fn, kw: MapBatches(

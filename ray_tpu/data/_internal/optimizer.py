@@ -34,6 +34,7 @@ from typing import List, Optional
 
 from ray_tpu.data._internal.logical_ops import (
     DropColumns,
+    Exchange,
     Limit,
     LogicalOp,
     MapBatches,
@@ -72,6 +73,21 @@ class LimitStage(Stage):
     def __init__(self, n: int):
         self.n = n
         self.name = f"Limit[{n}]"
+
+
+class ExchangeStage(Stage):
+    """Streaming all-to-all exchange (data/_internal/exchange.py). Any
+    run of fusable narrow ops immediately upstream folds into the
+    mappers (`mapper_ops`) — one task per block applies the whole chain
+    AND partitions, exactly like the seed shuffle's fused map stage."""
+
+    def __init__(self, op: Exchange, mapper_ops: Optional[List[LogicalOp]] = None):
+        self.op = op
+        self.mapper_ops = mapper_ops or []
+        self.name = op.name
+        # the stage owns TWO launch windows: mapper tasks and reducer
+        # finalizes — separate names so caps/stats/metas don't alias
+        self.map_name = f"ExchangeMap[{op.mode}]"
 
 
 def optimize(ops: List[LogicalOp], *, limit_pushdown: bool = True) -> List[LogicalOp]:
@@ -142,6 +158,17 @@ def build_plan(
         elif isinstance(op, Limit):
             flush()
             stages.append(LimitStage(op.n))
+        elif isinstance(op, Exchange):
+            # steal the pending fused run into the exchange's mappers:
+            # apply-chain + partition in ONE task per block instead of a
+            # separate task stage feeding the exchange
+            mapper_ops, run = run, []
+            if not fusion:
+                # fusion off (debug): keep per-op stages, bare mappers
+                for o in mapper_ops:
+                    stages.append(TaskStage([o]))
+                mapper_ops = []
+            stages.append(ExchangeStage(op, mapper_ops))
         else:
             run.append(op)
     flush()
@@ -154,6 +181,8 @@ def build_plan(
         seen[s.name] = n + 1
         if n:
             s.name = f"{s.name}#{n + 1}"
+            if isinstance(s, ExchangeStage):
+                s.map_name = f"{s.map_name}#{n + 1}"
     return stages
 
 
@@ -166,3 +195,11 @@ def has_actor_stage(ops: Optional[List]) -> bool:
 
 def has_limit(ops: Optional[List]) -> bool:
     return any(isinstance(as_op(op), Limit) for op in ops or [])
+
+
+def has_barrier(ops: Optional[List]) -> bool:
+    """True when the chain contains an op that cannot be applied
+    independently per block (Limit's global budget, Exchange's
+    all-to-all) — such chains must execute through the plan before a
+    per-block consumer (shuffle maps, preprocessor fits) may run."""
+    return any(isinstance(as_op(op), (Limit, Exchange)) for op in ops or [])

@@ -43,7 +43,34 @@ def _data_metrics_factory():
             "ray_tpu_data_backpressure_throttles_total",
             "launch refusals by policy",
             tag_keys=("operator", "policy")),
+        exchange_ring_bytes=metrics.Counter(
+            "ray_tpu_data_exchange_ring_bytes_total",
+            "exchange bytes moved over shm rings",
+            tag_keys=("operator",)),
+        exchange_fallback_bytes=metrics.Counter(
+            "ray_tpu_data_exchange_fallback_bytes_total",
+            "exchange bytes moved via put/get fallback",
+            tag_keys=("operator",)),
+        exchange_chunks=metrics.Counter(
+            "ray_tpu_data_exchange_chunks_total",
+            "exchange chunks streamed",
+            tag_keys=("operator",)),
+        exchange_ring_throttled=metrics.Counter(
+            "ray_tpu_data_exchange_ring_throttles_total",
+            "mapper writes that hit a full ring (slow-reader backpressure)",
+            tag_keys=("operator",)),
     )
+
+
+# optional per-exchange counters carried in task metas (mapper AND
+# reducer sides both report; sums surface per stage in stats() and
+# bridge into the metrics pipeline like the other operator counters)
+_EXCHANGE_KEYS = (
+    "exchange_ring_bytes",
+    "exchange_fallback_bytes",
+    "exchange_chunks",
+    "exchange_ring_throttled",
+)
 
 
 _data_metrics = _metric_singletons(_data_metrics_factory)
@@ -97,6 +124,13 @@ class DatasetStats:
             if m.get("throttled"):
                 th = ", ".join(f"{k}: {v}" for k, v in m["throttled"].items())
                 parts.append(f"throttled({th})")
+            if m.get("exchange_chunks"):
+                parts.append(
+                    f"exchange({_fmt_bytes(m.get('exchange_ring_bytes', 0))} ring, "
+                    f"{_fmt_bytes(m.get('exchange_fallback_bytes', 0))} fallback, "
+                    f"{m['exchange_chunks']} chunks, "
+                    f"{m.get('exchange_ring_throttled', 0)} ring-throttles)"
+                )
             lines.append(f"  Operator {i} {name}: " + ", ".join(parts))
             for op_name, s in (m.get("per_op_s") or {}).items():
                 lines.append(f"    - {op_name}: {s * 1e3:.0f}ms")
@@ -235,6 +269,10 @@ class StatsBuilder:
                             per[k] = per.get(k, 0.0) + v
                     if per:
                         m["per_op_s"] = per
+                    for key in _EXCHANGE_KEYS:
+                        total = sum(x.get(key, 0) for x in metas)
+                        if total:
+                            m[key] = total
             for k, v in self._driver_counts.get(name, {}).items():
                 m[k] = m.get(k, 0) + v
             operators[name] = m
@@ -284,5 +322,8 @@ class StatsBuilder:
                     m["bytes_out"].inc(op["bytes_out"], tags=tags)
                 if op.get("task_s"):
                     m["task_time"].inc(op["task_s"], tags=tags)
+                for key in _EXCHANGE_KEYS:
+                    if op.get(key):
+                        m[key].inc(op[key], tags=tags)
         except Exception:
             pass

@@ -1,11 +1,21 @@
-"""Distributed 2-stage shuffle primitives for Dataset.
+"""Distributed 2-stage shuffle primitives for Dataset — the LEGACY /
+fallback exchange path.
 
-Equivalent of the reference's push-based shuffle
+Equivalent of the reference's pull-based shuffle
 (reference: python/ray/data/_internal/planner/exchange/ — the
 map-partition / reduce-merge task pattern behind repartition,
 random_shuffle and range-partitioned sort). The driver only touches
 refs: every row moves worker-to-worker through the object store, so no
 operation materializes the dataset in the driver process.
+
+The DEFAULT shuffle path is now the streaming exchange
+(`data/_internal/exchange.py`): mappers push partition chunks to
+reducer actors over shm rings as they are produced, so no N×M part-ref
+set ever materializes. This module remains as (a) the partition-function
+library the streaming mappers share (`partition_block`), and (b) the
+whole-pipeline fallback selected by
+`DataContext.use_streaming_exchange = False` — its hierarchical fan-in
+is the shape cross-node exchanges without a shared arena fall back to.
 
 Map stage: each input block is split into M parts (random assignment,
 range partition by sampled boundaries, or contiguous chunks). Reduce
@@ -20,43 +30,62 @@ import ray_tpu
 from ray_tpu.data import block as B
 
 
-@ray_tpu.remote
-def _map_partition(blk, ops, mode: str, M: int, arg, seed: int):
+def partition_block(blk, mode: str, M: int, arg, seed: int):
+    """Split one block into M parts (shared by the legacy map task AND
+    the streaming exchange mappers): random assignment, range partition
+    by sampled boundaries, contiguous chunks, or deterministic key
+    hash."""
     import numpy as np
 
-    from ray_tpu.data.dataset import _apply_ops_local
-
-    blk = _apply_ops_local(blk, ops)
-    n = blk.num_rows
-    if M == 1:
-        # with num_returns=1 the executor treats the return value itself
-        # as the single result — a 1-tuple would arrive as a tuple
-        return blk
     if mode == "random":
         rng = np.random.default_rng(seed)
-        assign = rng.integers(0, M, size=n)
-        parts = tuple(blk.take(np.nonzero(assign == j)[0]) for j in range(M))
-    elif mode == "range":
+        assign = rng.integers(0, M, size=blk.num_rows)
+        return [blk.take(np.nonzero(assign == j)[0]) for j in range(M)]
+    if mode == "range":
         key, descending, boundaries = arg
         col = np.asarray(blk.column(key))
         idx = np.searchsorted(np.asarray(boundaries), col, side="right")
         if descending:
             idx = (M - 1) - idx
-        parts = tuple(blk.take(np.nonzero(idx == j)[0]) for j in range(M))
-    elif mode == "chunk":
+        return [blk.take(np.nonzero(idx == j)[0]) for j in range(M)]
+    if mode == "chunk":
         start, per = arg  # global row offset of this block, rows per output
-        ends = np.arange(n) + start
+        ends = np.arange(blk.num_rows) + start
         idx = np.minimum(ends // per, M - 1)
-        parts = tuple(blk.take(np.nonzero(idx == j)[0]) for j in range(M))
-    elif mode == "hash":
+        return [blk.take(np.nonzero(idx == j)[0]) for j in range(M)]
+    if mode == "hash":
         # deterministic key hash (Python's str hash is seed-randomized
         # PER PROCESS — using it would scatter one key across reducers)
         key = arg
         idx = _hash_partition_index(blk.column(key), M)
-        parts = tuple(blk.take(np.nonzero(idx == j)[0]) for j in range(M))
-    else:
-        raise ValueError(f"unknown partition mode {mode}")
-    return parts
+        return [blk.take(np.nonzero(idx == j)[0]) for j in range(M)]
+    raise ValueError(f"unknown partition mode {mode}")
+
+
+def finalize_partition(blk, mode: str, reduce_arg, seed: int):
+    """Per-partition post-merge step (shared with the streaming
+    reducers): permute for random shuffle, sort for range partition."""
+    import numpy as np
+
+    if mode == "random":
+        rng = np.random.default_rng(seed)
+        return blk.take(rng.permutation(blk.num_rows))
+    if mode == "range":
+        key, descending = reduce_arg
+        return blk.sort_by([(key, "descending" if descending else "ascending")])
+    return blk
+
+
+@ray_tpu.remote
+def _map_partition(blk, ops, mode: str, M: int, arg, seed: int):
+    from ray_tpu.data.dataset import _apply_ops_local
+
+    blk = _apply_ops_local(blk, ops)
+    if M == 1:
+        # with num_returns=1 the executor treats the return value itself
+        # as the single result — a 1-tuple would arrive as a tuple
+        return blk
+    return tuple(partition_block(blk, mode, M, arg, seed))
 
 
 def _hash_partition_index(col, M: int):
@@ -86,16 +115,7 @@ def _hash_partition_index(col, M: int):
 
 @ray_tpu.remote
 def _reduce_merge(mode: str, arg, seed: int, *parts):
-    import numpy as np
-
-    blk = B.concat_blocks(list(parts))
-    if mode == "random":
-        rng = np.random.default_rng(seed)
-        blk = blk.take(rng.permutation(blk.num_rows))
-    elif mode == "range":
-        key, descending = arg
-        blk = blk.sort_by([(key, "descending" if descending else "ascending")])
-    return blk
+    return finalize_partition(B.concat_blocks(list(parts)), mode, arg, seed)
 
 
 @ray_tpu.remote

@@ -42,6 +42,24 @@ class DataContext:
         # -- actor-pool stages -----------------------------------------
         self.actor_max_tasks_in_flight: int = 2
 
+        # -- streaming exchange (shuffle/sort/repartition/groupby) ------
+        # False restores the seed-era 2-stage shuffle (data/_shuffle.py):
+        # N×M part refs through the object store, hierarchical fan-in
+        self.use_streaming_exchange: bool = True
+        # chunks ride shm rings between colocated mappers/reducers;
+        # False forces the put/get (object-plane) path everywhere
+        self.exchange_use_rings: bool = True
+        # reducer actors per exchange (pooled across exchanges); each
+        # owns M/R partitions and one ring
+        self.exchange_num_reducers: int = 2
+        # byte ring per (reducer, exchange): ring-full blocks mappers —
+        # this IS the transport-level backpressure bound
+        self.exchange_ring_capacity: int = 16 * 1024 * 1024
+        # partition parts are pushed in chunks of at most this many bytes
+        # (bigger chunks amortize per-record costs; the ring must hold a
+        # few records so writers keep streaming while the reducer drains)
+        self.exchange_chunk_bytes: int = 2 * 1024 * 1024
+
     @classmethod
     def get_current(cls) -> "DataContext":
         if cls._current is None:

@@ -165,13 +165,19 @@ class Dataset:
 
     def _exchange_inputs(self):
         """(source refs, ops chain) safe to apply independently per
-        block inside exchange/fit map tasks. A global Limit cannot be
-        applied per block, so chains containing one execute first."""
-        from ray_tpu.data._internal.optimizer import has_limit
+        block inside exchange/fit map tasks. A global Limit (or an
+        earlier Exchange) cannot be applied per block, so chains
+        containing one execute first."""
+        from ray_tpu.data._internal.optimizer import has_barrier
 
-        if has_limit(self._ops):
+        if has_barrier(self._ops):
             return self._execute_refs(), []
         return self._forced(), self._ops
+
+    def _use_streaming_exchange(self) -> bool:
+        from ray_tpu.data.context import DataContext
+
+        return DataContext.get_current().use_streaming_exchange
 
     # ------------------------------------------------------------ transforms
     def _with_op(self, op: L.LogicalOp) -> "Dataset":
@@ -259,10 +265,13 @@ class Dataset:
         return self._execute_refs()
 
     # ------------------------------------------------------------ reshaping
-    # All three reshaping ops run as distributed 2-stage exchanges — the
-    # driver only moves refs, never rows (reference: push-based shuffle,
-    # data/_internal/planner/exchange/; replaces the round-1 versions that
-    # concatenated the whole dataset in the driver).
+    # All three reshaping ops run as distributed exchanges — the driver
+    # only moves refs, never rows. The DEFAULT path appends a streaming
+    # Exchange operator to the plan (data/_internal/exchange.py: mappers
+    # push partition chunks to reducer actors over shm rings as blocks
+    # arrive, object-plane fallback across nodes, backpressure via the
+    # executor's policies). `DataContext.use_streaming_exchange = False`
+    # restores the seed-era 2-stage shuffle (data/_shuffle.py).
 
     def repartition(self, num_blocks: int) -> "Dataset":
         from ray_tpu.data._shuffle import _block_count, shuffle_exchange
@@ -271,6 +280,8 @@ class Dataset:
             return Dataset([])
         src_refs, ops = self._exchange_inputs()
         ops_ref = ray_tpu.put(ops) if ops else None
+        # chunk partitioning needs each mapper's global row offset: a
+        # counts prepass (integers only) — shared by both paths
         counts = ray_tpu.get([_block_count.remote(r, ops_ref) for r in src_refs])
         total = sum(counts)
         per = max(1, (total + num_blocks - 1) // num_blocks)
@@ -279,6 +290,10 @@ class Dataset:
         for c in counts:
             offsets.append((acc, per))
             acc += c
+        if self._use_streaming_exchange():
+            return Dataset(src_refs, ops, source=self._source)._with_op(
+                L.Exchange("chunk", num_blocks, per_map_args=offsets)
+            )
         refs = shuffle_exchange(
             src_refs, ops, "chunk", num_blocks, per_map_args=offsets, ops_ref=ops_ref
         )
@@ -289,6 +304,14 @@ class Dataset:
 
         if not self._block_refs:
             return Dataset([])
+        if self._use_streaming_exchange():
+            # pure plan rewrite — no prepass: a Limit earlier in the
+            # chain becomes a LimitStage ahead of the ExchangeStage.
+            # num_blocks (not len(block_refs)): an earlier Exchange in
+            # the chain (repartition) changes the block count and M must
+            # follow it, as the legacy path's post-barrier refs do
+            M = max(1, self.num_blocks())
+            return self._with_op(L.Exchange("random", M, seed=seed))
         src_refs, ops = self._exchange_inputs()
         M = max(1, len(src_refs))
         refs = shuffle_exchange(src_refs, ops, "random", M, seed=seed)
@@ -316,6 +339,13 @@ class Dataset:
         else:
             qs = [len(allkeys) * j // M for j in builtins.range(1, M)]
             boundaries = list(allkeys[qs])
+        if self._use_streaming_exchange():
+            return Dataset(src_refs, ops, source=self._source)._with_op(
+                L.Exchange(
+                    "range", M, arg=(key, descending, boundaries),
+                    reduce_arg=(key, descending),
+                )
+            )
         refs = shuffle_exchange(
             src_refs,
             ops,
@@ -587,6 +617,11 @@ class Dataset:
         return ray_tpu.get(refs[0]).schema
 
     def num_blocks(self) -> int:
+        # a trailing Exchange repartitions to its M outputs (e.g.
+        # repartition(6).num_blocks() == 6 before any execution)
+        for op in reversed(self._ops):
+            if isinstance(op, L.Exchange):
+                return op.M
         return len(self._block_refs)
 
     def show(self, n: int = 20):

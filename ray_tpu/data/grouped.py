@@ -64,14 +64,14 @@ Mean = _arrow_builtin("mean")
 Std = _arrow_builtin("stddev", "std")
 
 
-@ray_tpu.remote
-def _agg_partition(key: str, aggs, *parts) -> pa.Table:
-    """One hash partition: concat its parts and aggregate with pyarrow
-    (builtins) and/or a python fold (custom AggregateFn)."""
-    live = [p for p in parts if p is not None and p.num_rows]
-    if not live:
+def _agg_table(key: str, aggs, tbl: pa.Table) -> pa.Table:
+    """Aggregate one already-merged hash partition with pyarrow
+    (builtins) and/or a python fold (custom AggregateFn). Shared by the
+    legacy per-partition task AND the streaming exchange's reducer-side
+    reduce_fn (aggregating IN the reducer means partitions never
+    rematerialize through the arena)."""
+    if tbl.num_rows == 0:
         return B.to_block([])
-    tbl = B.concat_blocks(live)
     arrow_specs = []
     custom: List[AggregateFn] = []
     for a in aggs:
@@ -106,15 +106,22 @@ def _agg_partition(key: str, aggs, *parts) -> pa.Table:
 
 
 @ray_tpu.remote
-def _map_groups_partition(key: str, fn, *parts):
-    """One hash partition of map_groups: run fn per key group, in a task."""
+def _agg_partition(key: str, aggs, *parts) -> pa.Table:
+    """Legacy path: one hash partition arrives as N mapper parts."""
+    live = [p for p in parts if p is not None and p.num_rows]
+    if not live:
+        return B.to_block([])
+    return _agg_table(key, aggs, B.concat_blocks(live))
+
+
+def _map_groups_table(key: str, fn, tbl: pa.Table):
+    """Run fn per key group over one merged hash partition (shared by
+    the legacy task and the exchange reduce_fn)."""
     import pyarrow.compute as pc
 
-    live = [p for p in parts if p is not None and p.num_rows]
     rows: List[Dict] = []
-    if not live:
+    if tbl.num_rows == 0:
         return B.to_block(rows)
-    tbl = B.concat_blocks(live)
     for k in tbl.column(key).unique().to_pylist():
         sub = tbl.filter(pc.equal(tbl.column(key), pa.scalar(k, tbl.column(key).type)))
         result = fn(sub.to_pylist())
@@ -122,10 +129,37 @@ def _map_groups_partition(key: str, fn, *parts):
     return B.to_block(rows)
 
 
+@ray_tpu.remote
+def _map_groups_partition(key: str, fn, *parts):
+    """Legacy path: one hash partition of map_groups as N mapper parts."""
+    live = [p for p in parts if p is not None and p.num_rows]
+    if not live:
+        return B.to_block([])
+    return _map_groups_table(key, fn, B.concat_blocks(live))
+
+
 class GroupedData:
     def __init__(self, ds, key: str):
         self._ds = ds
         self._key = key
+
+    def _use_streaming(self) -> bool:
+        from ray_tpu.data.context import DataContext
+
+        return DataContext.get_current().use_streaming_exchange
+
+    def _exchanged(self, reduce_fn):
+        """Streaming path: hash-exchange the dataset and run the
+        per-partition reduction INSIDE the exchange reducers (the merged
+        partition never rematerializes through the arena — only the
+        reduced table does)."""
+        from ray_tpu.data._internal import logical_ops as L
+        from ray_tpu.data.dataset import Dataset
+
+        M = max(1, min(self._ds.num_blocks(), 64))
+        return self._ds._with_op(
+            L.Exchange("hash", M, arg=self._key, reduce_fn=reduce_fn)
+        )
 
     def _partitions(self) -> List[List[Any]]:
         """Hash-partition the dataset's blocks by key: returns M lists of
@@ -156,10 +190,16 @@ class GroupedData:
         return [[p[j] for p in parts] for j in range(M)]
 
     def aggregate(self, *aggs: AggregateFn):
-        """Composable distributed aggregation: one task per hash
-        partition; the result Dataset holds one block ref per partition."""
+        """Composable distributed aggregation: every key lands wholly in
+        one hash partition, aggregated where the partition merges (the
+        exchange reducer on the streaming path, one task per partition
+        on the legacy path); the result Dataset holds one block ref per
+        partition."""
         from ray_tpu.data.dataset import Dataset
 
+        if self._use_streaming():
+            key, aggs_l = self._key, list(aggs)
+            return self._exchanged(lambda tbl: _agg_table(key, aggs_l, tbl))
         out = [
             _agg_partition.remote(self._key, list(aggs), *partition)
             for partition in self._partitions()
@@ -188,10 +228,14 @@ class GroupedData:
         return self._builtin(Std, on)
 
     def map_groups(self, fn: Callable):
-        """fn(list-of-row-dicts) -> row dict or list of row dicts, run as
-        one task per hash partition (each key's rows are colocated)."""
+        """fn(list-of-row-dicts) -> row dict or list of row dicts, run
+        where each hash partition merges (each key's rows are
+        colocated)."""
         from ray_tpu.data.dataset import Dataset
 
+        if self._use_streaming():
+            key = self._key
+            return self._exchanged(lambda tbl: _map_groups_table(key, fn, tbl))
         fn_ref = ray_tpu.put(fn)
         out = [
             _map_groups_partition.remote(self._key, fn_ref, *partition)

@@ -717,6 +717,60 @@ int shm_store_dump_entries(void* handle, uint8_t* ids, int64_t* refs,
   return n;
 }
 
+// --- zero-copy put helper: parallel bulk copy ---
+//
+// The put path's single real cost for large objects is the one
+// host->arena memcpy. Python-side copies (numpy slice assignment into a
+// ctypes-backed view) measure well below libc memcpy on the same box
+// (3.3 vs 5.4 GiB/s observed), and one core cannot saturate DRAM — so
+// the serializer hands large out-of-band buffers here: plain memcpy
+// fanned across a few threads (thread spawn is ~20us, noise for the
+// >=4 MiB chunks this is used on). Called through the GIL-releasing
+// CDLL binding, so reader/executor threads keep running during the copy.
+
+struct CopyJob {
+  uint8_t* dst;
+  const uint8_t* src;
+  uint64_t n;
+};
+
+static void* copy_worker(void* arg) {
+  CopyJob* j = reinterpret_cast<CopyJob*>(arg);
+  memcpy(j->dst, j->src, j->n);
+  return nullptr;
+}
+
+void shm_copy_mt(uint8_t* dst, const uint8_t* src, uint64_t n, int nthreads) {
+  if (nthreads < 2 || n < (1ULL << 20)) {
+    memcpy(dst, src, n);
+    return;
+  }
+  if (nthreads > 8) nthreads = 8;
+  // split on cacheline boundaries; main thread takes the first chunk so
+  // only nthreads-1 spawns are paid
+  uint64_t per = (n / nthreads) & ~63ULL;
+  pthread_t th[8];
+  CopyJob jobs[8];
+  int spawned = 0;
+  for (int i = 1; i < nthreads; i++) {
+    jobs[i].dst = dst + i * per;
+    jobs[i].src = src + i * per;
+    jobs[i].n = (i == nthreads - 1) ? (n - i * per) : per;
+    if (pthread_create(&th[i], nullptr, copy_worker, &jobs[i]) != 0) break;
+    spawned = i;
+  }
+  // whatever failed to spawn folds into the main thread's chunk
+  uint64_t main_n = (spawned + 1 < nthreads) ? (n - spawned * per) : per;
+  if (spawned == 0) main_n = n;
+  memcpy(dst, src, spawned ? per : main_n);
+  if (spawned && spawned + 1 < nthreads) {
+    // partial spawn: main thread also covers the unspawned tail
+    uint64_t done = (uint64_t)(spawned + 1) * per;
+    if (done < n) memcpy(dst + done, src + done, n - done);
+  }
+  for (int i = 1; i <= spawned; i++) pthread_join(th[i], nullptr);
+}
+
 // List up to max_n sealed object ids into out (16 bytes each); returns count.
 int shm_store_list(void* handle, uint8_t* out, int max_n) {
   Store* s = reinterpret_cast<Store*>(handle);

@@ -1,0 +1,289 @@
+"""Streaming exchange (data/_internal/exchange.py) — parity with the
+legacy 2-stage shuffle, streaming boundedness under an arena budget,
+zero-copy object-plane semantics, and leak audits.
+
+The parity contract per ISSUE 12: row-SET equality for random, sorted
+order for range, exact global order for chunk (repartition), and
+deterministic key placement for hash — the two paths need not agree on
+permutations (different seed plumbing), only on semantics.
+"""
+import gc
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.data.context import DataContext
+
+
+ARENA = 256 * 1024 * 1024
+
+
+@pytest.fixture(scope="module")
+def ray_start_exchange():
+    ray_tpu.init(num_cpus=4, object_store_memory=ARENA)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ctx():
+    """DataContext with every knob restored after the test."""
+    c = DataContext.get_current()
+    saved = dict(c.__dict__)
+    yield c
+    c.__dict__.update(saved)
+
+
+def _rows(ds):
+    return ds.take_all()
+
+
+def _with_legacy(c, fn):
+    c.use_streaming_exchange = False
+    try:
+        return fn()
+    finally:
+        c.use_streaming_exchange = True
+
+
+# ------------------------------------------------------------------ parity
+
+
+def test_random_parity_and_determinism(ray_start_exchange, ctx):
+    ds = rd.range(300, parallelism=5)
+    new = [r["id"] for r in _rows(ds.random_shuffle(seed=11))]
+    old = _with_legacy(ctx, lambda: [r["id"] for r in _rows(ds.random_shuffle(seed=11))])
+    assert sorted(new) == sorted(old) == list(range(300))
+    assert new != list(range(300))
+    # same seed, same path -> identical permutation (ring chunk arrival
+    # order is nondeterministic; the (mapper, seq) merge order must hide it)
+    again = [r["id"] for r in _rows(ds.random_shuffle(seed=11))]
+    assert new == again
+
+
+def test_range_parity(ray_start_exchange, ctx):
+    ds = rd.range(400, parallelism=4).map(lambda r: {"k": 399 - r["id"]})
+    new = [r["k"] for r in _rows(ds.sort("k"))]
+    old = _with_legacy(ctx, lambda: [r["k"] for r in _rows(ds.sort("k"))])
+    assert new == old == list(range(400))
+    newd = [r["k"] for r in _rows(ds.sort("k", descending=True))]
+    assert newd == list(range(399, -1, -1))
+
+
+def test_chunk_parity_exact_order(ray_start_exchange, ctx):
+    ds = rd.range(250, parallelism=3)
+    new = [r["id"] for r in _rows(ds.repartition(7))]
+    old = _with_legacy(ctx, lambda: [r["id"] for r in _rows(ds.repartition(7))])
+    # chunk mode preserves EXACT global row order on both paths
+    assert new == old == list(range(250))
+    assert ds.repartition(7).num_blocks() == 7
+
+
+def test_repartition_then_shuffle_block_count(ray_start_exchange, ctx):
+    # random_shuffle must size its Exchange from num_blocks() — an
+    # earlier repartition in the chain changes the block count, and the
+    # streaming path must match the legacy path's post-barrier refs
+    ds = rd.range(120, parallelism=3).repartition(10)
+    sh = ds.random_shuffle(seed=5)
+    assert sh.num_blocks() == 10
+    out = sh.materialize()
+    assert out.num_blocks() == 10
+    assert sorted(r["id"] for r in _rows(out)) == list(range(120))
+    legacy_n = _with_legacy(
+        ctx, lambda: ds.random_shuffle(seed=5).materialize().num_blocks()
+    )
+    assert out.num_blocks() == legacy_n
+
+
+def test_hash_deterministic_placement(ray_start_exchange, ctx):
+    from ray_tpu.data._internal import logical_ops as L
+    from ray_tpu.data._shuffle import _hash_partition_index
+
+    n_keys = 23
+    ds = rd.from_items([{"k": i % n_keys, "v": i} for i in range(230)])
+    parts = ds._with_op(L.Exchange("hash", 4, arg="k"))
+    blocks = ray_tpu.get(parts._execute_refs())
+    assert len(blocks) == 4
+    # every key lands wholly in ONE partition, and that partition is the
+    # deterministic hash index — the same contract groupby relies on
+    import pyarrow as pa
+
+    for j, blk in enumerate(blocks):
+        if blk.num_rows == 0:
+            continue
+        idx = _hash_partition_index(blk.column("k"), 4)
+        assert (np.asarray(idx) == j).all(), f"foreign keys in partition {j}"
+    total = sum(b.num_rows for b in blocks)
+    assert total == 230
+    # groupby rides the same placement: aggregates must be exact
+    out = {r["k"]: r["v_sum"] for r in _rows(ds.groupby("k").sum("v"))}
+    exp = {}
+    for i in range(230):
+        exp[i % n_keys] = exp.get(i % n_keys, 0) + i
+    assert out == exp
+
+
+def test_fallback_path_parity(ray_start_exchange, ctx):
+    """Rings disabled: every chunk takes the put/get (object-plane)
+    fallback — the cross-node path — and the results must be identical."""
+    ds = rd.range(200, parallelism=4)
+    ctx.exchange_use_rings = False
+    ids = [r["id"] for r in _rows(ds.random_shuffle(seed=3))]
+    assert sorted(ids) == list(range(200))
+    sh = ds.random_shuffle(seed=3)
+    sh.materialize()
+    st = sh.stats().to_dict()["operators"]
+    map_m = next(v for k, v in st.items() if k.startswith("ExchangeMap"))
+    assert map_m.get("exchange_fallback_bytes", 0) > 0
+    assert map_m.get("exchange_ring_bytes", 0) == 0
+
+
+def test_exchange_stats_counters(ray_start_exchange, ctx):
+    ds = rd.range(100, parallelism=4)
+    sh = ds.random_shuffle(seed=1)
+    sh.materialize()
+    st = sh.stats().to_dict()["operators"]
+    map_m = next(v for k, v in st.items() if k.startswith("ExchangeMap"))
+    red_m = next(v for k, v in st.items() if k.startswith("Exchange["))
+    assert map_m["exchange_ring_bytes"] > 0
+    assert map_m["exchange_chunks"] >= 4
+    assert map_m.get("exchange_fallback_bytes", 0) == 0
+    # reducer side observed the same stream
+    assert red_m["exchange_ring_bytes"] == map_m["exchange_ring_bytes"]
+    assert red_m["rows_out"] == 100
+
+
+# ------------------------------------------------------- streaming bound
+
+
+def test_streaming_bound_larger_than_budget(ray_start_exchange, ctx):
+    """96 MiB shuffled through a 16 MiB arena budget: the exchange must
+    STREAM — peak arena occupancy stays within ~2x the budget (chunks
+    ride rings, outputs seal only as the consumer drains)."""
+    budget = 16 * 1024 * 1024
+    ctx.arena_usage_budget_bytes = budget
+    n_blocks, rows = 16, 12_000  # 16 x ~6 MiB = ~96 MiB
+    ds = rd.range(n_blocks, parallelism=n_blocks).map_batches(
+        lambda b: {
+            "k": np.arange(rows),
+            "pad": np.zeros((rows, 63), dtype=np.float64),
+        }
+    )
+    core = ray_tpu._private.worker.get_global_core()
+    shm = core._shm
+    base = shm.usage()["used_bytes"]
+    peak = 0
+    n_rows = 0
+    for batch in ds.random_shuffle(seed=5).iter_batches(batch_size=4096):
+        n_rows += len(batch["k"])
+        peak = max(peak, shm.usage()["used_bytes"] - base)
+    assert n_rows == n_blocks * rows
+    assert peak <= 2.25 * budget, (
+        f"peak arena occupancy {peak / 1e6:.1f} MB exceeded ~2x the "
+        f"{budget / 1e6:.0f} MB budget — the exchange is not streaming"
+    )
+
+
+# ------------------------------------------------- zero-copy object plane
+
+
+def test_zero_copy_get_aliases_arena_and_reclaims(ray_start_exchange):
+    """get() of a large put returns numpy views backed directly by the
+    arena mmap (no copy); releasing the value releases the pin and the
+    slot reclaims."""
+    core = ray_tpu._private.worker.get_global_core()
+    shm = core._shm
+    arr = np.arange(4 * 1024 * 1024, dtype=np.float64)  # 32 MiB
+    # settle leftover refs from earlier tests so the reclaim check has a
+    # stable baseline
+    deadline = time.time() + 10
+    used0 = shm.usage()["used_bytes"]
+    while time.time() < deadline:
+        gc.collect()
+        core.force_ref_gc()
+        u = shm.usage()["used_bytes"]
+        if u >= used0:
+            used0 = u
+            break
+        used0 = u
+        time.sleep(0.2)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    assert isinstance(out, np.ndarray) and (out == arr).all()
+    addr = out.__array_interface__["data"][0]
+    arena_size = os.path.getsize(shm.path)
+    assert shm._base <= addr < shm._base + arena_size, (
+        "get() result does not alias the arena mmap — the zero-copy path regressed"
+    )
+    assert shm.usage()["used_bytes"] >= arr.nbytes  # object resident in arena
+    # pin-release: value dies -> view export drops -> slot reclaims
+    del out
+    del ref
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        core.force_ref_gc()
+        if shm.usage()["used_bytes"] <= used0 + 1024 * 1024:
+            break
+        time.sleep(0.1)
+    assert shm.usage()["used_bytes"] <= used0 + 1024 * 1024, (
+        f"arena slot not reclaimed: {shm.usage()['used_bytes']} vs baseline {used0}"
+    )
+
+
+def test_large_put_roundtrip_integrity(ray_start_exchange):
+    """The multi-threaded chunked memcpy path must be byte-exact
+    (threads split on cacheline boundaries — off-by-one there would
+    corrupt silently)."""
+    rng = np.random.default_rng(0)
+    for size in (256 * 1024 + 13, 5 * 1024 * 1024 + 7, 48 * 1024 * 1024 + 1):
+        arr = rng.integers(0, 255, size=size, dtype=np.uint8)
+        back = ray_tpu.get(ray_tpu.put(arr))
+        assert back.nbytes == size
+        assert (back == arr).all(), f"corruption at size {size}"
+
+
+# --------------------------------------------------------------- leak audit
+
+
+def test_exchange_leak_audit(ray_start_exchange, ctx):
+    """After a shuffle materializes and its dataset dies: no arena slots
+    stay pinned and no exchange ring files litter /dev/shm (the PR-6
+    chaos-sweep contract, applied to the exchange)."""
+    core = ray_tpu._private.worker.get_global_core()
+    shm = core._shm
+
+    def _settle(stop=None, timeout=15.0):
+        """Sweep ref-gc until `stop(usage)` holds (or usage stops
+        falling); returns the last usage snapshot."""
+        deadline = time.time() + timeout
+        last = shm.usage()
+        while time.time() < deadline:
+            gc.collect()
+            core.force_ref_gc()
+            u = shm.usage()
+            if stop is not None and stop(u):
+                return u
+            if stop is None and u["used_bytes"] >= last["used_bytes"]:
+                return u
+            last = u
+            time.sleep(0.2)
+        return shm.usage()
+
+    used0 = _settle()["used_bytes"]
+    ds = rd.range(8, parallelism=8).map_batches(
+        lambda b: {"v": np.arange(50_000, dtype=np.float64)}
+    ).random_shuffle(seed=2).materialize()
+    assert ds.count() == 8 * 50_000
+    rings_during = [p for p in os.listdir("/dev/shm") if "ray_tpu_ring" in p and "xch" in p]
+    del ds
+    u = _settle(stop=lambda u: u["used_bytes"] <= used0 + 1024 * 1024)
+    assert u["used_bytes"] <= used0 + 1024 * 1024, (
+        f"arena not reclaimed after shuffle: {u} vs used0={used0}"
+    )
+    leftover = [p for p in os.listdir("/dev/shm") if "ray_tpu_ring" in p and "xch" in p]
+    assert not leftover, f"exchange ring litter: {leftover} (during: {rings_during})"

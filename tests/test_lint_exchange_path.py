@@ -1,0 +1,166 @@
+"""Lint the streaming-exchange and zero-copy put hot paths (source
+inspection, no cluster).
+
+Contracts pinned here (ISSUE 12):
+- the exchange driver side never fetches part data: no driver-side
+  `ray_tpu.get` per part — the finalize loop moves refs only, and the
+  mapper-launch loop resolves nothing;
+- the ops chain ships in ONE spec put — mappers never re-pickle it per
+  chunk (exactly one pickle.dumps on the hot path, and it serializes
+  the chunk, not the ops);
+- the zero-copy put path writes out-of-band buffers straight into the
+  arena allocation: no `bytes(...)` materialization or `b"".join` of
+  the payload anywhere between serializer and seal.
+"""
+import ast
+import inspect
+import textwrap
+
+
+def _source(obj) -> str:
+    return textwrap.dedent(inspect.getsource(obj))
+
+
+def _calls_named(tree, name: str):
+    """All Call nodes whose dotted name ends with `name`."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            dotted = None
+            if isinstance(f, ast.Attribute):
+                dotted = f.attr
+            elif isinstance(f, ast.Name):
+                dotted = f.id
+            if dotted == name:
+                out.append(node)
+    return out
+
+
+# ------------------------------------------------------------ driver side
+
+
+def test_driver_never_gets_part_data():
+    from ray_tpu.data._internal import exchange
+
+    # the per-partition finalize loop: refs flow to the consumer, the
+    # driver must never pull a partition's bytes
+    reduce_src = _source(exchange._reduce_phase)
+    assert ".get(" not in reduce_src, "driver-side get in the finalize loop"
+
+    # the mapper-launch loop may wait on metas but must not get() inside
+    # the per-block loop (the single post-loop bulk meta fetch is the
+    # error barrier, not a data fetch)
+    tree = ast.parse(_source(exchange._map_phase))
+    for_nodes = [n for n in ast.walk(tree) if isinstance(n, ast.For)]
+    assert for_nodes, "expected the mapper launch loop"
+    launch_loop = for_nodes[0]
+    assert not _calls_named(launch_loop, "get"), (
+        "ray_tpu.get inside the mapper-launch loop — a slow mapper would "
+        "serialize the launch pipeline"
+    )
+
+    # the whole-exchange driver entry makes exactly ONE spec put
+    run_src = _source(exchange.run_exchange_stage)
+    assert run_src.count("ray_tpu.put(") == 1, "exchange spec must ship via ONE put"
+
+
+def test_mapper_never_repickles_ops_per_chunk():
+    from ray_tpu.data._internal import exchange
+
+    # unwrap the @remote decoration
+    fn = exchange._exchange_map._fn
+    src = _source(fn)
+    assert "cloudpickle" not in src
+    assert "pickle.dumps(" not in src, "chunks must ride the object-plane serializer"
+    # exactly one serialization call per chunk, and it packs the CHUNK
+    assert src.count("_pack_data_record(") == 1
+    assert "_pack_data_record(j, midx, seq, chunk" in src
+    # the ops chain applies once, before any chunk is produced
+    tree = ast.parse(src)
+    body_src_lines = src.splitlines()
+    apply_line = next(
+        i for i, l in enumerate(body_src_lines) if "_apply_mapper_ops" in l
+    )
+    pack_line = next(
+        i for i, l in enumerate(body_src_lines) if "_pack_data_record(" in l
+    )
+    assert apply_line < pack_line, "ops must apply before the chunk loop"
+    # chunk loop body must not touch the ops chain at all
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For) and ast.dump(node.target).find("chunk") != -1:
+            loop_src = ast.get_source_segment(src, node) or ""
+            assert '"ops"' not in loop_src and "'ops'" not in loop_src
+
+
+def test_ring_records_use_out_of_band_buffers():
+    """Chunk records must serialize via the object-plane wire format
+    (pickle5 out-of-band buffers + native bulk copy) and decode
+    zero-copy — a plain pickle.dumps of an arrow table byte-copies every
+    buffer through the pickle stream (~100x slower for MiB chunks)."""
+    from ray_tpu.data._internal import exchange
+
+    pack_src = _source(exchange._pack_data_record)
+    assert "serialization.serialize(" in pack_src
+    assert "write_to(" in pack_src
+    assert "pickle.dumps" not in pack_src
+    unpack_src = _source(exchange._unpack_data_record)
+    assert "zero_copy=True" in unpack_src
+
+
+def test_reducer_finalize_sorts_deterministically():
+    """Ring arrival order is racy across mappers: finalize must restore
+    (mapper, seq) order or seeded shuffles stop being reproducible."""
+    from ray_tpu.data._internal import exchange
+
+    src = _source(exchange._ExchangeReducer._cls.finalize)
+    assert ".sort(" in src and "e[0], e[1]" in src
+
+
+# --------------------------------------------------------- zero-copy put
+
+
+def test_put_path_has_no_payload_materialization():
+    from ray_tpu._private import serialization
+    from ray_tpu._private.core_worker import CoreWorker
+
+    # the serializer's arena write: straight buffer copies, never a
+    # bytes() of the payload or a join of the oob buffers
+    for fn in (serialization.write_to, serialization._bulk_copy):
+        src = _source(fn)
+        assert "bytes(" not in src, f"{fn.__name__} materializes the payload"
+        assert ".join" not in src, f"{fn.__name__} joins buffers"
+
+    # the worker-side shm put: create -> write_to in place -> seal; the
+    # wire-join helper (to_wire) must not appear
+    shm_src = _source(CoreWorker.put_serialized_to_shm)
+    assert "write_to(" in shm_src
+    assert "to_wire" not in shm_src, "shm put must write in place, not join"
+    for needle in ('b"".join', "b''.join", "bytes(buf"):
+        assert needle not in shm_src
+
+    # driver put: the large branch writes into the arena allocation
+    put_src = _source(CoreWorker.put)
+    assert "_create_with_gc" in put_src and "write_to(" in put_src
+
+
+def test_result_paths_compute_size_once():
+    """The small-object result path used to call serialized_size AND
+    to_wire (which re-walks the buffers): both result serializers must
+    ship the precomputed size."""
+    from ray_tpu._private import worker_proc
+
+    for fn in (worker_proc.Executor._to_env_sync, worker_proc.Executor._to_env):
+        src = _source(fn)
+        assert src.count("serialized_size(") == 1
+        assert "to_wire_sized(" in src
+        assert "to_wire(" not in src.replace("to_wire_sized(", "")
+
+
+def test_bulk_copy_routes_large_spans_native():
+    """Large out-of-band buffers must take the native (multi-threaded,
+    GIL-releasing) memcpy — the python copy loop caps put bandwidth."""
+    from ray_tpu._private import serialization
+
+    src = _source(serialization._bulk_copy)
+    assert "parallel_copy" in src
