@@ -1582,11 +1582,115 @@ def bench_serve_scale(extra):
     _settle()
 
 
+def bench_serve_fault(extra):
+    """Fault-tolerant serving gates: (1) CHAOS — a seeded replica
+    SIGKILL mid-burst with redispatch + one harness retry must lose
+    zero accepted requests; (2) OVERLOAD — at 4x the sustainable
+    arrival rate with deadlines set, shed requests get typed rejections
+    with p99 rejection latency far below the deadline, and goodput for
+    admitted requests stays within ~10% of the 1x run instead of
+    collapsing into a timeout pileup."""
+    import ray_tpu
+
+    try:
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+        import jax.numpy as jnp
+
+        from ray_tpu import serve
+        from ray_tpu.chaos import ChaosEvent, ChaosSchedule
+        from ray_tpu.models import llama
+        from ray_tpu.serve.llm import llm_deployment
+        from ray_tpu.serve.loadgen import Phase, Workload, run_load
+
+        cfg = llama.LlamaConfig.tiny(
+            dtype=jnp.float32, attn_impl="blockwise", remat=False
+        )
+
+        def _deploy(n, max_queue=None):
+            app = llm_deployment(
+                num_replicas=n, continuous=True, n_slots=4, chunk=4,
+                macro_phases=2, block_size=8, max_new_tokens=8, cfg=cfg,
+                max_queue=max_queue,
+            )
+            h = serve.run(app, name="bench_fault")
+            warm = [h.remote([1, 2, 3 + i]) for i in range(4 * n)]
+            for r in warm:
+                r.result(timeout=300)
+            return h
+
+        # ---- chaos gate: kill one of two replicas mid-burst ----------
+        h = _deploy(2)
+        sched = ChaosSchedule([ChaosEvent(t_s=1.5, kind="kill")], seed=17)
+        wl = Workload(rate_hz=6.0, prompt_len=(3, 6), max_new_tokens=(4, 8),
+                      seed=31)
+        rc = run_load(
+            h, wl, phases=[Phase("burst", 6.0)], request_timeout_s=120.0,
+            retries=1, chaos=sched, chaos_target=("bench_fault", "LLMServer"),
+            collect_serve_metrics=False,
+        )
+        stats = h.routing_stats()
+        serve.delete("bench_fault")
+        t = rc["total"]
+        extra["serve_fault_chaos_sent"] = t["sent"]
+        extra["serve_fault_chaos_lost"] = t["lost"]
+        extra["serve_fault_chaos_redispatches"] = stats["redispatches"]
+        extra["serve_fault_chaos_p99_ms"] = t["latency_ms_p99"]
+        log(f"[bench] serve_fault chaos: {t['sent']} sent, {t['lost']} lost, "
+            f"{stats['redispatches']} redispatched, retry recovered "
+            f"{t['recovered']}, p99 {t['latency_ms_p99']}ms through the kill")
+
+        # ---- overload gate: 4x sustainable arrival with deadlines ----
+        # 1x is picked near the tiny engine's measured capacity on this
+        # box (~4-6 req/s at 4 slots); 4x must actually exceed it or
+        # the queue never builds and nothing sheds
+        DEADLINE_S = 20.0
+        h = _deploy(1, max_queue=6)
+        base = run_load(
+            h, Workload(rate_hz=3.0, prompt_len=(3, 6),
+                        max_new_tokens=(4, 8), deadline_s=DEADLINE_S, seed=5),
+            phases=[Phase("steady", 8.0)], request_timeout_s=120.0,
+            collect_serve_metrics=False,
+        )
+        over = run_load(
+            h, Workload(rate_hz=12.0, prompt_len=(3, 6),
+                        max_new_tokens=(4, 8), deadline_s=DEADLINE_S, seed=6),
+            phases=[Phase("overload", 8.0)], request_timeout_s=120.0,
+            collect_serve_metrics=False,
+        )
+        serve.delete("bench_fault")
+        b, o = base["total"], over["total"]
+        extra["serve_fault_goodput_1x_tok_s"] = b["goodput_tok_s"]
+        extra["serve_fault_goodput_4x_tok_s"] = o["goodput_tok_s"]
+        extra["serve_fault_goodput_ratio"] = round(
+            o["goodput_tok_s"] / max(1e-9, b["goodput_tok_s"]), 3)
+        extra["serve_fault_shed_4x"] = o["drops"].get("shed", 0)
+        extra["serve_fault_deadline_4x"] = o["drops"].get("deadline", 0)
+        extra["serve_fault_lost_4x"] = o["lost"]
+        extra["serve_fault_rejection_p99_ms"] = o.get("rejection_ms_p99", 0.0)
+        log(f"[bench] serve_fault overload: goodput {b['goodput_tok_s']} "
+            f"tok/s @1x vs {o['goodput_tok_s']} tok/s @4x "
+            f"(ratio {extra['serve_fault_goodput_ratio']}), "
+            f"{extra['serve_fault_shed_4x']} shed + "
+            f"{extra['serve_fault_deadline_4x']} deadline-shed typed, "
+            f"rejection p99 {extra['serve_fault_rejection_p99_ms']}ms "
+            f"vs deadline {DEADLINE_S * 1e3:.0f}ms, {o['lost']} lost")
+        serve.shutdown()
+    except Exception as e:
+        log(f"[bench] serve_fault bench skipped: {e}")
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+    _settle()
+
+
 def main():
     extra = {}
     bench_runtime(extra)
     bench_dispatch(extra)
     bench_serve_scale(extra)
+    bench_serve_fault(extra)
     bench_broadcast(extra)
     bench_data_pipeline(extra)
     bench_telemetry_overhead(extra)

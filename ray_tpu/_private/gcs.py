@@ -1461,6 +1461,21 @@ class GcsServer:
             del table[reporter]
         return True
 
+    async def _rpc_telemetry_prune(self, d, conn):
+        """Delete one key from every reporter's snapshot of a kind.
+        The serve controller calls this at replica-death detection: the
+        120s retention window would otherwise let the autoscaler keep
+        counting the corpse's last-published load as live signal."""
+        table = getattr(self, "telemetry", {}).get(d.get("kind", ""), {})
+        key = d["key"]
+        n = 0
+        for rec in table.values():
+            snap = rec.get("snapshot")
+            if isinstance(snap, dict) and key in snap:
+                del snap[key]
+                n += 1
+        return n
+
     async def _rpc_telemetry_get(self, d, conn):
         """Snapshots for one kind, stale reporters (>120s) dropped."""
         table = getattr(self, "telemetry", {}).get(d.get("kind", ""), {})

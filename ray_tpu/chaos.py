@@ -1,0 +1,410 @@
+"""Shared deterministic fault injection — train slices AND serve replicas.
+
+PR 5 built seeded, perfectly replayable preemption schedules for elastic
+training; the serving plane needs the same property (a chaos run's
+kill/hang/slow sequence must be a deterministic function of its seed, or
+the regression tests and bench gates can't hold a number steady). This
+module is the one home for both:
+
+- STEP-keyed faults (``FaultEvent`` / ``PreemptionSchedule`` /
+  ``PreemptionInjector``): the training side, injected into
+  ``MultisliceTrainStep`` per (slice, step). ``train/fault_injection.py``
+  re-exports these unchanged.
+- TIME-keyed faults (``ChaosEvent`` / ``ChaosSchedule`` /
+  ``ServeChaosInjector``): the serving side — events fire at seconds
+  offsets from injector start against a live deployment's replica set.
+
+Serve fault kinds, mirroring how replicas actually fail:
+
+  kill      — SIGKILL the replica's worker process (spot reclaim, OOM
+              kill). The hard case: no exception escapes, no K_FATAL is
+              sent; detection is the GCS worker monitor + the
+              controller's telemetry-staleness health check, and every
+              in-flight request must be redispatched or failed typed.
+  terminate — ``ray_tpu.kill`` (graceful-less actor destroy through the
+              control plane): death is visible in the actor table
+              immediately, exercising the fast-detection path.
+  hang      — the replica process lives but stops responding: health
+              pings stall, telemetry stops publishing, in-flight
+              requests wedge. Detection must come from the BOUNDED
+              ping/staleness path, and recovery from the controller
+              declaring it dead and restarting it.
+  slow      — a straggler: every request pays extra latency for the
+              window, no membership change. Erodes deadlines without a
+              recovery event (the deadline-shed path's workload).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+logger = logging.getLogger("ray_tpu.chaos")
+
+
+# =====================================================================
+# step-keyed training faults (moved verbatim from train/fault_injection)
+# =====================================================================
+class SlicePreempted(Exception):
+    """A slice died (or was declared dead) mid-step."""
+
+    def __init__(self, slice_idx: int, kind: str = "kill"):
+        super().__init__(f"slice {slice_idx} preempted ({kind})")
+        self.slice_idx = slice_idx
+        self.kind = kind
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    step: int            # first step the fault is active
+    slice_idx: int
+    kind: str            # "kill" | "hang" | "slow"
+    duration_steps: int = 3   # steps the slice stays down (kill/hang)
+    notice_steps: int = 0     # advance maintenance notice before a kill
+    slow_s: float = 0.0       # extra latency for "slow"
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @property
+    def end_step(self) -> int:
+        return self.step + self.duration_steps
+
+
+class PreemptionSchedule:
+    """An ordered, replayable list of FaultEvents."""
+
+    def __init__(self, events: Sequence[FaultEvent], seed: Optional[int] = None):
+        self.events: List[FaultEvent] = sorted(
+            events, key=lambda e: (e.step, e.slice_idx)
+        )
+        self.seed = seed
+
+    @classmethod
+    def generate(
+        cls,
+        seed: int,
+        n_slices: int,
+        total_steps: int,
+        *,
+        n_events: int = 2,
+        kinds: Sequence[str] = ("kill", "hang", "slow"),
+        min_gap_steps: int = 6,
+        duration_steps: Tuple[int, int] = (2, 4),
+        notice_prob: float = 0.5,
+        notice_steps: int = 2,
+        slow_s: float = 0.05,
+    ) -> "PreemptionSchedule":
+        """Deterministic in (seed, args): same inputs, same schedule.
+        Events never target slice 0 (one survivor must always hold the
+        authoritative state to broadcast from) and are spaced at least
+        `min_gap_steps` apart so each outage resolves before the next."""
+        import numpy as np
+
+        if n_slices < 2:
+            return cls([], seed=seed)
+        rng = np.random.Generator(np.random.PCG64(seed))
+        events: List[FaultEvent] = []
+        step = int(rng.integers(min_gap_steps, max(min_gap_steps + 1, total_steps // 3)))
+        for _ in range(n_events):
+            if step >= total_steps - 1:
+                break
+            kind = str(rng.choice(list(kinds)))
+            dur = int(rng.integers(duration_steps[0], duration_steps[1] + 1))
+            notice = (
+                notice_steps
+                if kind == "kill" and rng.random() < notice_prob
+                else 0
+            )
+            events.append(
+                FaultEvent(
+                    step=step,
+                    slice_idx=int(rng.integers(1, n_slices)),
+                    kind=kind,
+                    duration_steps=dur if kind != "slow" else 0,
+                    notice_steps=notice,
+                    slow_s=slow_s if kind == "slow" else 0.0,
+                )
+            )
+            step += dur + int(rng.integers(min_gap_steps, 2 * min_gap_steps))
+        return cls(events, seed=seed)
+
+    # ---------------------------------------------------------- replay io
+    def to_json(self) -> str:
+        return json.dumps(
+            {"seed": self.seed, "events": [e.to_dict() for e in self.events]}
+        )
+
+    @classmethod
+    def from_json(cls, blob: str) -> "PreemptionSchedule":
+        d = json.loads(blob)
+        return cls([FaultEvent(**e) for e in d["events"]], seed=d.get("seed"))
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PreemptionSchedule) and self.events == other.events
+
+    def __repr__(self) -> str:
+        return f"PreemptionSchedule(seed={self.seed}, events={self.events})"
+
+
+class PreemptionInjector:
+    """Drives a schedule against a MultisliceTrainStep.
+
+    The trainer calls `check(slice_idx, step)` inside each slice's
+    work, `maintenance_notice(step)` before dispatching a step, and
+    `revivable(step)` when deciding whether to re-admit. `hang_s`
+    bounds the simulated hang so test threads eventually unwind — it
+    must exceed the trainer's probe timeout for the hang to be
+    DETECTED as one."""
+
+    def __init__(self, schedule: PreemptionSchedule, *, hang_s: float = 2.0):
+        self.schedule = schedule
+        self.hang_s = hang_s
+        self.fired: List[FaultEvent] = []
+        self._down: Dict[int, FaultEvent] = {}  # slice -> active outage
+
+    # ---------------------------------------------------------- queries
+    def maintenance_notice(self, step: int) -> List[FaultEvent]:
+        """Kills whose advance-notice window covers `step` and have not
+        fired yet — the signal for a priority checkpoint."""
+        return [
+            e
+            for e in self.schedule.events
+            if e.kind == "kill"
+            and e.notice_steps > 0
+            and e.step - e.notice_steps <= step < e.step
+        ]
+
+    def active_event(self, slice_idx: int, step: int) -> Optional[FaultEvent]:
+        for e in self.schedule.events:
+            if e.slice_idx != slice_idx:
+                continue
+            if e.kind == "slow" and e.step == step:
+                return e
+            if e.kind in ("kill", "hang") and e.step <= step < e.end_step:
+                return e
+        return None
+
+    def revivable(self, step: int) -> Set[int]:
+        """Slices whose outage has ended by `step` (ready to re-admit)."""
+        out = set()
+        for e in self.schedule.events:
+            if e.kind in ("kill", "hang") and e.end_step <= step:
+                out.add(e.slice_idx)
+        # minus slices currently inside a LATER outage
+        for e in self.schedule.events:
+            if e.kind in ("kill", "hang") and e.step <= step < e.end_step:
+                out.discard(e.slice_idx)
+        return out
+
+    # ------------------------------------------------------------ inject
+    def check(self, slice_idx: int, step: int) -> None:
+        """Called inside a slice's per-step work. Raises/sleeps per the
+        schedule; a no-op for healthy (slice, step) pairs."""
+        e = self.active_event(slice_idx, step)
+        if e is None:
+            return
+        if e not in self.fired:
+            self.fired.append(e)
+        if e.kind == "kill":
+            raise SlicePreempted(slice_idx, "kill")
+        if e.kind == "hang":
+            # wedge past the probe timeout, then die like the probe
+            # would eventually observe — bounded so threads unwind
+            time.sleep(self.hang_s)
+            raise SlicePreempted(slice_idx, "hang")
+        if e.kind == "slow":
+            time.sleep(e.slow_s)
+
+
+# =====================================================================
+# time-keyed serve chaos
+# =====================================================================
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One serve fault: at `t_s` seconds after injector start, apply
+    `kind` to a replica. `victim` pins the target by index into the
+    sorted live membership at fire time; None lets the injector's
+    seeded RNG pick (deterministic given the same membership)."""
+
+    t_s: float
+    kind: str                    # "kill" | "terminate" | "hang" | "slow"
+    duration_s: float = 3.0      # hang/slow window
+    slow_s: float = 0.2          # per-request latency for "slow"
+    victim: Optional[int] = None
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class ChaosSchedule:
+    """Ordered, replayable serve-fault schedule (time-keyed twin of
+    PreemptionSchedule — same json round-trip contract)."""
+
+    KINDS = ("kill", "terminate", "hang", "slow")
+
+    def __init__(self, events: Sequence[ChaosEvent], seed: Optional[int] = None):
+        for e in events:
+            if e.kind not in self.KINDS:
+                raise ValueError(f"unknown chaos kind {e.kind!r} (valid: {self.KINDS})")
+        self.events: List[ChaosEvent] = sorted(events, key=lambda e: e.t_s)
+        self.seed = seed
+
+    @classmethod
+    def generate(
+        cls,
+        seed: int,
+        window_s: float,
+        *,
+        n_events: int = 2,
+        kinds: Sequence[str] = ("kill", "hang", "slow"),
+        min_gap_s: float = 2.0,
+        duration_s: Tuple[float, float] = (1.0, 3.0),
+        slow_s: float = 0.2,
+    ) -> "ChaosSchedule":
+        """Deterministic in (seed, args). Events spread over the first
+        `window_s` seconds with at least `min_gap_s` between them so one
+        outage's recovery isn't hidden under the next fault."""
+        import numpy as np
+
+        rng = np.random.Generator(np.random.PCG64(seed))
+        events: List[ChaosEvent] = []
+        t = float(rng.uniform(min_gap_s, max(min_gap_s * 1.5, window_s / 3)))
+        for _ in range(n_events):
+            if t >= window_s:
+                break
+            kind = str(rng.choice(list(kinds)))
+            dur = float(rng.uniform(*duration_s))
+            events.append(ChaosEvent(
+                t_s=round(t, 3), kind=kind,
+                duration_s=round(dur, 3) if kind in ("hang", "slow") else 0.0,
+                slow_s=slow_s if kind == "slow" else 0.0,
+            ))
+            t += dur + float(rng.uniform(min_gap_s, 2 * min_gap_s))
+        return cls(events, seed=seed)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"seed": self.seed, "events": [e.to_dict() for e in self.events]}
+        )
+
+    @classmethod
+    def from_json(cls, blob: str) -> "ChaosSchedule":
+        d = json.loads(blob)
+        return cls([ChaosEvent(**e) for e in d["events"]], seed=d.get("seed"))
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ChaosSchedule) and self.events == other.events
+
+    def __repr__(self) -> str:
+        return f"ChaosSchedule(seed={self.seed}, events={self.events})"
+
+
+class ServeChaosInjector:
+    """Fires a ChaosSchedule at a live deployment's replicas.
+
+    A driver-side harness tool (like ``loadgen.replica_metrics``): it
+    reads membership through the controller per event — never on a
+    request path — picks the victim deterministically from the seeded
+    RNG over the SORTED live replica names, and applies the fault:
+
+    - kill: SIGKILL the replica worker's OS pid (read from the replica's
+      ``stats()``) — the replica gets no chance to say goodbye.
+    - terminate: ``ray_tpu.kill`` on the actor handle.
+    - hang / slow: arm the Replica wrapper's cooperative ``chaos()``
+      wedge (health pings, stat reports and requests all stall for the
+      window — what a stuck driver looks like from outside).
+
+    ``fired`` records ``{"t_s", "kind", "replica"}`` per applied event
+    for the loadgen report's chaos section.
+    """
+
+    def __init__(self, schedule: ChaosSchedule, app_name: str,
+                 deployment_name: str):
+        import random
+
+        self.schedule = schedule
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.fired: List[Dict[str, Any]] = []
+        self._rng = random.Random(schedule.seed or 0)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ driving
+    def start(self) -> "ServeChaosInjector":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="serve-chaos"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _run(self) -> None:
+        t0 = time.monotonic()
+        for event in self.schedule.events:
+            delay = event.t_s - (time.monotonic() - t0)
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            try:
+                self._fire(event)
+            except Exception as e:  # a missed event must not kill the run
+                logger.warning("chaos event %s failed: %s", event, e)
+                self.fired.append({
+                    "t_s": event.t_s, "kind": event.kind,
+                    "replica": None, "error": str(e),
+                })
+
+    # ------------------------------------------------------------- firing
+    def _members(self) -> List[str]:
+        import ray_tpu
+        from ray_tpu.serve.api import _get_controller
+
+        info = ray_tpu.get(_get_controller().get_replicas_versioned.remote(
+            self.app_name, self.deployment_name
+        ))
+        data = info["data"]
+        names = data.get("replicas", []) if isinstance(data, dict) else (data or [])
+        return sorted(names)
+
+    def _fire(self, event: ChaosEvent) -> None:
+        import signal
+
+        import ray_tpu
+
+        names = self._members()
+        if not names:
+            raise RuntimeError("no live replicas to target")
+        idx = event.victim if event.victim is not None else \
+            self._rng.randrange(len(names))
+        name = names[idx % len(names)]
+        actor = ray_tpu.get_actor(name)
+        if event.kind == "kill":
+            stats = ray_tpu.get(actor.stats.remote(), timeout=10)
+            pid = stats.get("pid")
+            if not pid:
+                raise RuntimeError(f"replica {name} reports no pid")
+            import os
+
+            os.kill(int(pid), signal.SIGKILL)
+        elif event.kind == "terminate":
+            ray_tpu.kill(actor)
+        elif event.kind in ("hang", "slow"):
+            # fire-and-forget: a hang wedge by definition won't reply
+            actor.chaos.remote(event.kind, event.duration_s, event.slow_s)
+        else:  # pragma: no cover — schedule validation rejects these
+            raise ValueError(f"unknown chaos kind {event.kind}")
+        logger.info("chaos: %s replica %s (t=%.2fs)", event.kind, name, event.t_s)
+        self.fired.append({"t_s": event.t_s, "kind": event.kind, "replica": name})

@@ -37,6 +37,7 @@ __all__ = [
     "all_telemetries",
     "publish_snapshot",
     "fetch_snapshots",
+    "prune_snapshot_key",
     "flush",
     "flush_async",
     "snapshot",
@@ -58,6 +59,28 @@ def fetch_snapshots(kind: str, timeout: float = 5.0) -> Dict[str, Dict[str, Any]
         ) or {}
     except Exception:
         return {}
+
+def prune_snapshot_key(kind: str, key: str, timeout: float = 5.0) -> int:
+    """Remove `key` from every reporter's published `kind` snapshot in
+    the GCS telemetry table (and from this process's pending extras).
+    The delete half of publish_snapshot: when a reporter is KNOWN dead
+    (the serve controller detecting a replica crash), its last snapshot
+    must stop feeding consumers instead of riding out the retention
+    window. Returns the number of reporter snapshots pruned
+    (best-effort; 0 when no cluster is reachable)."""
+    with _extras_lock:
+        d = _extras.get(kind)
+        if d is not None:
+            d.pop(key, None)
+    try:
+        from ray_tpu._private.worker import get_global_core
+
+        return int(get_global_core().gcs_request(
+            "telemetry.prune", {"kind": kind, "key": key}, timeout=timeout
+        ) or 0)
+    except Exception:
+        return 0
+
 
 # driver-side extras merged into the published snapshot per kind
 # (e.g. the trainer's per-report metrics, an engine's serving counters)

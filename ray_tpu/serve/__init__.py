@@ -26,6 +26,13 @@ from ray_tpu.serve._internal.autoscaler import (  # noqa: F401
 )
 from ray_tpu.serve._internal.sampling import SamplingParams  # noqa: F401
 from ray_tpu.serve.config import build_app, deploy_config  # noqa: F401
+from ray_tpu.serve.errors import (  # noqa: F401
+    DeadlineExceededError,
+    ReplicaDiedError,
+    RequestRetryableError,
+    RequestShedError,
+    classify_error,
+)
 from ray_tpu.serve.grpc_proxy import start_grpc_proxy  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401
 from ray_tpu.serve.ingress import ingress, route  # noqa: F401

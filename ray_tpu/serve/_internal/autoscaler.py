@@ -208,6 +208,54 @@ def validate_affinity_config(cfg: Optional[Dict[str, Any]]) -> Optional[Dict[str
     return dataclasses.asdict(AffinityConfig(**cfg))
 
 
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Failure-semantics knobs (handle redispatch policy). Rides the
+    same controller long-poll payload as the affinity config, so every
+    handle learns the deployment's policy with its membership.
+
+    redispatch: auto-requeue a request that was in flight on a replica
+        that DIED (process kill / wedge declared dead) onto a survivor.
+        Safe only for side-effect-free requests — result delivery is
+        end-of-request only, so nothing can have escaped a killed
+        replica, but a side-effectful method may have partially
+        executed. Off by default; llm_deployment (pure generation)
+        turns it on.
+    max_redispatches: automatic requeue attempts per request before the
+        failure surfaces as a typed retryable ReplicaDiedError.
+    """
+
+    redispatch: bool = False
+    max_redispatches: int = 1
+
+    def __post_init__(self):
+        if self.max_redispatches < 0:
+            raise ValueError(
+                f"fault_config: max_redispatches must be >= 0, got "
+                f"{self.max_redispatches}"
+            )
+
+
+_FAULT_KEYS = tuple(f.name for f in dataclasses.fields(FaultConfig))
+
+
+def validate_fault_config(cfg: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Validate a user fault_config dict at deployment() time."""
+    if cfg is None:
+        return None
+    if not isinstance(cfg, dict):
+        raise ValueError(
+            f"fault_config must be a dict, got {type(cfg).__name__}"
+        )
+    unknown = set(cfg) - set(_FAULT_KEYS)
+    if unknown:
+        raise ValueError(
+            f"fault_config: unknown key(s) {sorted(unknown)}; valid "
+            f"keys: {sorted(_FAULT_KEYS)}"
+        )
+    return dataclasses.asdict(FaultConfig(**cfg))
+
+
 # ------------------------------------------------------------ decision state
 class AutoscalerState:
     """Per-deployment autoscaling decision engine.

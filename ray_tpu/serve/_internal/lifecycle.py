@@ -1,0 +1,144 @@
+"""Replica crash/restart bookkeeping — backoff + crash-loop breaker.
+
+The controller's health loop detects dead/wedged replicas; THIS module
+decides when a replacement may start. Pure host logic with an explicit
+``now`` everywhere (the AutoscalerState pattern), so unit tests replay
+synthetic crash traces on a fake clock:
+
+- exponential restart backoff: the Nth crash inside the sliding window
+  delays the next restart by ``backoff_base_s * 2**(N-1)`` (capped) —
+  a replica that dies on arrival must not be respawned at the control
+  loop's full tick rate.
+- crash-loop circuit breaker: ``threshold`` crashes inside ``window_s``
+  OPEN the breaker — no restarts at all until ``cooldown_s`` passes,
+  then ONE half-open probe restart is allowed; further refills wait
+  until the probe survives ``window_s`` (the breaker closes) or it
+  crashes (straight back to open). A deployment whose __init__
+  segfaults gets pinned at "crash_looped" on /api/serve instead of
+  eating the cluster with a fork bomb of doomed replicas.
+
+State transitions happen ONLY in ``record_crash`` and ``restart_at``
+(the gate the health loop consults before actually restarting);
+``state()`` is a derived read — a dashboard poll can never advance the
+breaker or mint events.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+
+class CrashLoopBreaker:
+    """One deployment's crash history + restart gate."""
+
+    def __init__(self, backoff_base_s: float = 0.5,
+                 backoff_max_s: float = 30.0,
+                 window_s: float = 30.0, threshold: int = 5,
+                 cooldown_s: float = 30.0):
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.window_s = window_s
+        self.threshold = max(1, threshold)
+        self.cooldown_s = cooldown_s
+        self._crashes: deque = deque()   # crash timestamps (window-pruned)
+        self._opened_at: Optional[float] = None
+        self._probe_at: Optional[float] = None  # half-open probe launch time
+        # replica state transitions, newest last (published on /api/serve)
+        self.events: deque = deque(maxlen=32)
+
+    # ------------------------------------------------------------ inputs
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._crashes and self._crashes[0] < cutoff:
+            self._crashes.popleft()
+
+    def record_crash(self, replica: str, now: float, reason: str = "died") -> None:
+        self._prune(now)
+        self._crashes.append(now)
+        self.events.append({"t": round(now, 3), "replica": replica,
+                            "event": "died", "reason": reason})
+        if self._probe_at is not None:
+            # the half-open probe (or a survivor beside it) crashed:
+            # straight back to open, cooldown restarts from this crash
+            self._probe_at = None
+            self._opened_at = now
+            self.events.append({"t": round(now, 3), "replica": replica,
+                                "event": "breaker_reopened"})
+        elif self._opened_at is None and len(self._crashes) >= self.threshold:
+            self._opened_at = now
+            self.events.append({"t": round(now, 3), "replica": replica,
+                                "event": "breaker_opened"})
+
+    def record_restart(self, replica: str, now: float) -> None:
+        self.events.append({"t": round(now, 3), "replica": replica,
+                            "event": "restarted"})
+
+    # ----------------------------------------------------------- queries
+    def _phase(self, now: float) -> Optional[str]:
+        """Derived breaker phase (no mutation): crash_looped inside the
+        cooldown, half_open from cooldown expiry until the probe has
+        survived its window, else None (closed)."""
+        if self._opened_at is not None:
+            if now - self._opened_at < self.cooldown_s:
+                return "crash_looped"
+            return "half_open"  # probe not yet taken (restart_at takes it)
+        if self._probe_at is not None and now - self._probe_at < self.window_s:
+            return "half_open"  # probe out, proving itself
+        return None
+
+    def _backoff_at(self, now: float) -> float:
+        """Earliest backoff-gated restart time from the crash window
+        (no mutation)."""
+        if not self._crashes:
+            return now
+        n = len(self._crashes)
+        delay = min(self.backoff_max_s, self.backoff_base_s * (2 ** (n - 1)))
+        return self._crashes[-1] + delay
+
+    def probing(self, now: float) -> bool:
+        """True while the half-open probe must prove itself — the
+        caller restarts AT MOST ONE replica in this state."""
+        return self._phase(now) == "half_open"
+
+    def restart_at(self, now: float) -> Optional[float]:
+        """Earliest time a replacement replica may start: ``now`` when
+        clear, a future time while backing off, None while the breaker
+        is open (crash-looped) or a probe is already out. Consulting
+        this during an expired cooldown TAKES the half-open probe slot
+        (the caller is expected to restart one replica)."""
+        self._prune(now)
+        if self._opened_at is not None:
+            if now - self._opened_at < self.cooldown_s:
+                return None
+            # cooldown expired: transition to half-open, hand out the
+            # one probe slot
+            self._opened_at = None
+            self._probe_at = now
+            self.events.append({"t": round(now, 3), "replica": None,
+                                "event": "breaker_half_open"})
+            return now
+        if self._probe_at is not None:
+            if now - self._probe_at < self.window_s:
+                return None  # probe still proving itself: no refills
+            # probe survived a full window: breaker closes
+            self._probe_at = None
+            self.events.append({"t": round(now, 3), "replica": None,
+                                "event": "breaker_closed"})
+        return self._backoff_at(now)
+
+    def state(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Derived snapshot — never advances the breaker (a status poll
+        must not take the probe slot or mint transition events)."""
+        now = time.time() if now is None else now
+        self._prune(now)
+        st = self._phase(now)
+        if st is None:
+            st = "backing_off" if (
+                self._crashes and now < self._backoff_at(now)
+            ) else "healthy"
+        return {
+            "state": st,
+            "recent_crashes": len(self._crashes),
+            "events": list(self.events),
+        }

@@ -41,6 +41,16 @@ class SamplingParams:
     stop: token ids that end generation early (the stop token itself is
         not delivered). Detected device-side; the host repairs its
         speculative plan when the resolved tokens reveal the stop.
+    deadline: ABSOLUTE unix time (time.time() seconds) after which the
+        result is worthless to the caller. Not a sampling control — it
+        rides here because this dataclass is the per-request record
+        that travels handle → replica → engine, and the engine's
+        admission/shed policy is its consumer: requests still queued
+        past their deadline are shed with a typed error instead of
+        burning decode steps, and admission refuses requests whose
+        queue ETA already overruns the budget. Request dicts set it via
+        the relative ``deadline_s`` field (the handle stamps the
+        absolute form so redispatch can't reset the clock).
     """
 
     temperature: float = 0.0
@@ -48,6 +58,7 @@ class SamplingParams:
     top_p: float = 1.0
     seed: "int | None" = None
     stop: Tuple[int, ...] = ()
+    deadline: "float | None" = None
 
     def __post_init__(self):
         if self.temperature < 0.0:
@@ -64,6 +75,12 @@ class SamplingParams:
         if any(t < 0 for t in stop):
             raise ValueError(f"stop token ids must be >= 0, got {stop}")
         object.__setattr__(self, "stop", stop)
+        if self.deadline is not None and self.deadline <= 0:
+            raise ValueError(
+                f"deadline must be an absolute unix time > 0, got "
+                f"{self.deadline} (request dicts carry the relative form "
+                f"as 'deadline_s')"
+            )
 
     @property
     def greedy(self) -> bool:

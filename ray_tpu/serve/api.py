@@ -60,10 +60,12 @@ class Deployment:
         max_ongoing_requests: int = 16,
         autoscaling_config: Optional[dict] = None,
         affinity_config: Optional[dict] = None,
+        fault_config: Optional[dict] = None,
     ):
         from ray_tpu.serve._internal.autoscaler import (
             validate_affinity_config,
             validate_autoscaling_config,
+            validate_fault_config,
         )
 
         self._callable = cls_or_fn
@@ -83,6 +85,11 @@ class Deployment:
         # cache-affinity routing: same-prefix/same-session traffic
         # consistently hashes to the replica whose radix cache is hot
         self.affinity_config = validate_affinity_config(affinity_config)
+        # {"redispatch", "max_redispatches"} — failure semantics: may
+        # the handle requeue a dead replica's in-flight requests onto
+        # survivors? (safe only for side-effect-free requests; see
+        # serve/errors.py for the full taxonomy)
+        self.fault_config = validate_fault_config(fault_config)
 
     def options(self, **kw) -> "Deployment":
         merged = dict(
@@ -93,6 +100,7 @@ class Deployment:
             max_ongoing_requests=self.max_ongoing_requests,
             autoscaling_config=self.autoscaling_config,
             affinity_config=self.affinity_config,
+            fault_config=self.fault_config,
         )
         merged.update(kw)
         return Deployment(self._callable, **merged)
@@ -150,6 +158,7 @@ def _deploy_tree(controller, app_name: str, app: Application, *, is_root: bool,
             dep.autoscaling_config,
             bool(getattr(dep._callable, "__serve_is_ingress__", False)),
             dep.affinity_config,
+            dep.fault_config,
         )
     )
     seen[id(app)] = dep.name

@@ -28,6 +28,10 @@ Config shape (dict, or YAML text/file path)::
             # cache-affinity routing (prompt-prefix / session_id
             # consistent hashing with spill-to-least-loaded):
             affinity_config: {prefix_len: 32, spill_threshold: 8}
+            # failure semantics: auto-requeue a dead replica's
+            # in-flight requests onto survivors (side-effect-free
+            # deployments only — see serve/errors.py):
+            fault_config: {redispatch: true, max_redispatches: 1}
 """
 from __future__ import annotations
 

@@ -30,6 +30,36 @@ STATS_STALE_S = 5.0
 STARVED_WINDOW_S = 5.0
 
 
+def _health_knobs() -> Dict[str, float]:
+    """Replica health-check / restart knobs, env-overridable (read at
+    controller construction so a test's environment reaches the actor).
+
+    health_stale_s: telemetry silence that makes a replica a SUSPECT
+        (replicas publish every 0.5–2s; suspects get pinged, nothing
+        else does — steady state stays RPC-free).
+    ping_timeout_s: bounded health-ping wait; a suspect that can't
+        answer within it is declared wedged and replaced.
+    startup_grace_s: staleness is not judged until a replica has either
+        published once or been alive this long — a replica loading a
+        model / compiling its programs must not be "wedged" at birth
+        (the PR-5 compile-grace lesson, serve-side).
+    restart_backoff_s / crash window/threshold / cooldown: see
+        serve/_internal/lifecycle.CrashLoopBreaker.
+    """
+    import os
+
+    e = os.environ.get
+    return {
+        "health_stale_s": float(e("RAY_TPU_SERVE_HEALTH_STALE_S", "5.0")),
+        "ping_timeout_s": float(e("RAY_TPU_SERVE_PING_TIMEOUT_S", "2.0")),
+        "startup_grace_s": float(e("RAY_TPU_SERVE_STARTUP_GRACE_S", "120.0")),
+        "restart_backoff_s": float(e("RAY_TPU_SERVE_RESTART_BACKOFF_S", "0.5")),
+        "crash_loop_window_s": float(e("RAY_TPU_SERVE_CRASH_LOOP_WINDOW_S", "30.0")),
+        "crash_loop_threshold": int(e("RAY_TPU_SERVE_CRASH_LOOP_THRESHOLD", "5")),
+        "breaker_cooldown_s": float(e("RAY_TPU_SERVE_BREAKER_COOLDOWN_S", "30.0")),
+    }
+
+
 def _fetch_replica_stats() -> Dict[str, Dict[str, Any]]:
     """Merged per-replica load stats from the GCS `serve` telemetry
     table — the same last-write-wins-per-reporter snapshots `/api/serve`
@@ -50,6 +80,36 @@ def _fetch_replica_stats() -> Dict[str, Dict[str, Any]]:
     return out
 
 
+def _fetch_actor_states() -> Dict[str, str]:
+    """Replica-actor name -> GCS actor state, ONE state-table RPC for
+    every replica of every deployment (the health loop's fast death
+    signal: a SIGKILLed worker's actor flips DEAD the moment the raylet
+    reports the process gone — no staleness window to wait out)."""
+    try:
+        from ray_tpu.util.state import list_actors
+
+        return {
+            a["name"]: a.get("state", "")
+            for a in list_actors()
+            if isinstance(a.get("name"), str)
+            and a["name"].startswith("SERVE_REPLICA::")
+        }
+    except Exception:
+        return {}
+
+
+def _prune_replica_telemetry(name: str) -> None:
+    """Drop a dead replica's `replica:<name>` snapshot from the GCS
+    serve telemetry table (best-effort; blocking — callers run it off
+    the control loop)."""
+    try:
+        from ray_tpu.observability import prune_snapshot_key
+
+        prune_snapshot_key("serve", f"replica:{name}")
+    except Exception:
+        pass
+
+
 @ray_tpu.remote(max_concurrency=16)
 class Replica:
     """Wraps one instance of the user's deployment class
@@ -57,7 +117,19 @@ class Replica:
 
     def __init__(self, cls_or_fn, init_args, init_kwargs, replica_name=None):
         import inspect
+        import os
         import threading
+
+        # worker pid: the chaos harness SIGKILLs it; surfaced in stats()
+        # and the telemetry payload
+        self._pid = os.getpid()
+        # cooperative fault injection (ray_tpu.chaos): a "hang" wedge
+        # stalls health pings, stat publishing AND requests until the
+        # deadline — what a stuck driver looks like from outside; "slow"
+        # taxes each request with extra latency
+        self._wedged_until = 0.0
+        self._slow_until = 0.0
+        self._slow_s = 0.0
 
         def _resolve(v):
             # handle markers from deployment graphs → live handles
@@ -119,12 +191,18 @@ class Replica:
         while True:
             period = period_s
             try:
+                if time.time() < self._wedged_until:
+                    # chaos wedge: a stuck process publishes nothing —
+                    # the controller must notice via staleness + ping
+                    time.sleep(0.1)
+                    continue
                 payload = {
                     "t": time.time(),
                     "load": self._load(),
                     "ongoing": self._ongoing,
                     "queued": self._instance_load(),
                     "num_requests": self.num_requests,
+                    "pid": self._pid,
                 }
                 # idle backoff: an unchanged zero-load signal still
                 # publishes (the autoscaler treats >5s-stale stats as
@@ -141,6 +219,15 @@ class Replica:
             time.sleep(period)
 
     def handle_request(self, method: str, args, kwargs):
+        now = time.time()
+        if now < self._wedged_until:
+            # wedged: requests stall exactly like the rest of the
+            # process (the controller's kill-and-restart breaks them
+            # out, exercising the redispatch path)
+            while time.time() < self._wedged_until:
+                time.sleep(0.05)
+        elif now < self._slow_until and self._slow_s > 0:
+            time.sleep(self._slow_s)
         with self._ongoing_lock:
             self.num_requests += 1
             self._ongoing += 1
@@ -169,6 +256,25 @@ class Replica:
                 self._ongoing -= 1
 
     def health(self):
+        # a wedged replica cannot answer its health ping — that is the
+        # point: the controller's bounded wait times out and declares it
+        while time.time() < self._wedged_until:
+            time.sleep(0.05)
+        return True
+
+    def chaos(self, kind: str, duration_s: float = 3.0, slow_s: float = 0.0):
+        """Cooperative fault injection hook for
+        ray_tpu.chaos.ServeChaosInjector ("hang" / "slow"); kills go
+        straight to the OS. Test/bench surface — never on a request
+        path."""
+        now = time.time()
+        if kind == "hang":
+            self._wedged_until = now + duration_s
+        elif kind == "slow":
+            self._slow_until = now + duration_s
+            self._slow_s = slow_s
+        else:
+            raise ValueError(f"unknown chaos kind {kind!r}")
         return True
 
     def stats(self):
@@ -177,6 +283,7 @@ class Replica:
             "ongoing": self._ongoing,
             "queued": self._instance_load(),
             "load": self._load(),
+            "pid": self._pid,
         }
 
 
@@ -200,6 +307,15 @@ class ServeControllerActor:
         # per-deployment autoscaler decision state (flap-guard timers +
         # smoothing windows), reset on redeploy
         self._autoscalers: Dict[tuple, Any] = {}
+        # replica lifecycle state: birth times (startup grace for the
+        # staleness check) + per-deployment crash/restart breakers
+        self._knobs = _health_knobs()
+        self._born: Dict[str, float] = {}
+        self._breakers: Dict[tuple, Any] = {}
+        # telemetry snapshot shared between the autoscale and health
+        # loops: both tick at ~1s, so without the cache the controller
+        # would pay two identical full-table GCS fetches per second
+        self._stats_cache: tuple = (0.0, {})
 
     # ------------------------------------------------------------ long poll
     def _bump(self, key: str):
@@ -254,12 +370,14 @@ class ServeControllerActor:
             rec = self.apps.get(app, {}).get(dep)
             if rec is None:
                 return []
-            # membership + routing config in one long-poll payload, so a
-            # handle learns the deployment's affinity policy the same
-            # push that tells it which replicas exist
+            # membership + routing/failure config in one long-poll
+            # payload, so a handle learns the deployment's affinity AND
+            # redispatch policy the same push that tells it which
+            # replicas exist
             return {
                 "replicas": list(rec["replicas"]),
                 "affinity": rec.get("affinity"),
+                "fault": rec.get("fault"),
             }
         return None
 
@@ -277,6 +395,7 @@ class ServeControllerActor:
         autoscaling_config: Optional[dict] = None,
         is_ingress: bool = False,
         affinity_config: Optional[dict] = None,
+        fault_config: Optional[dict] = None,
     ):
         import cloudpickle
 
@@ -284,6 +403,7 @@ class ServeControllerActor:
             AutoscalingConfig,
             validate_affinity_config,
             validate_autoscaling_config,
+            validate_fault_config,
         )
 
         cls = cloudpickle.loads(cls_blob)
@@ -291,6 +411,7 @@ class ServeControllerActor:
         # already validated, but the controller RPC is also a surface)
         autoscaling_config = validate_autoscaling_config(autoscaling_config)
         affinity_config = validate_affinity_config(affinity_config)
+        fault_config = validate_fault_config(fault_config)
         app = self.apps.setdefault(app_name, {})
         old = app.get(deployment_name)
         rec = {
@@ -303,6 +424,7 @@ class ServeControllerActor:
             "ray_actor_options": dict(ray_actor_options or {}),
             "autoscaling": autoscaling_config,
             "affinity": affinity_config,
+            "fault": fault_config,
             "is_ingress": is_ingress,
             "deploy_time": time.time(),
         }
@@ -311,6 +433,9 @@ class ServeControllerActor:
         # autoscaler block): old flap-guard timers and load samples must
         # not drive the first decisions against the new replica set
         self._autoscalers.pop((app_name, deployment_name), None)
+        # new code, new crash history: a redeploy closes the old
+        # version's crash-loop breaker
+        self._breakers.pop((app_name, deployment_name), None)
         if autoscaling_config:
             rec["num_replicas"] = AutoscalingConfig(**autoscaling_config).start_replicas
         # stage new replicas BEFORE committing the record: a failed deploy
@@ -370,6 +495,7 @@ class ServeControllerActor:
             Replica.options(name=name, max_concurrency=16, **opts).remote(
                 rec["cls"], rec["init_args"], rec["init_kwargs"], name
             )
+            self._born[name] = time.time()
             cur.append(name)
         if len(cur) > target:
             # victim selection: least-loaded first (shortest drain, and
@@ -424,8 +550,27 @@ class ServeControllerActor:
         except Exception:
             pass
         self._scheduler.forget(name)
+        # replica names are never reused: drop the birth stamp or the
+        # dict grows one entry per replica a long-lived autoscaling
+        # deployment ever scaled through
+        self._born.pop(name, None)
 
     # ------------------------------------------------------ autoscale loop
+    async def _fetch_replica_stats_shared(self, max_age_s: float = 0.5):
+        """The ONE controller→GCS telemetry fetch per tick, shared by
+        the autoscale and health loops through a short-lived cache (the
+        blocking RPC runs off the actor's event loop)."""
+        import asyncio
+
+        t, stats = self._stats_cache
+        now = time.monotonic()
+        if now - t <= max_age_s:
+            return stats
+        stats = await asyncio.get_running_loop().run_in_executor(
+            None, _fetch_replica_stats)
+        self._stats_cache = (time.monotonic(), stats)
+        return stats
+
     async def run_control_loop(self, period_s: float = 1.0):
         """Traffic-driven autoscaling (fire-and-forget from serve.run).
 
@@ -440,7 +585,10 @@ class ServeControllerActor:
         if self._loop_started:
             return
         self._loop_started = True
-        loop = asyncio.get_running_loop()
+        # replica lifecycle rides its own loop: health checking must not
+        # share a tick budget with autoscaling (a suspect ping waits up
+        # to ping_timeout_s; scaling decisions shouldn't)
+        asyncio.ensure_future(self._health_loop(period_s))
         while True:
             await asyncio.sleep(period_s)
             targets = [
@@ -451,8 +599,9 @@ class ServeControllerActor:
             ]
             if not targets:
                 continue
-            # blocking GCS RPC off the actor's event loop
-            stats = await loop.run_in_executor(None, _fetch_replica_stats)
+            # ONE GCS round trip per tick (_fetch_replica_stats via the
+            # shared cache — the health loop reuses the same snapshot)
+            stats = await self._fetch_replica_stats_shared()
             now = time.time()
             for app_name, dep_name, rec in targets:
                 try:
@@ -522,6 +671,182 @@ class ServeControllerActor:
         except Exception:
             pass
 
+    # ------------------------------------------------------ replica health
+    def _breaker(self, app_name: str, dep_name: str):
+        from ray_tpu.serve._internal.lifecycle import CrashLoopBreaker
+
+        key = (app_name, dep_name)
+        b = self._breakers.get(key)
+        if b is None:
+            k = self._knobs
+            b = self._breakers[key] = CrashLoopBreaker(
+                backoff_base_s=k["restart_backoff_s"],
+                window_s=k["crash_loop_window_s"],
+                threshold=int(k["crash_loop_threshold"]),
+                cooldown_s=k["breaker_cooldown_s"],
+            )
+        return b
+
+    async def _health_loop(self, period_s: float = 1.0):
+        """Replica lifecycle loop: telemetry-staleness + bounded ping
+        health checks, dead/wedged replica replacement with exponential
+        backoff and a crash-loop circuit breaker, state transitions
+        published on /api/serve (`lifecycle:<app>::<dep>` snapshots).
+
+        Steady-state cost: one GCS telemetry fetch + one actor-table
+        fetch per tick, ZERO replica RPCs — pings go only to SUSPECTS
+        (stale telemetry past the startup grace), each bounded by
+        ping_timeout_s and gathered concurrently."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(period_s)
+            targets = [
+                (app_name, dep_name, rec)
+                for app_name, deps in list(self.apps.items())
+                for dep_name, rec in list(deps.items())
+                if rec["replicas"] or len(rec["replicas"]) < rec["num_replicas"]
+            ]
+            if not targets:
+                continue
+            stats = await self._fetch_replica_stats_shared()
+            actor_states = await loop.run_in_executor(None, _fetch_actor_states)
+            now = time.time()
+            for app_name, dep_name, rec in targets:
+                try:
+                    await self._health_one(
+                        app_name, dep_name, rec, stats, actor_states, now)
+                except Exception:
+                    import logging
+
+                    logging.getLogger("ray_tpu.serve").warning(
+                        "health cycle failed for %s::%s",
+                        app_name, dep_name, exc_info=True,
+                    )
+
+    async def _health_one(self, app_name, dep_name, rec, stats, actor_states, now):
+        """One deployment's health pass: classify replicas from the two
+        fetched tables, ping the suspects, replace the dead."""
+        import asyncio
+
+        dead: List[tuple] = []
+        suspects: List[str] = []
+        for name in list(rec["replicas"]):
+            if actor_states.get(name) == "DEAD":
+                # the GCS already knows (worker process exited / actor
+                # killed): no ping needed, fastest detection path
+                dead.append((name, "process died"))
+                continue
+            s = stats.get(name)
+            t = float(s.get("t", 0.0)) if isinstance(s, dict) else 0.0
+            if t >= now - self._knobs["health_stale_s"]:
+                continue  # fresh telemetry: healthy, zero RPCs
+            if t <= 0.0 and now - self._born.get(name, now) < self._knobs["startup_grace_s"]:
+                continue  # still initializing (model load / compile)
+            suspects.append(name)
+        if suspects:
+            oks = await asyncio.gather(
+                *(self._ping_replica(n) for n in suspects))
+            for name, ok in zip(suspects, oks):
+                if not ok:
+                    dead.append((name, "health check timed out (wedged)"))
+        for name, reason in dead:
+            self._on_replica_death(app_name, dep_name, rec, name, reason, now)
+        if dead:
+            self._bump(f"replicas::{app_name}::{dep_name}")
+            # prune the corpses' telemetry NOW: the ≤120s GCS retention
+            # window would otherwise let the autoscaler keep counting a
+            # crashed replica's last-published load as live signal
+            loop = asyncio.get_running_loop()
+            for name, _ in dead:
+                loop.run_in_executor(None, _prune_replica_telemetry, name)
+        self._maybe_restart(app_name, dep_name, rec, now)
+        if dead:
+            self._publish_lifecycle(app_name, dep_name, rec, now)
+
+    async def _ping_replica(self, name: str) -> bool:
+        """Bounded liveness ping for ONE suspect; False = wedged/dead."""
+        import asyncio
+
+        try:
+            h = ray_tpu.get_actor(name)
+            await asyncio.wait_for(
+                h.health.remote(), timeout=self._knobs["ping_timeout_s"])
+            return True
+        except Exception:
+            return False
+
+    def _on_replica_death(self, app_name, dep_name, rec, name, reason, now):
+        """Remove one dead/wedged replica from the serving set and
+        record the crash. The membership bump (caller) makes handles
+        stop routing at it; their in-flight requests fail through the
+        transport/RPC death paths and funnel into the handle's
+        redispatch choke point."""
+        import logging
+
+        logging.getLogger("ray_tpu.serve").warning(
+            "replica %s declared dead (%s); removing from %s/%s",
+            name, reason, app_name, dep_name,
+        )
+        if name in rec["replicas"]:
+            rec["replicas"].remove(name)
+        self._scheduler.forget(name)
+        self._born.pop(name, None)
+        try:
+            # wedged replicas are still registered: kill so the restart
+            # below doesn't race a zombie holding the old name's state
+            ray_tpu.kill(ray_tpu.get_actor(name))
+        except Exception:
+            pass
+        self._breaker(app_name, dep_name).record_crash(name, now, reason)
+
+    def _maybe_restart(self, app_name, dep_name, rec, now):
+        """Refill the replica set toward its target, gated by the
+        deployment's backoff/breaker state. In the breaker's half-open
+        phase exactly ONE probe replica starts — the rest of the
+        target waits until the probe survives its window (a
+        num_replicas=N crash-looper must not pay N doomed spawns per
+        cooldown cycle)."""
+        desired = rec["num_replicas"]
+        missing = desired - len(rec["replicas"])
+        if missing <= 0:
+            return
+        breaker = self._breaker(app_name, dep_name)
+        at = breaker.restart_at(now)
+        if at is None or at > now:
+            return  # crash-looped / probe out (None) or still backing off
+        target = min(desired, len(rec["replicas"]) + 1) \
+            if breaker.probing(now) else desired
+        before = list(rec["replicas"])
+        self._scale_to(app_name, dep_name, target, rec=rec)
+        # a probe scale must not lower the deployment's stored target
+        rec["num_replicas"] = desired
+        for name in rec["replicas"]:
+            if name not in before:
+                breaker.record_restart(name, now)
+        self._bump(f"replicas::{app_name}::{dep_name}")
+        self._publish_lifecycle(app_name, dep_name, rec, now)
+
+    def _publish_lifecycle(self, app_name, dep_name, rec, now):
+        """Replica state transitions on /api/serve: the
+        `lifecycle:<app>::<dep>` snapshot carries the breaker state and
+        the recent died/restarted/breaker event log."""
+        try:
+            from ray_tpu import observability
+
+            breaker = self._breaker(app_name, dep_name)
+            observability.publish_snapshot("serve", {
+                f"lifecycle:{app_name}::{dep_name}": {
+                    "t": now,
+                    "replicas": len(rec["replicas"]),
+                    "target": rec["num_replicas"],
+                    **breaker.state(now),
+                }
+            })
+        except Exception:
+            pass
+
     async def notify_starved(self, app_name: str, dep_name: str):
         """A handle is parking requests against an empty replica set —
         the scale-from-zero demand signal (rate-limited caller-side)."""
@@ -552,8 +877,11 @@ class ServeControllerActor:
             return False
         for key in [k for k in self._autoscalers if k[0] == app_name]:
             self._autoscalers.pop(key, None)
+        for key in [k for k in self._breakers if k[0] == app_name]:
+            self._breakers.pop(key, None)
         for dep_name, dep in app.items():
             for name in dep["replicas"]:
+                self._born.pop(name, None)
                 try:
                     ray_tpu.kill(ray_tpu.get_actor(name))
                 except Exception:
@@ -584,5 +912,14 @@ class ServeControllerActor:
                     }
                 if d.get("affinity"):
                     entry["affinity"] = dict(d["affinity"])
+                if d.get("fault"):
+                    entry["fault"] = dict(d["fault"])
+                breaker = self._breakers.get((app_name, name))
+                if breaker is not None and breaker.events:
+                    st = breaker.state()
+                    entry["lifecycle"] = {
+                        "state": st["state"],
+                        "recent_crashes": st["recent_crashes"],
+                    }
                 out[app_name][name] = entry
         return out

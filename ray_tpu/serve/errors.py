@@ -1,0 +1,132 @@
+"""Typed serve failure taxonomy.
+
+The serving plane's failure story hangs off ONE vocabulary: every way
+an accepted request can fail maps to a class here, every class says
+whether a retry can succeed, and every layer (engine admission, handle
+redispatch, HTTP proxy, loadgen report) speaks it instead of inventing
+its own string matching. Errors raised replica-side cross the process
+boundary as themselves — the RPC and direct-transport reply envelopes
+cloudpickle the exception object (`core_worker._env_err` /
+`_rebuild_error`) — so `isinstance` works wherever the failure lands.
+
+Retryable means: the request provably produced no observable output,
+so resubmitting it cannot duplicate anything. Three cases qualify:
+
+- ``RequestShedError`` — admission control refused the request before
+  any work started (queue bound / ETA bound). Retry after
+  ``retry_after_s`` (the proxy turns this into HTTP 503 +
+  ``Retry-After``).
+- ``ReplicaDiedError`` with ``started=False`` — the replica died (or
+  its transport broke) with the request in flight but, because result
+  delivery is end-of-request only, nothing ever escaped the dead
+  process. The handle auto-redispatches these onto survivors when the
+  deployment opted in (``fault_config={"redispatch": True}``).
+- ``ReplicaDiedError`` with ``started=True`` — the engine failed the
+  request AFTER emitting tokens (engine-internal death mid-stream).
+  Never auto-redispatched — a silent re-generation could diverge from
+  output a streaming consumer already saw — but safe for the CALLER to
+  retry explicitly, which is why it stays retryable.
+
+``DeadlineExceededError`` is typed but NOT retryable: the client's
+deadline already passed, so a retry of the same request is wasted work
+by definition (retry with a fresh deadline is a new request).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorError,
+    ActorUnavailableError,
+    RayTpuError,
+    TaskError,
+)
+
+__all__ = [
+    "RequestRetryableError",
+    "RequestShedError",
+    "ReplicaDiedError",
+    "DeadlineExceededError",
+    "classify_error",
+]
+
+
+class RequestRetryableError(RayTpuError):
+    """Base: the request produced no observable output — a retry (by
+    the handle's redispatch or by the caller) cannot duplicate work."""
+
+    #: hint for the caller / the proxy's Retry-After header (seconds)
+    retry_after_s: float = 1.0
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class RequestShedError(RequestRetryableError):
+    """Admission control refused the request (queue depth / ETA bound):
+    the deployment is overloaded and queueing longer would only convert
+    the overload into a timeout pileup. Maps to HTTP 503."""
+
+
+class ReplicaDiedError(RequestRetryableError, RuntimeError):
+    """The replica serving this request died (SIGKILL, wedge declared
+    dead by the health check, engine-loop death). ``started`` records
+    whether the engine had already emitted tokens for the request when
+    it failed — the redispatch-safety bit (see module docstring).
+
+    Also a RuntimeError: engine-death diagnostics historically surfaced
+    that way and callers catching RuntimeError keep working."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.5,
+                 started: bool = False):
+        super().__init__(message, retry_after_s)
+        self.started = started
+
+
+class DeadlineExceededError(RayTpuError):
+    """The request's deadline passed before (or while) it was served.
+    Typed so the proxy can answer 504 without a stack trace; not
+    retryable — the budget is spent."""
+
+
+# error classes whose appearance means "the replica process/transport is
+# gone" — nothing escaped, redispatch-safe unless the error itself says
+# otherwise (ReplicaDiedError.started)
+_DEATH_TYPES = (ActorUnavailableError, ActorDiedError, ActorError)
+_DEATH_NAMES = ("ActorUnavailableError", "ActorDiedError", "ActorError",
+                "ReplicaDiedError")
+
+
+def classify_error(exc: BaseException) -> Tuple[str, bool, Optional[float]]:
+    """Map any failure surfaced by the serve request path to
+    ``(category, retryable, retry_after_s)``.
+
+    category is one of ``"shed"`` / ``"replica-death"`` /
+    ``"deadline"`` / ``"other"`` — the drop taxonomy the loadgen report
+    and the proxy's HTTP mapping share. ``retry_after_s`` is None when
+    the error carries no hint.
+
+    Typed classes classify by isinstance; a ``TaskError`` (an exception
+    that failed to unpickle on the way back) falls back to its recorded
+    cause type so the taxonomy degrades gracefully instead of lumping
+    everything into "other".
+    """
+    if isinstance(exc, RequestShedError):
+        return "shed", True, exc.retry_after_s
+    if isinstance(exc, ReplicaDiedError):
+        return "replica-death", True, exc.retry_after_s
+    if isinstance(exc, DeadlineExceededError):
+        return "deadline", False, None
+    if isinstance(exc, _DEATH_TYPES):
+        return "replica-death", True, 0.5
+    if isinstance(exc, TaskError):
+        cause = exc.cause_type or ""
+        if cause == "RequestShedError":
+            return "shed", True, 1.0
+        if cause == "DeadlineExceededError":
+            return "deadline", False, None
+        if cause in _DEATH_NAMES:
+            return "replica-death", True, 0.5
+    return "other", False, None

@@ -26,11 +26,27 @@ the next long-poll bump repopulates the replica set, bounded by
 ``no_replica_timeout_s`` with an actionable error. An empty set also
 pings the controller (rate-limited) — the scale-from-zero demand
 signal.
+
+Failure semantics: every request gets a caller-generated request id
+and an in-flight RECORD (method/args/replica/attempt count) held
+handle-side. When the response resolves to a failure — whether it
+arrived over the RPC path (``ActorDiedError`` from the sender loop) or
+the direct transport (``ActorUnavailableError`` from the stream break)
+— it funnels through ONE policy choke point, ``_on_failure``: requests
+that were in flight on a replica that died are REQUEUED onto a
+survivor when the deployment opted in (``fault_config={"redispatch":
+True}``, safe for side-effect-free requests: result delivery is
+end-of-request only, so nothing escaped the dead process) and
+otherwise fail fast with a typed retryable ``ReplicaDiedError``;
+shed/deadline failures propagate typed as-is. Requeue decisions use
+only handle-local state — no controller round trips — and park under
+the zero-replica machinery when no survivor exists yet.
 """
 from __future__ import annotations
 
 import bisect
 import hashlib
+import itertools
 import logging
 import os
 import random
@@ -46,20 +62,65 @@ logger = logging.getLogger("ray_tpu.serve")
 # handle refresh re-walks the same membership list)
 _warned_replicas: set = set()
 
+# caller-generated request ids: pid + a process-wide counter is unique
+# and costs one integer increment on the submit path (uuid4 would pay
+# an os.urandom read per request)
+_rid_counter = itertools.count()
+
+
+def _next_rid() -> str:
+    return f"{os.getpid():x}-{next(_rid_counter):x}"
+
 
 class DeploymentResponse:
-    """Future-like response (reference: serve/handle.py DeploymentResponse)."""
+    """Future-like response (reference: serve/handle.py DeploymentResponse).
 
-    def __init__(self, ref, on_done=None):
+    Failure handling: a resolved error runs through the owning handle's
+    ``_on_failure`` choke point (when the response carries a request
+    record), which either REQUEUES the request onto a surviving replica
+    — the response then transparently re-awaits the new ref — or maps /
+    re-raises the failure typed. Both transports' death signals land
+    here: the RPC sender's ``ActorDiedError`` and the direct
+    transport's stream-break ``ActorUnavailableError`` are delivered
+    the same way (an error envelope on the result oid), so one loop
+    covers both."""
+
+    def __init__(self, ref, on_done=None, handle=None, record=None):
         self._ref = ref
         self._on_done = on_done
+        self._handle = handle
+        self._record = record
+        self._settled = False
+
+    def _settle(self):
+        if not self._settled:
+            self._settled = True
+            if self._on_done:
+                self._on_done()
+
+    def _failed(self, e: BaseException):
+        """Route a resolved failure through the handle's policy choke
+        point. Returns True when the request was requeued (self._ref
+        now points at the new attempt); raises the mapped typed error
+        (or returns False to re-raise the original) otherwise."""
+        if self._handle is None or self._record is None:
+            return False
+        new_ref = self._handle._on_failure(self._record, e)
+        if new_ref is None:
+            return False
+        self._ref = new_ref
+        return True
 
     def result(self, timeout: Optional[float] = None):
         try:
-            return ray_tpu.get(self._ref, timeout=timeout)
+            while True:
+                try:
+                    return ray_tpu.get(self._ref, timeout=timeout)
+                except Exception as e:
+                    if not self._failed(e):
+                        raise
         finally:
-            if self._on_done:
-                self._on_done()
+            self._settle()
 
     async def async_result(self, timeout: Optional[float] = 60.0):
         """Await the result natively (reference: the proxy awaits replica
@@ -68,11 +129,26 @@ class DeploymentResponse:
         blocking decode paths (shm/spill) use a worker thread."""
         from ray_tpu._private.worker import get_global_core
 
+        import asyncio
+
         try:
-            return await get_global_core().aget_value(self._ref, timeout)
+            while True:
+                try:
+                    return await get_global_core().aget_value(self._ref, timeout)
+                except Exception as e:
+                    if self._handle is None or self._record is None:
+                        raise
+                    # _on_failure can PARK (zero survivors) — run it on
+                    # a worker thread so a requeue during a replica
+                    # restart never stalls the caller's event loop
+                    new_ref = await asyncio.get_running_loop().run_in_executor(
+                        None, self._handle._on_failure, self._record, e
+                    )
+                    if new_ref is None:
+                        raise
+                    self._ref = new_ref
         finally:
-            if self._on_done:
-                self._on_done()
+            self._settle()
 
     @property
     def ref(self):
@@ -102,6 +178,12 @@ class DeploymentHandle:
         self._ring_names: List[str] = []    # replica name per ring point
         self._name_to_idx: Dict[str, int] = {}
         self._astats = {"hits": 0, "spills": 0, "misses": 0}
+        # failure-semantics state: the deployment's redispatch policy
+        # (pushed with membership) + the failure/redispatch counters
+        self._fault: Optional[Dict[str, Any]] = None
+        self._fstats = {"redispatches": 0, "redispatch_failfast": 0,
+                        "err_shed": 0, "err_replica_death": 0,
+                        "err_deadline": 0, "err_other": 0}
         self._last_starve_ping = 0.0
         self.no_replica_timeout_s = float(
             os.environ.get("RAY_TPU_SERVE_NO_REPLICA_TIMEOUT_S", "30.0")
@@ -115,9 +197,11 @@ class DeploymentHandle:
         if isinstance(data, dict):
             names = list(data.get("replicas") or ())
             affinity = data.get("affinity")
+            fault = data.get("fault", self._fault)
         else:
             names = list(data or ())
             affinity = self._affinity
+            fault = self._fault
         handles, ok_names, submits = [], [], []
         for name in names:
             try:
@@ -166,6 +250,7 @@ class DeploymentHandle:
             self._outstanding = {n: old.get(n, 0) for n in ok_names}
             self._version = version
             self._affinity = affinity
+            self._fault = fault
             self._ring_points = [p for p, _ in ring]
             self._ring_names = [n for _, n in ring]
             self._name_to_idx = {n: i for i, n in enumerate(ok_names)}
@@ -225,6 +310,7 @@ class DeploymentHandle:
             h._outstanding = dict(self._outstanding)
             h._version = self._version
             h._affinity = self._affinity
+            h._fault = self._fault
             h._ring_points = list(self._ring_points)
             h._ring_names = list(self._ring_names)
             h._name_to_idx = dict(self._name_to_idx)
@@ -390,10 +476,33 @@ class DeploymentHandle:
                 pass  # controller briefly unreachable: _reserve parks
             if not self._replicas:
                 self._notify_starved()
-        picked: Dict[str, str] = {}
+        if self._model_id:
+            kwargs = {**kwargs, "_serve_multiplexed_model_id": self._model_id}
+        # per-request failure record: the caller-generated request id,
+        # the exact submit shape (so a redispatch resubmits verbatim),
+        # and the attempt count — everything _on_failure needs, all
+        # handle-local. The request BODY is never mutated (arbitrary
+        # deployments echo it back) except for one normalization: a
+        # relative `deadline_s` becomes the ABSOLUTE `deadline` here,
+        # at first submit, so a redispatch cannot reset the clock. A
+        # user-provided request_id becomes the record's id.
+        rid = _next_rid()
+        if args and isinstance(args[0], dict):
+            req0 = args[0]
+            rid = req0.get("request_id", rid)
+            if req0.get("deadline_s") is not None:
+                req0 = dict(req0)
+                ds = req0.pop("deadline_s")
+                if req0.get("deadline") is None:
+                    req0["deadline"] = time.time() + float(ds)
+                args = (req0,) + args[1:]
+        record: Dict[str, Any] = {
+            "rid": rid, "method": self._method, "args": args,
+            "kwargs": kwargs, "replica": None, "attempts": 0,
+        }
 
         def done():
-            name = picked.get("name")
+            name = record.get("replica")
             with self._lock:
                 # counts are name-keyed so a membership refresh neither
                 # wipes them nor mis-charges a replica that took over
@@ -401,10 +510,9 @@ class DeploymentHandle:
                 if name in self._outstanding:
                     self._outstanding[name] = max(0, self._outstanding[name] - 1)
 
-        if self._model_id:
-            kwargs = {**kwargs, "_serve_multiplexed_model_id": self._model_id}
         akey = self._affinity_digest(args) if self._affinity else None
-        picked["name"], submit = self._reserve(akey)
+        record["akey"] = akey
+        record["replica"], submit = self._reserve(akey)
         try:
             # the prebound method rides the shm-ring direct transport
             # when negotiated, the RPC path otherwise — same call shape
@@ -412,21 +520,101 @@ class DeploymentHandle:
         except Exception:
             done()
             self._refresh()
-            picked["name"], submit = self._reserve(akey)
+            record["replica"], submit = self._reserve(akey)
             ref = submit.remote(self._method, args, kwargs)
-        return DeploymentResponse(ref, on_done=done)
+        return DeploymentResponse(ref, on_done=done, handle=self, record=record)
+
+    # -- failure policy -------------------------------------------------
+    def _drop_replica(self, name: str) -> None:
+        """Remove a replica observed dead from the local routing tables
+        NOW — the controller's membership push confirms (and re-adds a
+        restart) later, but until it lands neither pow-2 nor the
+        affinity ring should keep steering requests at a corpse."""
+        with self._lock:
+            if name not in self._name_to_idx:
+                return
+            names = [n for n in self._replica_names if n != name]
+            affinity, fault, version = self._affinity, self._fault, self._version
+        self._apply_replicas(
+            {"replicas": names, "affinity": affinity, "fault": fault}, version
+        )
+
+    def _on_failure(self, record: Dict[str, Any], exc: BaseException):
+        """THE redispatch choke point. Every failed serve request —
+        RPC-path actor death, direct-transport stream break, engine-side
+        typed failure — funnels here from DeploymentResponse.
+
+        Returns a NEW ref when the request was requeued onto a
+        survivor; returns None to re-raise the original (already-typed)
+        error; raises the mapped typed error otherwise. Decisions use
+        handle-local state only: the error's class/flags, the pushed
+        fault_config, and the record's attempt count. Requeue safety:
+        replica death with ``started=False`` (or process death, where
+        end-of-request delivery guarantees nothing escaped) is the ONLY
+        redispatched shape — anything that may have produced observable
+        output fails fast typed-retryable instead of silently running
+        twice."""
+        from ray_tpu.serve.errors import ReplicaDiedError, classify_error
+
+        category, _retryable, _hint = classify_error(exc)
+        dead_name = record.get("replica")
+        with self._lock:
+            self._fstats[f"err_{category.replace('-', '_')}"] += 1
+            fault = self._fault or {}
+            # the failed attempt's in-flight charge comes off now; a
+            # requeue below re-charges the survivor via _reserve
+            if dead_name in self._outstanding:
+                self._outstanding[dead_name] = max(
+                    0, self._outstanding[dead_name] - 1)
+            record["replica"] = None
+        if category != "replica-death":
+            return None  # shed / deadline / other: propagate typed as-is
+        if dead_name:
+            self._drop_replica(dead_name)
+        started = bool(getattr(exc, "started", False))
+        allowed = fault.get("redispatch", False) and not started
+        if not allowed or record["attempts"] >= fault.get("max_redispatches", 1):
+            with self._lock:
+                self._fstats["redispatch_failfast"] += 1
+            if isinstance(exc, ReplicaDiedError):
+                return None  # already the right type: re-raise original
+            raise ReplicaDiedError(
+                f"replica {dead_name or '?'} died with request "
+                f"{record['rid']} in flight"
+                + (" (redispatch disabled for this deployment)"
+                   if not fault.get("redispatch", False) else
+                   f" (after {record['attempts']} redispatch(es))"),
+                started=started,
+            ) from exc
+        record["attempts"] += 1
+        with self._lock:
+            self._fstats["redispatches"] += 1
+        logger.info(
+            "serve %s/%s: redispatching request %s off dead replica %s "
+            "(attempt %d)", self.app_name, self.deployment_name,
+            record["rid"], dead_name, record["attempts"],
+        )
+        # _reserve parks under the zero-replica machinery when the dead
+        # replica was the last one — the restart/scale-up push unparks
+        record["replica"], submit = self._reserve(record.get("akey"))
+        return submit.remote(record["method"], record["args"], record["kwargs"])
 
     def routing_stats(self) -> Dict[str, Any]:
         """Affinity routing counters (transport_stats-style): hits =
         preferred replica taken, spills = preferred over the spill
         threshold so least-loaded took over, misses = affinity on but
-        the request carried no routable key."""
+        the request carried no routable key — plus the failure ledger
+        (redispatches, fail-fasts, errors seen by taxonomy category)."""
         with self._lock:
             out = dict(self._astats)
-            out["total"] = sum(self._astats.values())
+            out["total"] = (self._astats["hits"] + self._astats["spills"]
+                            + self._astats["misses"])
             out["affinity_enabled"] = self._affinity is not None
             out["ring_points"] = len(self._ring_points)
             out["replicas"] = len(self._replica_names)
+            out.update(self._fstats)
+            out["redispatch_enabled"] = bool(
+                (self._fault or {}).get("redispatch"))
             return out
 
     def close(self):

@@ -38,7 +38,7 @@ from ray_tpu.serve.api import batch, deployment
 
 def _parse_request(req, default_max_new: int):
     """Request-path coercion: bare prompt list or dict with sampling
-    fields -> (prompt, max_new_tokens, SamplingParams)."""
+    fields -> (prompt, max_new_tokens, SamplingParams, request_id)."""
     if isinstance(req, dict):
         body = dict(req)
         if "prompt" not in body:
@@ -51,6 +51,16 @@ def _parse_request(req, default_max_new: int):
         # routing-only field: the handle/proxy affinity layer hashes it
         # to pick a cache-hot replica; the engine itself ignores it
         body.pop("session_id", None)
+        # caller-generated request id (redispatch bookkeeping / logs)
+        rid = body.pop("request_id", None)
+        # relative deadline form: the handle normally stamps the
+        # absolute `deadline` at submit (so a redispatch can't reset
+        # the clock); direct engine callers may still pass deadline_s
+        deadline_s = body.pop("deadline_s", None)
+        if deadline_s is not None and body.get("deadline") is None:
+            import time
+
+            body["deadline"] = time.time() + float(deadline_s)
         known = {f.name for f in dataclasses.fields(SamplingParams)}
         unknown = set(body) - known
         if unknown:
@@ -58,8 +68,8 @@ def _parse_request(req, default_max_new: int):
                 f"unknown request field(s) {sorted(unknown)}; valid "
                 f"sampling fields: {sorted(known)}"
             )
-        return prompt, max_new, SamplingParams(**body)
-    return [int(t) for t in req], default_max_new, SamplingParams()
+        return prompt, max_new, SamplingParams(**body), rid
+    return [int(t) for t in req], default_max_new, SamplingParams(), None
 
 
 class _LLMServer:
@@ -71,7 +81,7 @@ class _LLMServer:
                  continuous: bool = False, n_slots: int = 8, chunk: int = 8,
                  macro_phases: int = 8, paged: Optional[bool] = None,
                  block_size: int = 16, n_blocks: int = 0,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, max_queue: Optional[int] = None):
         import jax
 
         from ray_tpu.models import llama
@@ -107,7 +117,7 @@ class _LLMServer:
                 self.params, self.cfg, n_slots=n_slots, chunk=chunk,
                 macro_phases=macro_phases, paged=paged,
                 block_size=block_size, n_blocks=n_blocks,
-                prefix_cache=prefix_cache,
+                prefix_cache=prefix_cache, max_queue=max_queue,
                 # pid-unique name: each replica's engine publishes its
                 # own `engine:<name>` telemetry entry, so /api/serve
                 # shows PER-REPLICA serving metrics (same-named engines
@@ -149,7 +159,7 @@ class _LLMServer:
 
     def __call__(self, request) -> List[int]:
         if self.engine is not None:
-            prompt, max_new, sampling = _parse_request(
+            prompt, max_new, sampling, rid = _parse_request(
                 request, self.max_new_tokens
             )
             from ray_tpu.experimental.direct_transport import maybe_defer
@@ -165,15 +175,23 @@ class _LLMServer:
                     if req.error is None:
                         deferred.complete(req.tokens)
                     else:
-                        deferred.fail(RuntimeError(f"generation failed: {req.error}"))
+                        # typed failure when the engine recorded one
+                        # (shed / deadline / replica-death) — the class
+                        # crosses the ring pickled, so the handle's
+                        # redispatch policy classifies by isinstance
+                        deferred.fail(req.exc or RuntimeError(
+                            f"generation failed: {req.error}"))
 
-                # a submit() raise (dead engine, bad request) propagates:
-                # the transport surfaces it and disarms the deferred
+                # a submit() raise (dead engine, shed, bad request)
+                # propagates: the transport surfaces it and disarms the
+                # deferred
                 self.engine.submit(
                     prompt, max_new, on_done=_complete, sampling=sampling,
+                    rid=rid,
                 )
                 return None
-            return self.engine.generate(prompt, max_new, sampling=sampling)
+            return self.engine.generate(prompt, max_new, sampling=sampling,
+                                        rid=rid)
         if isinstance(request, dict):
             raise ValueError(
                 "per-request sampling needs the continuous engine "
@@ -188,6 +206,7 @@ def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
                    chunk: int = 8, macro_phases: int = 8,
                    paged: Optional[bool] = None, block_size: int = 16,
                    n_blocks: int = 0, prefix_cache: bool = True,
+                   max_queue: Optional[int] = None,
                    **deploy_kw):
     """A ready-to-run LLM generation application:
 
@@ -197,9 +216,18 @@ def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
 
     With continuous=True the replica runs the paged continuous-batching
     engine: requests may be dicts carrying SamplingParams fields
-    (temperature/top_k/top_p/seed/stop/max_new_tokens); `block_size` /
-    `n_blocks` size the paged KV pool and `prefix_cache` toggles radix
-    prompt-prefix reuse."""
+    (temperature/top_k/top_p/seed/stop/max_new_tokens, plus the
+    relative `deadline_s` budget); `block_size` / `n_blocks` size the
+    paged KV pool, `prefix_cache` toggles radix prompt-prefix reuse and
+    `max_queue` bounds admission (excess requests shed with a typed
+    retryable error instead of queueing unboundedly).
+
+    Generation is side-effect-free, so the deployment opts into
+    replica-death REDISPATCH by default: a request in flight on a
+    SIGKILLed/wedged replica (from which no output can have escaped —
+    results deliver only at completion) is requeued onto a survivor by
+    the handle; pass fault_config={"redispatch": False} to disable."""
+    deploy_kw.setdefault("fault_config", {"redispatch": True})
     dep = deployment(
         _LLMServer, name="LLMServer", num_replicas=num_replicas, **deploy_kw
     )
@@ -207,4 +235,4 @@ def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
                     checkpoint_dir=checkpoint_dir, continuous=continuous,
                     n_slots=n_slots, chunk=chunk, macro_phases=macro_phases,
                     paged=paged, block_size=block_size, n_blocks=n_blocks,
-                    prefix_cache=prefix_cache)
+                    prefix_cache=prefix_cache, max_queue=max_queue)

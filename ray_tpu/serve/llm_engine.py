@@ -205,17 +205,20 @@ class _LatencyHist:
 
 class _Request:
     __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
-                 "on_done", "sampling", "finish_reason", "_first_dev",
+                 "exc", "on_done", "sampling", "finish_reason", "_first_dev",
                  "_remaining", "_t_submit", "_t_first", "_t_done",
                  "_trace_ctx", "_start", "_blocks", "_blocks_freed",
-                 "_done_lock")
+                 "_done_lock", "rid")
 
-    def __init__(self, prompt, max_new_tokens, on_done=None, sampling=None):
+    def __init__(self, prompt, max_new_tokens, on_done=None, sampling=None,
+                 rid: Optional[str] = None):
         from ray_tpu.serve._internal.sampling import SamplingParams
 
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.sampling = sampling or SamplingParams()
+        # caller-generated request id (redispatch bookkeeping + logs)
+        self.rid = rid
         # "length" | "stop" | "cancelled" | None (error/unfinished)
         self.finish_reason: Optional[str] = None
         self.tokens: List[int] = []
@@ -233,6 +236,10 @@ class _Request:
         # event (see _LLMServer.__call__)
         self.on_done = on_done
         self.error: Optional[str] = None
+        # typed failure (serve/errors.py) — what generate()/the deferred
+        # completion raise so the taxonomy survives the process boundary
+        # (error stays the human-readable string form)
+        self.exc: Optional[BaseException] = None
         self._first_dev = None   # device scalar: prefill's first token (legacy path)
         self._remaining = 0      # host-side plan counter (decode steps owed)
         self._t_submit = time.perf_counter()
@@ -247,16 +254,23 @@ class _Request:
 
 
 def _finish(req: "_Request", error: Optional[str] = None,
-            reason: Optional[str] = None) -> bool:
+            reason: Optional[str] = None,
+            exc: Optional[BaseException] = None) -> bool:
     """Complete a request ATOMICALLY: exactly one caller wins (the
     engine loop delivering vs. a caller thread cancelling race here),
     the final error/finish_reason are written before `done` is visible,
     and on_done fires exactly once, outside the lock (callback failures
-    are logged, never poison the engine loop). Returns True for the
-    winner, False if the request was already complete."""
+    are logged, never poison the engine loop). `exc` carries the typed
+    failure (shed / deadline / replica-death) alongside the string form.
+    Returns True for the winner, False if the request was already
+    complete."""
     with req._done_lock:
         if req.done.is_set():
             return False
+        if exc is not None:
+            req.exc = exc
+            if error is None:
+                error = str(exc)
         if error is not None:
             req.error = error
         if reason is not None:
@@ -276,7 +290,8 @@ class ContinuousBatchingEngine:
     def __init__(self, params, cfg, n_slots: int = 8, max_len: int = 0,
                  chunk: int = 8, macro_phases: int = 8, name: str = "default",
                  paged: bool = False, block_size: int = 16,
-                 n_blocks: int = 0, prefix_cache: bool = True):
+                 n_blocks: int = 0, prefix_cache: bool = True,
+                 max_queue: Optional[int] = None):
         import jax
 
         from ray_tpu.models import llama_decode as D
@@ -333,12 +348,26 @@ class ContinuousBatchingEngine:
         self._waiting: deque = deque()       # planner-side FIFO (loop thread only)
         self._pending: deque = deque()       # fetch frontier: tagged entries
         self._dead: Optional[str] = None
+        # admission bound: max requests WAITING (beyond the resident
+        # slots) before submit() sheds with a typed 503-shaped error —
+        # overload must become fast rejections, not a timeout pileup.
+        # 0 = unbounded (the library default; serve deployments set it)
+        import os as _os
+
+        if max_queue is None:
+            max_queue = int(_os.environ.get("RAY_TPU_SERVE_MAX_QUEUE", "0"))
+        self.max_queue = max(0, int(max_queue))
+        # EMA of completed-request service time (submit → done): the
+        # admission ETA estimate. Written by the loop thread at
+        # delivery, read by submit() — a torn float read is harmless
+        self._ema_service_s = 0.0
         # serving metrics (monotonic counters + latency histograms)
         self.name = name
         self._m = {"dispatches": 0, "tokens_out": 0, "slot_steps": 0,
                    "useful_slot_steps": 0, "wasted_steps": 0,
                    "prefill_tokens": 0, "reused_prefix_tokens": 0,
-                   "kv_blocks_peak_in_use": 0}
+                   "kv_blocks_peak_in_use": 0, "shed_queue_full": 0,
+                   "shed_eta": 0, "deadline_expired": 0}
         shared = _engine_metrics()
         self._tags = {"engine": name}
         self._ttft = _LatencyHist(_TTFT_BOUNDS, shared["ttft"], self._tags)
@@ -358,8 +387,50 @@ class ContinuousBatchingEngine:
         self._thread.start()
 
     # ------------------------------------------------------------- public
+    def eta_s(self) -> float:
+        """Admission ETA estimate: how long a request submitted NOW is
+        expected to wait+run, from the queue depth and the service-time
+        EMA. 0.0 until the first completion (no data, no shedding)."""
+        ema = self._ema_service_s
+        if ema <= 0.0:
+            return 0.0
+        waiting = self._queue.qsize() + len(self._waiting)
+        return (waiting / max(1, self.n_slots)) * ema + ema
+
+    def _check_admission(self, sampling) -> None:
+        """Deadline/overload admission control — the typed-503 gate.
+        Raises; on the happy path costs two counter reads."""
+        from ray_tpu.serve.errors import DeadlineExceededError, RequestShedError
+
+        now = time.time()
+        deadline = sampling.deadline
+        if deadline is not None and deadline <= now:
+            self._m["deadline_expired"] += 1
+            raise DeadlineExceededError(
+                f"deadline passed {now - deadline:.2f}s before admission"
+            )
+        if self.max_queue:
+            waiting = self._queue.qsize() + len(self._waiting)
+            if waiting >= self.max_queue:
+                self._m["shed_queue_full"] += 1
+                raise RequestShedError(
+                    f"admission queue full ({waiting} waiting >= "
+                    f"max_queue {self.max_queue})",
+                    retry_after_s=max(0.1, round(self.eta_s(), 2)),
+                )
+        if deadline is not None:
+            eta = self.eta_s()
+            if eta > 0.0 and now + eta > deadline:
+                self._m["shed_eta"] += 1
+                raise RequestShedError(
+                    f"queue ETA {eta:.2f}s overruns the request deadline "
+                    f"({deadline - now:.2f}s away) — shedding instead of "
+                    f"queueing a guaranteed miss",
+                    retry_after_s=max(0.1, round(eta, 2)),
+                )
+
     def submit(self, prompt: List[int], max_new_tokens: int,
-               on_done=None, sampling=None) -> _Request:
+               on_done=None, sampling=None, rid: Optional[str] = None) -> _Request:
         from ray_tpu.serve._internal.sampling import SamplingParams
 
         if self._dead is not None:
@@ -399,8 +470,9 @@ class ContinuousBatchingEngine:
                     f"request needs {need} KV blocks, pool only has "
                     f"{self.n_blocks - 1}"
                 )
+        self._check_admission(sampling)
         req = _Request([int(t) for t in prompt], max_new_tokens,
-                       on_done=on_done, sampling=sampling)
+                       on_done=on_done, sampling=sampling, rid=rid)
         try:
             from ray_tpu.util import tracing
 
@@ -419,8 +491,9 @@ class ContinuousBatchingEngine:
         return req
 
     def generate(self, prompt: List[int], max_new_tokens: int,
-                 timeout: float = 120.0, sampling=None) -> List[int]:
-        req = self.submit(prompt, max_new_tokens, sampling=sampling)
+                 timeout: float = 120.0, sampling=None,
+                 rid: Optional[str] = None) -> List[int]:
+        req = self.submit(prompt, max_new_tokens, sampling=sampling, rid=rid)
         if not req.done.wait(timeout):
             # CANCEL, don't abandon: a timed-out request left live would
             # keep burning decode steps and (paged) holding KV blocks
@@ -429,6 +502,12 @@ class ContinuousBatchingEngine:
             self.cancel(req, "cancelled: generation timed out")
             raise TimeoutError("generation timed out (request cancelled)")
         if req.error is not None:
+            if req.exc is not None:
+                # typed failure (shed / deadline / replica-death):
+                # propagate the class, not a stringly RuntimeError — the
+                # handle's redispatch policy and the proxy's HTTP
+                # mapping both classify by isinstance
+                raise req.exc
             raise RuntimeError(f"generation failed: {req.error}")
         return req.tokens
 
@@ -479,6 +558,11 @@ class ContinuousBatchingEngine:
         m["speculative_waste_pct"] = round(
             100.0 * m["wasted_steps"] / max(1, m["useful_slot_steps"]), 2
         )
+        # admission-control ledger: total sheds + the ETA estimate the
+        # next admission would be judged against
+        m["shed_requests"] = m["shed_queue_full"] + m["shed_eta"]
+        m["avg_service_ms"] = round(self._ema_service_s * 1e3, 1)
+        m["admission_eta_ms"] = round(self.eta_s() * 1e3, 1)
         if self.paged:
             total = self.n_blocks - 1  # block 0 is the reserved null
             m["kv_blocks_total"] = total
@@ -780,6 +864,30 @@ class ContinuousBatchingEngine:
             self._m["useful_slot_steps"] += sum(t for _, _, t in ph["takes"])
         self._pending.append(("macro", toks_dev, firsts_dev, phases))
 
+    def _shed_expired(self) -> None:
+        """Deadline shed at plan boundaries: a QUEUED request whose
+        deadline already passed gets a typed failure now instead of
+        burning decode steps on a result nobody can use. In-flight
+        requests run to completion (their slots are already paid for —
+        evicting mid-macro-step would cost a repair for no capacity
+        gain). The finished entries leave the wait queue via _repair."""
+        if not self._waiting:
+            return
+        now = time.time()
+        shed = None
+        for r in self._waiting:
+            d = r.sampling.deadline
+            if d is not None and d <= now and not r.done.is_set():
+                shed = shed or []
+                shed.append((r, now - d))
+        if shed:
+            from ray_tpu.serve.errors import DeadlineExceededError
+
+            for r, late in shed:
+                self._m["deadline_expired"] += 1
+                _finish(r, exc=DeadlineExceededError(
+                    f"deadline passed {late:.2f}s into the queue"))
+
     def _repair(self) -> None:
         """Plan repair: reconcile host bookkeeping with requests that
         ended ahead of the speculative plan (device-side stop token,
@@ -798,6 +906,7 @@ class ContinuousBatchingEngine:
     def _loop_macro(self) -> None:
         while self._running:
             self._drain_queue()
+            self._shed_expired()
             self._repair()
             if not self._waiting and not any(r is not None for r in self._slots):
                 while self._pending:
@@ -867,6 +976,7 @@ class ContinuousBatchingEngine:
     def _loop_chunked(self) -> None:
         while self._running:
             self._drain_queue()
+            self._shed_expired()
             self._repair()  # timeout/cancel: free the slot before admitting
             self._admit()
             active = [(s, r) for s, r in enumerate(self._slots) if r is not None]
@@ -990,6 +1100,10 @@ class ContinuousBatchingEngine:
                     self._tpot.observe(
                         (req._t_done - req._t_first) / (len(req.tokens) - 1)
                     )
+                # service-time EMA feeding the admission ETA estimate
+                dur = req._t_done - req._t_submit
+                ema = self._ema_service_s
+                self._ema_service_s = dur if ema <= 0.0 else 0.8 * ema + 0.2 * dur
                 self._wake.set()  # repair promptly: slot + blocks are free
 
     def _resolve(self, entry) -> None:
@@ -1029,7 +1143,18 @@ class ContinuousBatchingEngine:
     def _die(self, msg: str) -> None:
         """Fail every in-flight and queued request with a diagnostic and
         mark the engine dead so submit() raises immediately — a poisoned
-        device program must not surface as N generic timeouts."""
+        device program must not surface as N generic timeouts.
+
+        Failures are TYPED (ReplicaDiedError) with the redispatch-safety
+        bit set from whether the request had already emitted tokens:
+        token-less requests are safe to replay elsewhere (nothing
+        escaped), partially-delivered ones must fail fast to the caller
+        (a silent re-generation could diverge from output already
+        observed). Every doomed request's KV blocks go back to the pool
+        — engine death must leave allocator refs == radix-cache refs
+        (the leak audit's invariant)."""
+        from ray_tpu.serve.errors import ReplicaDiedError
+
         self._dead = msg
         doomed = set()
         for entry in self._pending:
@@ -1051,7 +1176,8 @@ class ContinuousBatchingEngine:
                 break
         for req in doomed:
             self._free_request_blocks(req)
-            _finish(req, error=msg)
+            _finish(req, error=msg, exc=ReplicaDiedError(
+                f"engine died: {msg}", started=len(req.tokens) > 0))
 
     def _loop(self) -> None:
         try:

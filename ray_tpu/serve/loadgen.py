@@ -64,6 +64,10 @@ class Workload:
         spread over R replicas, affinity partitions them K/R per
         replica while unaffinitized routing makes every replica cache
         (and under pool pressure, evict) all K.
+    deadline_s: > 0 stamps every request with this relative deadline
+        budget (the handle converts it to the absolute form) — the
+        engine's deadline-aware admission/shed path engages exactly as
+        it would for production traffic carrying deadlines.
     request_fn: escape hatch — build the request yourself (rng ->
         request object); everything above is ignored. Use for non-LLM
         deployments.
@@ -80,6 +84,7 @@ class Workload:
     session_count: int = 0
     session_prefixes: int = 0
     session_prefix_len: int = 16
+    deadline_s: Optional[float] = None
     seed: int = 0
     request_fn: Optional[Callable[[random.Random], Any]] = None
     count_tokens: Optional[Callable[[Any], int]] = None
@@ -103,6 +108,8 @@ def _make_request(w: Workload, rng: random.Random):
     req: Dict[str, Any] = {
         "max_new_tokens": rng.randint(*w.max_new_tokens),
     }
+    if w.deadline_s is not None:
+        req["deadline_s"] = w.deadline_s
     if w.session_prefixes > 0:
         # per-session distinct prefixes: session s always opens with its
         # own session_prefix_len tokens (deterministic, disjoint from
@@ -212,7 +219,9 @@ def replica_metrics(app_name: str, deployment_name: str) -> Dict[str, Dict[str, 
 # ------------------------------------------------------------ the harness
 async def _run_async(handle, workload: Workload, phases: List[Phase],
                      request_timeout_s: float, track: Optional[Tuple[str, str]],
-                     drain_timeout_s: float) -> Dict[str, Any]:
+                     drain_timeout_s: float, retries: int = 0,
+                     chaos=None, chaos_target: Optional[Tuple[str, str]] = None
+                     ) -> Dict[str, Any]:
     rng = random.Random(workload.seed)
     records: List[Dict[str, Any]] = []
     in_flight: set = set()
@@ -238,30 +247,59 @@ async def _run_async(handle, workload: Workload, phases: List[Phase],
                 pass
 
     async def _one(req, phase_name: str):
+        from ray_tpu.serve.errors import classify_error
+
         rec = {"phase": phase_name, "t_submit": time.monotonic(), "ok": False,
-               "tokens": 0, "error": None}
+               "tokens": 0, "error": None, "category": None, "retried": 0}
         records.append(rec)
-        try:
-            # handle.remote() is cheap in steady state (pick + ring
-            # write) but can BLOCK during the scale events this harness
-            # exists to measure (zero-replica parking, an empty-set
-            # controller refresh) — submit on a worker thread so one
-            # parked request never stalls the arrival clock or other
-            # requests' completion timestamps
-            resp = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: handle.remote(req)
-            )
-            result = await resp.async_result(request_timeout_s)
-            rec["tokens"] = _count_tokens(workload, result)
-            rec["ok"] = True
-            # the result itself is NOT retained: a multi-minute run at
-            # open-loop rates would otherwise hold every generated token
-            # list until the report builds
-        except Exception as e:  # a DROPPED request — the harness counts it
-            rec["error"] = f"{type(e).__name__}: {e}"
+        attempt = 0
+        while True:
+            try:
+                # handle.remote() is cheap in steady state (pick + ring
+                # write) but can BLOCK during the scale events this
+                # harness exists to measure (zero-replica parking, an
+                # empty-set controller refresh) — submit on a worker
+                # thread so one parked request never stalls the arrival
+                # clock or other requests' completion timestamps
+                resp = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: handle.remote(req)
+                )
+                result = await resp.async_result(request_timeout_s)
+                rec["tokens"] = _count_tokens(workload, result)
+                rec["ok"] = True
+                rec["error"] = None
+                rec["category"] = None
+                # the result itself is NOT retained: a multi-minute run
+                # at open-loop rates would otherwise hold every
+                # generated token list until the report builds
+                break
+            except Exception as e:  # a failed attempt — classify it
+                category, retryable, hint = classify_error(e)
+                rec["error"] = f"{type(e).__name__}: {e}"
+                rec["category"] = category
+                # the harness retry: ONE more attempt for typed
+                # retryable failures (the chaos-gate contract — a
+                # request that fails retryable and lands on retry was
+                # never lost). Deadline/other failures are final.
+                if retryable and attempt < retries:
+                    attempt += 1
+                    rec["retried"] = attempt
+                    if hint:
+                        await asyncio.sleep(min(float(hint), 2.0))
+                    continue
+                break
         rec["t_done"] = time.monotonic()
 
     sampler = asyncio.ensure_future(_sample_replicas()) if track else None
+    injector = None
+    if chaos is not None:
+        # the chaos phase: fault events fire on the schedule's clock,
+        # relative to the first arrival — kills/hangs land mid-burst
+        from ray_tpu.chaos import ServeChaosInjector
+
+        app, dep = (chaos_target or track
+                    or (handle.app_name, handle.deployment_name))
+        injector = ServeChaosInjector(chaos, app, dep).start()
     for phase in phases:
         rate = workload.rate_hz * phase.rate_multiplier
         phase_end = time.monotonic() + phase.duration_s
@@ -291,7 +329,15 @@ async def _run_async(handle, workload: Workload, phases: List[Phase],
     if sampler is not None:
         stop_sampler.set()
         await sampler
-    return _build_report(records, replica_timeline, time.monotonic() - t_start)
+    report = _build_report(records, replica_timeline, time.monotonic() - t_start)
+    if injector is not None:
+        injector.stop()
+        injector.join(timeout=5.0)
+        report["chaos"] = {
+            "scheduled": len(chaos.events),
+            "fired": list(injector.fired),
+        }
+    return report
 
 
 def _phase_stats(recs: List[Dict[str, Any]], wall_s: float) -> Dict[str, Any]:
@@ -299,15 +345,45 @@ def _phase_stats(recs: List[Dict[str, Any]], wall_s: float) -> Dict[str, Any]:
         (r["t_done"] - r["t_submit"]) * 1e3 for r in recs if r.get("ok")
     )
     tokens = sum(r["tokens"] for r in recs if r.get("ok"))
-    return {
+    # typed drop taxonomy (serve/errors.classify_error categories):
+    # shed/deadline drops are the system REFUSING work it could not
+    # finish in time — intentional, typed, fast. "Lost" is everything
+    # else that didn't complete: a replica-death drop that survived the
+    # harness retry budget, or an untyped failure/timeout. The chaos
+    # gate is lost == 0.
+    drops: Dict[str, int] = {}
+    retried = recovered = 0
+    for r in recs:
+        if r.get("retried"):
+            retried += 1
+            if r.get("ok"):
+                recovered += 1
+        if not r.get("ok"):
+            drops[r.get("category") or "other"] = (
+                drops.get(r.get("category") or "other", 0) + 1)
+    lost = sum(n for cat, n in drops.items() if cat not in ("shed", "deadline"))
+    rej = sorted(
+        (r["t_done"] - r["t_submit"]) * 1e3 for r in recs
+        if not r.get("ok") and r.get("category") in ("shed", "deadline")
+    )
+    out = {
         "sent": len(recs),
         "completed": sum(1 for r in recs if r.get("ok")),
         "dropped": sum(1 for r in recs if not r.get("ok")),
+        "drops": drops,
+        "retried": retried,
+        "recovered": recovered,
+        "lost": lost,
         "latency_ms_p50": round(_percentile(lat, 0.50), 2),
         "latency_ms_p99": round(_percentile(lat, 0.99), 2),
         "tokens_out": tokens,
         "goodput_tok_s": round(tokens / max(1e-9, wall_s), 2),
     }
+    if rej:
+        # how fast overload turns into a typed rejection — the overload
+        # gate wants this ≪ the request deadline
+        out["rejection_ms_p99"] = round(_percentile(rej, 0.99), 2)
+    return out
 
 
 def _build_report(records, replica_timeline, wall_s) -> Dict[str, Any]:
@@ -317,6 +393,7 @@ def _build_report(records, replica_timeline, wall_s) -> Dict[str, Any]:
             r["t_done"] = r["t_submit"]
             r["ok"] = False
             r.setdefault("error", "TimeoutError: still in flight at drain timeout")
+            r.setdefault("category", "other")
         by_phase.setdefault(r["phase"], []).append(r)
     phase_wall: Dict[str, float] = {}
     for name, recs in by_phase.items():
@@ -345,17 +422,29 @@ def run_load(handle, workload: Workload, phases: Optional[List[Phase]] = None,
              *, request_timeout_s: float = 60.0,
              track: Optional[Tuple[str, str]] = None,
              drain_timeout_s: float = 120.0,
-             collect_serve_metrics: bool = True) -> Dict[str, Any]:
+             collect_serve_metrics: bool = True,
+             retries: int = 0,
+             chaos=None,
+             chaos_target: Optional[Tuple[str, str]] = None) -> Dict[str, Any]:
     """Drive `handle` with the workload through the phases (default: one
     steady phase of 5s) and return the report dict. `track=(app, dep)`
     samples that deployment's replica count through the run (the
     scale-up/scale-down record). With `collect_serve_metrics`, the
     report carries the post-run `/api/serve`-path telemetry snapshot
-    (engine TTFT/TPOT percentiles, aggregate prefix-cache hit rate)."""
+    (engine TTFT/TPOT percentiles, aggregate prefix-cache hit rate).
+
+    Failure knobs: `retries` grants each arrival that many extra
+    attempts on TYPED-RETRYABLE failures (shed / replica death) — the
+    chaos-gate contract is retries=1 with zero `lost`. `chaos` takes a
+    ray_tpu.chaos.ChaosSchedule fired against `chaos_target` (defaults
+    to `track`, then the handle's own deployment) while the load runs;
+    the report's `chaos` section records what actually fired, and every
+    drop is classified shed / replica-death / deadline / other."""
     phases = phases or [Phase("steady", 5.0)]
     report = asyncio.run(
         _run_async(handle, workload, phases, request_timeout_s, track,
-                   drain_timeout_s)
+                   drain_timeout_s, retries=retries, chaos=chaos,
+                   chaos_target=chaos_target)
     )
     if collect_serve_metrics:
         time.sleep(0.5)  # let the last engine/replica publishes land

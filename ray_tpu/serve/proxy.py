@@ -154,6 +154,19 @@ class ProxyActor:
         sid = request.headers.get("X-Serve-Session-Id")
         if sid and isinstance(body, dict):
             body.setdefault("session_id", sid)
+        # deadline over HTTP: a relative seconds budget in the
+        # X-Request-Deadline-S header rides into the body, where the
+        # handle stamps the absolute deadline and the engine's
+        # admission/shed policy enforces it
+        dl = request.headers.get("X-Request-Deadline-S")
+        if dl and isinstance(body, dict):
+            try:
+                body.setdefault("deadline_s", float(dl))
+            except ValueError:
+                return web.json_response(
+                    {"error": f"bad X-Request-Deadline-S header: {dl!r}"},
+                    status=400,
+                )
         try:
             # handle.remote can BLOCK (zero-replica parking waits on the
             # membership condition; an empty-set refresh is a controller
@@ -190,7 +203,21 @@ class ProxyActor:
 
             if isinstance(e, TaskError) and "_NoRouteError" in getattr(e, "traceback_str", str(e)):
                 return web.json_response({"error": "no matching route"}, status=404)
-            return web.json_response({"error": str(e)}, status=500)
+            # typed failure taxonomy → HTTP: retryable failures (shed,
+            # replica death) answer 503 with a Retry-After hint —
+            # clients see "overloaded/recovering, come back", not a 500
+            # with a stack trace; a spent deadline answers 504
+            from ray_tpu.serve.errors import classify_error
+
+            category, retryable, retry_after = classify_error(e)
+            payload = {"error": str(e), "type": category,
+                       "retryable": retryable}
+            if category in ("shed", "replica-death"):
+                headers = {"Retry-After": str(max(1, round(retry_after or 1.0)))}
+                return web.json_response(payload, status=503, headers=headers)
+            if category == "deadline":
+                return web.json_response(payload, status=504)
+            return web.json_response(payload, status=500)
 
     def ready(self):
         return self.port

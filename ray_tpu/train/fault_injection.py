@@ -1,206 +1,24 @@
 """Deterministic preemption injection for elastic-training tests/bench.
 
-TPU slices get preempted: spot reclaims, maintenance events, link
-flaps. The chaos tier (tests/test_chaos.py) kills PROCESSES at random;
-this module injects SLICE-level faults into `MultisliceTrainStep` on a
-seeded, perfectly replayable schedule, so an elastic run's
-degrade → re-admit behavior (and its goodput bill) is a deterministic
-function of (seed, config) — the property the regression tests and
-`bench.py`'s elastic section both lean on.
-
-Three fault kinds, mirroring how real slices fail:
-
-  kill — the slice vanishes mid-step (spot reclaim). Raises
-         `SlicePreempted` from inside the slice's work; the slice stays
-         dead for `duration_steps`, then becomes re-admittable.
-  hang — the slice stops responding but the process lives (wedged ICI,
-         driver stall). The injected work sleeps past the trainer's
-         probe timeout so detection happens via the BOUNDED-TIMEOUT
-         probe path, not an exception.
-  slow — a straggler (thermal throttle, noisy neighbor): work is
-         delayed by `slow_s` but completes. No membership change —
-         goodput erodes without a recovery event.
-
-Kills can carry an ADVANCE MAINTENANCE NOTICE (`notice_steps > 0`),
-modeling TPU maintenance-event warnings: `maintenance_notice(step)`
-reports the impending kill before it fires so the train loop can take
-a PRIORITY checkpoint while the slice is still healthy.
+The implementation moved to ``ray_tpu/chaos.py`` when the serving plane
+grew its own fault injection — seeded kill/hang/slow schedules are now
+ONE shared module covering both step-keyed training faults (these
+re-exports) and time-keyed serve replica chaos
+(``chaos.ChaosSchedule`` / ``chaos.ServeChaosInjector``). This shim
+keeps every existing train import path working unchanged.
 """
 from __future__ import annotations
 
-import dataclasses
-import json
-import time
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from ray_tpu.chaos import (  # noqa: F401
+    FaultEvent,
+    PreemptionInjector,
+    PreemptionSchedule,
+    SlicePreempted,
+)
 
-
-class SlicePreempted(Exception):
-    """A slice died (or was declared dead) mid-step."""
-
-    def __init__(self, slice_idx: int, kind: str = "kill"):
-        super().__init__(f"slice {slice_idx} preempted ({kind})")
-        self.slice_idx = slice_idx
-        self.kind = kind
-
-
-@dataclasses.dataclass(frozen=True)
-class FaultEvent:
-    step: int            # first step the fault is active
-    slice_idx: int
-    kind: str            # "kill" | "hang" | "slow"
-    duration_steps: int = 3   # steps the slice stays down (kill/hang)
-    notice_steps: int = 0     # advance maintenance notice before a kill
-    slow_s: float = 0.0       # extra latency for "slow"
-
-    def to_dict(self) -> Dict:
-        return dataclasses.asdict(self)
-
-    @property
-    def end_step(self) -> int:
-        return self.step + self.duration_steps
-
-
-class PreemptionSchedule:
-    """An ordered, replayable list of FaultEvents."""
-
-    def __init__(self, events: Sequence[FaultEvent], seed: Optional[int] = None):
-        self.events: List[FaultEvent] = sorted(
-            events, key=lambda e: (e.step, e.slice_idx)
-        )
-        self.seed = seed
-
-    @classmethod
-    def generate(
-        cls,
-        seed: int,
-        n_slices: int,
-        total_steps: int,
-        *,
-        n_events: int = 2,
-        kinds: Sequence[str] = ("kill", "hang", "slow"),
-        min_gap_steps: int = 6,
-        duration_steps: Tuple[int, int] = (2, 4),
-        notice_prob: float = 0.5,
-        notice_steps: int = 2,
-        slow_s: float = 0.05,
-    ) -> "PreemptionSchedule":
-        """Deterministic in (seed, args): same inputs, same schedule.
-        Events never target slice 0 (one survivor must always hold the
-        authoritative state to broadcast from) and are spaced at least
-        `min_gap_steps` apart so each outage resolves before the next."""
-        import numpy as np
-
-        if n_slices < 2:
-            return cls([], seed=seed)
-        rng = np.random.Generator(np.random.PCG64(seed))
-        events: List[FaultEvent] = []
-        step = int(rng.integers(min_gap_steps, max(min_gap_steps + 1, total_steps // 3)))
-        for _ in range(n_events):
-            if step >= total_steps - 1:
-                break
-            kind = str(rng.choice(list(kinds)))
-            dur = int(rng.integers(duration_steps[0], duration_steps[1] + 1))
-            notice = (
-                notice_steps
-                if kind == "kill" and rng.random() < notice_prob
-                else 0
-            )
-            events.append(
-                FaultEvent(
-                    step=step,
-                    slice_idx=int(rng.integers(1, n_slices)),
-                    kind=kind,
-                    duration_steps=dur if kind != "slow" else 0,
-                    notice_steps=notice,
-                    slow_s=slow_s if kind == "slow" else 0.0,
-                )
-            )
-            step += dur + int(rng.integers(min_gap_steps, 2 * min_gap_steps))
-        return cls(events, seed=seed)
-
-    # ---------------------------------------------------------- replay io
-    def to_json(self) -> str:
-        return json.dumps(
-            {"seed": self.seed, "events": [e.to_dict() for e in self.events]}
-        )
-
-    @classmethod
-    def from_json(cls, blob: str) -> "PreemptionSchedule":
-        d = json.loads(blob)
-        return cls([FaultEvent(**e) for e in d["events"]], seed=d.get("seed"))
-
-    def __eq__(self, other) -> bool:
-        return isinstance(other, PreemptionSchedule) and self.events == other.events
-
-    def __repr__(self) -> str:
-        return f"PreemptionSchedule(seed={self.seed}, events={self.events})"
-
-
-class PreemptionInjector:
-    """Drives a schedule against a MultisliceTrainStep.
-
-    The trainer calls `check(slice_idx, step)` inside each slice's
-    work, `maintenance_notice(step)` before dispatching a step, and
-    `revivable(step)` when deciding whether to re-admit. `hang_s`
-    bounds the simulated hang so test threads eventually unwind — it
-    must exceed the trainer's probe timeout for the hang to be
-    DETECTED as one."""
-
-    def __init__(self, schedule: PreemptionSchedule, *, hang_s: float = 2.0):
-        self.schedule = schedule
-        self.hang_s = hang_s
-        self.fired: List[FaultEvent] = []
-        self._down: Dict[int, FaultEvent] = {}  # slice -> active outage
-
-    # ---------------------------------------------------------- queries
-    def maintenance_notice(self, step: int) -> List[FaultEvent]:
-        """Kills whose advance-notice window covers `step` and have not
-        fired yet — the signal for a priority checkpoint."""
-        return [
-            e
-            for e in self.schedule.events
-            if e.kind == "kill"
-            and e.notice_steps > 0
-            and e.step - e.notice_steps <= step < e.step
-        ]
-
-    def active_event(self, slice_idx: int, step: int) -> Optional[FaultEvent]:
-        for e in self.schedule.events:
-            if e.slice_idx != slice_idx:
-                continue
-            if e.kind == "slow" and e.step == step:
-                return e
-            if e.kind in ("kill", "hang") and e.step <= step < e.end_step:
-                return e
-        return None
-
-    def revivable(self, step: int) -> Set[int]:
-        """Slices whose outage has ended by `step` (ready to re-admit)."""
-        out = set()
-        for e in self.schedule.events:
-            if e.kind in ("kill", "hang") and e.end_step <= step:
-                out.add(e.slice_idx)
-        # minus slices currently inside a LATER outage
-        for e in self.schedule.events:
-            if e.kind in ("kill", "hang") and e.step <= step < e.end_step:
-                out.discard(e.slice_idx)
-        return out
-
-    # ------------------------------------------------------------ inject
-    def check(self, slice_idx: int, step: int) -> None:
-        """Called inside a slice's per-step work. Raises/sleeps per the
-        schedule; a no-op for healthy (slice, step) pairs."""
-        e = self.active_event(slice_idx, step)
-        if e is None:
-            return
-        if e not in self.fired:
-            self.fired.append(e)
-        if e.kind == "kill":
-            raise SlicePreempted(slice_idx, "kill")
-        if e.kind == "hang":
-            # wedge past the probe timeout, then die like the probe
-            # would eventually observe — bounded so threads unwind
-            time.sleep(self.hang_s)
-            raise SlicePreempted(slice_idx, "hang")
-        if e.kind == "slow":
-            time.sleep(e.slow_s)
+__all__ = [
+    "FaultEvent",
+    "PreemptionInjector",
+    "PreemptionSchedule",
+    "SlicePreempted",
+]

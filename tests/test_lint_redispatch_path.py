@@ -1,0 +1,125 @@
+"""Repo lint: the redispatch path stays cheap, local, and singular.
+
+The rules, enforced on source (no cluster):
+
+- Requeue decisions are made from HANDLE-LOCAL state: `_on_failure`
+  (the policy choke point) makes no controller RPCs and no membership
+  refresh round trips — the error's class, the pushed fault_config and
+  the request record are the whole input.
+- There is exactly ONE policy choke point: both
+  `DeploymentResponse.result` and `async_result` funnel failures into
+  `_on_failure`; neither the direct transport nor the core worker
+  implements its own redispatch — their job ends at surfacing typed
+  death errors (ActorUnavailableError / ActorDiedError) that the choke
+  point classifies.
+- The failure taxonomy is classified in ONE place
+  (`serve/errors.classify_error`): the proxy's HTTP mapping and the
+  loadgen report both call it instead of string-matching.
+- Engine admission control raises TYPED errors
+  (RequestShedError/DeadlineExceededError) from `submit`, so overload
+  becomes classifiable 503s end to end.
+"""
+import inspect
+import re
+
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+_CONTROLLER_RPC = re.compile(
+    r"_get_controller|listen_for_change|get_replicas_versioned|_refresh\b"
+)
+
+
+def test_on_failure_uses_handle_local_state_only():
+    src = inspect.getsource(DeploymentHandle._on_failure)
+    assert not _CONTROLLER_RPC.search(src), (
+        "_on_failure must decide requeues from handle-local state (the "
+        "record, the pushed fault_config, the error class) — a controller "
+        "round trip per failure would stall every failed request behind "
+        "the control plane"
+    )
+    assert "classify_error" in src, (
+        "_on_failure must classify through the shared taxonomy, not "
+        "ad-hoc string matching"
+    )
+    assert "_reserve" in src, (
+        "requeues must go through _reserve — the same pick/park path as "
+        "first submits, so zero-survivor windows park instead of raising"
+    )
+
+
+def test_both_transports_funnel_into_one_choke_point():
+    """RPC-path and direct-transport failures both surface as error
+    envelopes on the result oid; the response resolution loops route
+    them into _on_failure — the ONE redispatch policy."""
+    for fn in (DeploymentResponse.result, DeploymentResponse.async_result):
+        src = inspect.getsource(fn)
+        assert "_failed" in src or "_on_failure" in src, (
+            f"DeploymentResponse.{fn.__name__} must route failures through "
+            f"the _on_failure choke point"
+        )
+    # the transports surface typed death errors; they do NOT redispatch
+    import ray_tpu._private.core_worker as cw
+    import ray_tpu.experimental.direct_transport as dt
+
+    for mod in (dt, cw):
+        src = inspect.getsource(mod)
+        assert "redispatch" not in src and "_on_failure" not in src, (
+            f"{mod.__name__} must not implement its own redispatch — the "
+            f"handle's _on_failure is the single policy choke point"
+        )
+
+
+def test_proxy_and_loadgen_share_the_taxonomy():
+    import ray_tpu.serve.loadgen as loadgen
+    from ray_tpu.serve.proxy import ProxyActor
+
+    proxy_src = inspect.getsource(ProxyActor._cls._handle)
+    assert "classify_error" in proxy_src, (
+        "the proxy's HTTP mapping must classify through "
+        "serve.errors.classify_error (503 + Retry-After for retryable, "
+        "504 for deadline), not string-match exception text"
+    )
+    assert "Retry-After" in proxy_src
+    lg_src = inspect.getsource(loadgen)
+    assert "classify_error" in lg_src, (
+        "loadgen's drop taxonomy must come from the shared classifier"
+    )
+
+
+def test_engine_admission_raises_typed_errors():
+    from ray_tpu.serve.llm_engine import ContinuousBatchingEngine
+
+    submit_src = inspect.getsource(ContinuousBatchingEngine.submit)
+    assert "_check_admission" in submit_src, (
+        "submit must run admission control (queue/ETA/deadline bounds)"
+    )
+    adm_src = inspect.getsource(ContinuousBatchingEngine._check_admission)
+    assert "RequestShedError" in adm_src and "DeadlineExceededError" in adm_src, (
+        "admission refusals must be typed — the proxy's 503 mapping and "
+        "the handle's taxonomy counters both classify by class"
+    )
+    die_src = inspect.getsource(ContinuousBatchingEngine._die)
+    assert "ReplicaDiedError" in die_src and "started=" in die_src, (
+        "_die must fail requests with the typed ReplicaDiedError carrying "
+        "the started flag (the redispatch-safety bit)"
+    )
+
+
+def test_health_loop_pings_only_suspects():
+    """Steady state must stay RPC-free: the health loop's fast paths are
+    the telemetry staleness check and ONE actor-table fetch; pings go
+    only to suspects and are bounded."""
+    from ray_tpu.serve import controller as ctl
+
+    loop_src = inspect.getsource(ctl.ServeControllerActor._cls._health_loop)
+    assert "_fetch_replica_stats" in loop_src and "_fetch_actor_states" in loop_src
+    one_src = inspect.getsource(ctl.ServeControllerActor._cls._health_one)
+    assert "suspects" in one_src, (
+        "_health_one must gate pings on telemetry staleness (suspects), "
+        "never ping every replica every tick"
+    )
+    ping_src = inspect.getsource(ctl.ServeControllerActor._cls._ping_replica)
+    assert "wait_for" in ping_src and "ping_timeout_s" in ping_src, (
+        "health pings must be bounded — a wedged replica must cost at most "
+        "ping_timeout_s per cycle, not a hung control loop"
+    )

@@ -546,9 +546,11 @@ def test_parse_request_missing_prompt():
     # typo'd sampling field: a named ValueError, not a dataclass TypeError
     with pytest.raises(ValueError, match="temprature"):
         _parse_request({"prompt": [1, 2], "temprature": 0.5}, 8)
-    prompt, max_new, sp = _parse_request(
-        {"prompt": [1, 2], "temperature": 0.5, "max_new_tokens": 3}, 8)
+    prompt, max_new, sp, rid = _parse_request(
+        {"prompt": [1, 2], "temperature": 0.5, "max_new_tokens": 3,
+         "request_id": "r-1"}, 8)
     assert prompt == [1, 2] and max_new == 3 and sp.temperature == 0.5
+    assert rid == "r-1"
 
 
 def test_failed_admission_retries_do_not_inflate_hit_rate():
