@@ -846,6 +846,421 @@ def macro_step_slots_paged(params, cache, feed, steps, has_admit, prompts,
     return toks, firsts, feed, cache
 
 
+# ---------------------------------------------------------------------------
+# Draft-model speculative decoding (Leviathan et al. 2023; Chen et al.
+# 2023) on the paged substrate: a small DRAFT model proposes n_spec
+# tokens per lane from its OWN paged KV pool (mirroring the target's
+# block tables — one allocator plan serves both pools), then the target
+# verifies all of them in ONE batched multi-position pass
+# (verify-style scoring through the same block tables). Acceptance is
+# LOSSLESS: greedy lanes accept a draft token iff it equals the target
+# argmax; sampled lanes run residual/rejection sampling (accept d with
+# prob min(1, p(d)/q(d)); on rejection sample from the normalized
+# residual max(0, p - q)), which preserves the target's (warped)
+# distribution exactly. Rejected KV writes are safe by the
+# position-rollback discipline: `pos` only ever advances past VERIFIED
+# tokens, the attention mask s <= pos hides cells beyond it, and every
+# pass writes its whole position span before gathering — so stale
+# rejected cells are overwritten before they can become visible. The
+# draft pool's one possible hole (the last draft token's KV when all
+# n_spec are accepted and the bonus token is taken) is patched for free
+# by the next round's first draft pass, which is 2 positions wide: it
+# re-processes the tracked previous token at pos - 1 (an idempotent
+# rewrite when the cell was already correct, the hole-fill when it
+# wasn't) alongside the feed token at pos.
+# ---------------------------------------------------------------------------
+
+
+def init_spec_cache(draft_cfg: LlamaConfig, n_slots: int, n_blocks: int,
+                    block_size: int) -> Dict[str, Any]:
+    """Draft-model paged state: its own K/V pool with the SAME block
+    geometry as the target (block tables are shared — one host plan
+    addresses both pools) plus the per-slot previous token (`prev`, the
+    token at pos - 1). Each round's first draft pass re-processes it so
+    the one possible draft-pool hole — the last draft token's KV when a
+    whole round was accepted and the bonus token taken — is refilled
+    without a separate catch-up dispatch."""
+    shape = (draft_cfg.n_layers, n_blocks, block_size, draft_cfg.n_kv_heads,
+             draft_cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, draft_cfg.dtype),
+        "v": jnp.zeros(shape, draft_cfg.dtype),
+        "prev": jnp.zeros((n_slots,), jnp.int32),
+    }
+
+
+def _forward_tokens_paged(params, kv_k, kv_v, tokens, row_tables, base_pos,
+                          active, cfg: LlamaConfig, with_logits: bool = True):
+    """Multi-position paged forward: process tokens (R, T) at absolute
+    positions base_pos[:, None] + arange(T), writing each position's
+    K/V into the pool and attending through row_tables (R, MB).
+    Inactive rows and positions past the table edge aim their writes at
+    the null block. Per layer EVERY row writes before ANY row gathers
+    (the admit_slots_paged discipline) and position t's causal mask is
+    s <= base_pos + t, so one call scores T positions per row exactly
+    as T sequential decode steps would — the speculative verify kernel.
+    Returns (logits (R, T, V) f32 or None, kv_k, kv_v)."""
+    R, T = tokens.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    bs = kv_k.shape[2]
+    MB = row_tables.shape[1]
+    S = MB * bs
+    x = params["embed"][tokens].astype(cfg.dtype)
+    # rope span covers worst-case overshoot positions (a lane near the
+    # table edge writes its tail into the null block, but the angle
+    # lookup must stay in range)
+    cos, sin = rope_frequencies(hd, S + T, cfg.rope_theta)
+    positions = base_pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    def body(carry, layer_and_idx):
+        x, k_full, v_full = carry
+        layer, li = layer_and_idx
+        a = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = (a @ layer["wq"]).reshape(R, T, h, hd)
+        k = (a @ layer["wk"]).reshape(R, T, kvh, hd)
+        v = (a @ layer["wv"]).reshape(R, T, kvh, hd)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        def write_row(r, kv):
+            kf, vf = kv
+            pb = jax.lax.dynamic_index_in_dim(base_pos, r, keepdims=False)
+            ab = jax.lax.dynamic_index_in_dim(active, r, keepdims=False)
+            row = jax.lax.dynamic_index_in_dim(row_tables, r, 0, keepdims=False)
+            for t in range(T):  # static: T positions per row
+                p = pb + t
+                idx = p // bs
+                blk = jax.lax.dynamic_index_in_dim(
+                    row, jnp.minimum(idx, MB - 1), keepdims=False)
+                ok = ab & (idx < MB)
+                blk = jnp.where(ok, blk, 0)  # overshoot/inactive -> null
+                off = jnp.where(ok, p % bs, 0)
+                kc = jax.lax.dynamic_slice(k, (r, t, 0, 0), (1, 1, kvh, hd))
+                vc = jax.lax.dynamic_slice(v, (r, t, 0, 0), (1, 1, kvh, hd))
+                kf = jax.lax.dynamic_update_slice(kf, kc[None], (li, blk, off, 0, 0))
+                vf = jax.lax.dynamic_update_slice(vf, vc[None], (li, blk, off, 0, 0))
+            return kf, vf
+
+        k_full, v_full = jax.lax.fori_loop(0, R, write_row, (k_full, v_full))
+        k_layer = jax.lax.dynamic_index_in_dim(k_full, li, 0, keepdims=False)
+        v_layer = jax.lax.dynamic_index_in_dim(v_full, li, 0, keepdims=False)
+        ctx_k, ctx_v = _gather_block_ctx(k_layer, v_layer, row_tables)
+        o = _gqa_attend_paged_prefill(q, ctx_k, ctx_v, positions, cfg)
+        x = x + o @ layer["wo"]
+        m = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+        gate = jax.nn.silu((m @ layer["w_gate"]).astype(jnp.float32)).astype(cfg.dtype)
+        x = x + (gate * (m @ layer["w_up"])) @ layer["w_down"]
+        return (x, k_full, v_full), None
+
+    (x, k_full, v_full), _ = jax.lax.scan(
+        body, (x, kv_k, kv_v),
+        (params["layers"], jnp.arange(cfg.n_layers)), unroll=True)
+    if not with_logits:
+        return None, k_full, v_full
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return logits, k_full, v_full
+
+
+def verify_step_slots_paged(params, cache, feed, draft_toks, tables,
+                            cfg: LlamaConfig):
+    """Target verification pass: score feed + the n_spec draft
+    proposals for every lane in ONE batched paged dispatch. Writes the
+    target K/V for all n_spec + 1 positions (pos .. pos + n_spec) and
+    returns logits (B, n_spec + 1, V) f32 — logits[:, j] is the target
+    distribution AFTER consuming [feed, d_1 .. d_j], i.e. the verifier
+    for draft token j+1 (and column n_spec is the bonus distribution
+    when every draft token is accepted) — plus the updated (k, v)
+    pools. Position rollback (the caller advancing `pos` only past
+    accepted tokens) is what keeps the rejected tail's writes
+    invisible: the mask s <= pos hides them and the next round's span
+    overwrites them before any gather."""
+    toks = jnp.concatenate([feed[:, None], draft_toks], axis=1)
+    logits, tk, tv = _forward_tokens_paged(
+        params, cache["k"], cache["v"], toks, tables, cache["pos"],
+        cache["remaining"] > 0, cfg, with_logits=True)
+    return logits, tk, tv
+
+
+def spec_round_slots_paged(params, draft_params, cache, draft_cache, feed,
+                           tables, temps, top_ks, top_ps, stop_ids,
+                           n_spec: int, cfg: LlamaConfig,
+                           draft_cfg: LlamaConfig, sampled: bool = True):
+    """One speculative round on every slot: n_spec sequential draft
+    proposals (draft pool) + one batched target verification
+    (verify_step_slots_paged) + lossless acceptance.
+
+    Greedy lanes accept the longest draft prefix matching the target
+    argmax and emit the target argmax at the first mismatch (or the
+    bonus column) — the emitted stream is bit-identical to target-only
+    greedy decode. Sampled lanes accept d_j with probability
+    min(1, p_j(d_j) / q_j(d_j)) over the SAME temperature/top-k/top-p
+    warping on both models, and on rejection sample from the
+    normalized residual max(0, p_j − q_j) — the emitted stream is an
+    exact sample from the target's warped distribution (speculative
+    sampling, Leviathan et al. 2023 Thm 1). Returns
+    (out (B, n_spec+1) emitted-token rows, counts (B,) valid lengths
+    (0 = lane inactive), feed, cache, draft_cache): row b's first
+    counts[b] columns are real tokens — counts[b]-1 accepted draft
+    tokens plus one correction/bonus token."""
+    B = feed.shape[0]
+    S1 = n_spec + 1
+    pos = cache["pos"]
+    rem = cache["remaining"]
+    active = rem > 0
+    # draft_cache None => SELF-drafting with a SHARED pool: the draft
+    # weights are the target weights, so verify's writes of
+    # [feed, d_1 .. d_S] are bit-identical to the draft's own — one
+    # pool serves both models, there is no draft-pool hole (verify
+    # writes d_S's KV at pos + n_spec itself), and the first draft
+    # pass needs no previous-token rewrite
+    shared = draft_cache is None
+    if shared:
+        dk, dv = cache["k"], cache["v"]
+        prev = None
+    else:
+        dk, dv = draft_cache["k"], draft_cache["v"]
+        prev = draft_cache["prev"]
+
+    if sampled:
+        # one split per round; per-use keys fold in their stage index —
+        # a lane's key chain depends only on its seed and round count,
+        # never on co-scheduling
+        carried, round_key = _split_slot_keys(cache["rng"])
+        fold = jax.vmap(jax.random.fold_in, in_axes=(0, None))
+        step_keys = [fold(round_key, j) for j in range(n_spec + 2)]
+    else:
+        carried = cache["rng"]
+
+    # n_spec sequential draft proposals, each writing its token's draft
+    # KV at pos + j before attending (write-then-gather keeps the
+    # just-written position visible to its own score). The FIRST pass
+    # is 2 wide: [prev @ pos-1, feed @ pos]. When the previous round
+    # accepted all n_spec proposals, the last draft token's KV was
+    # never written to the draft pool (the bonus came straight from the
+    # target) and its position is exactly pos - 1 — re-processing prev
+    # there fills the hole; on every other lane it's a bit-identical
+    # rewrite of a cell that was already correct. Fusing the patch into
+    # the proposal pass saves a whole draft dispatch per round.
+    tok = feed
+    draft_list = []
+    q_list = []
+    for j in range(n_spec):
+        if j == 0 and not shared:
+            lg, dk, dv = _forward_tokens_paged(
+                draft_params, dk, dv, jnp.stack([prev, tok], axis=1),
+                tables, jnp.maximum(pos - 1, 0), active, draft_cfg,
+                with_logits=True)
+        else:
+            lg, dk, dv = _forward_tokens_paged(
+                draft_params, dk, dv, tok[:, None], tables, pos + j, active,
+                draft_cfg, with_logits=True)
+        lg = lg[:, -1, :]
+        if sampled:
+            # one top-k/top-p warp serves BOTH the proposal draw and
+            # the acceptance q — the masked logits are the (warped)
+            # draft distribution, so sampling categorical over them is
+            # exactly sample_tokens' draw with the vocab sort done once
+            safe_t = jnp.where(temps > 0.0, temps, 1.0)
+            masked = _topk_topp_mask(lg / safe_t[:, None], top_ks, top_ps)
+            smp = jax.vmap(jax.random.categorical)(
+                step_keys[j], masked).astype(jnp.int32)
+            greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(temps > 0.0, smp, greedy)
+            q_list.append(jax.nn.softmax(masked, axis=-1))
+        else:
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        draft_list.append(nxt)
+        tok = nxt
+    draft_toks = jnp.stack(draft_list, axis=1)  # (B, n_spec)
+
+    if shared:
+        # verify continues from the draft-written pool: it rewrites the
+        # very same cells with the very same values (same weights, same
+        # tokens, same positions), so threading dk/dv through keeps the
+        # buffer donation chain unbroken instead of forking the pool
+        logits, tk, tv = _forward_tokens_paged(
+            params, dk, dv,
+            jnp.concatenate([feed[:, None], draft_toks], axis=1),
+            tables, pos, active, cfg, with_logits=True)
+    else:
+        logits, tk, tv = verify_step_slots_paged(
+            params, cache, feed, draft_toks, tables, cfg)
+
+    tgt_argmax = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, S1)
+    greedy_match = draft_toks == tgt_argmax[:, :n_spec]
+    if sampled:
+        safe_t = jnp.where(temps > 0.0, temps, 1.0)
+        flat = logits.reshape(B * S1, -1) / jnp.repeat(safe_t, S1)[:, None]
+        p = jax.nn.softmax(
+            _topk_topp_mask(flat, jnp.repeat(top_ks, S1),
+                            jnp.repeat(top_ps, S1)),
+            axis=-1).reshape(B, S1, -1)
+        q = jnp.stack(q_list, axis=1)  # (B, n_spec, V)
+        p_d = jnp.take_along_axis(
+            p[:, :n_spec], draft_toks[..., None], axis=-1)[..., 0]
+        q_d = jnp.take_along_axis(q, draft_toks[..., None], axis=-1)[..., 0]
+        u = jax.vmap(lambda kk: jax.random.uniform(kk, (n_spec,)))(
+            step_keys[n_spec])
+        # accept iff u < p(d)/q(d)  (q(d) > 0: d was sampled from q)
+        samp_accept = u * jnp.maximum(q_d, 1e-20) < p_d
+        accept = jnp.where(temps[:, None] > 0.0, samp_accept, greedy_match)
+    else:
+        accept = greedy_match
+    n_acc = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)  # (B,)
+
+    next_g = jnp.take_along_axis(tgt_argmax, n_acc[:, None], axis=1)[:, 0]
+    if sampled:
+        # residual distribution at the rejection column: max(0, p − q),
+        # with q := 0 at the bonus column (pure target sample there)
+        p_at = jnp.take_along_axis(p, n_acc[:, None, None], axis=1)[:, 0]
+        q_pad = jnp.concatenate([q, jnp.zeros_like(q[:, :1])], axis=1)
+        q_at = jnp.take_along_axis(q_pad, n_acc[:, None, None], axis=1)[:, 0]
+        resid = jnp.maximum(p_at - q_at, 0.0)
+        # a rejection guarantees residual mass (p(d) < q(d) somewhere
+        # => p > q elsewhere); the fallback only covers f32 underflow
+        resid = jnp.where(resid.sum(-1, keepdims=True) > 0, resid, p_at)
+        next_s = jax.vmap(jax.random.categorical)(
+            step_keys[n_spec + 1],
+            jnp.where(resid > 0, jnp.log(resid), -jnp.inf),
+        ).astype(jnp.int32)
+        nxt = jnp.where(temps > 0.0, next_s, next_g)
+    else:
+        nxt = next_g
+
+    # emitted row: the accepted draft prefix, then the correction (or
+    # bonus) token at column n_acc; columns past it are garbage the
+    # host never reads (counts says where the row ends)
+    cols = jnp.arange(S1, dtype=jnp.int32)[None, :]
+    d_pad = jnp.concatenate([draft_toks, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    out = jnp.where(cols < n_acc[:, None], d_pad,
+                    jnp.where(cols == n_acc[:, None], nxt[:, None], 0))
+    m = n_acc + 1  # emitted tokens this round
+    stop_hit = jnp.any(
+        (out[:, :, None] == stop_ids[:, None, :])
+        & (cols < m[:, None])[:, :, None],
+        axis=(1, 2),
+    ) & active
+    new_cache = {
+        "k": tk,
+        "v": tv,
+        "pos": pos + jnp.where(active, m, 0),
+        "remaining": jnp.where(
+            active, jnp.where(stop_hit, 0, jnp.maximum(rem - m, 0)), rem),
+        "rng": carried,
+    }
+    if shared:
+        new_draft = None
+    else:
+        # the token now sitting at (new pos) - 1: the last accepted
+        # draft token, or the old feed when nothing was accepted — next
+        # round's first draft pass re-processes it (hole-fill /
+        # idempotent rewrite)
+        last_acc = jnp.take_along_axis(
+            out, jnp.maximum(n_acc - 1, 0)[:, None], axis=1)[:, 0]
+        new_draft = {
+            "k": dk,
+            "v": dv,
+            "prev": jnp.where(active,
+                              jnp.where(n_acc > 0, last_acc, feed), prev),
+        }
+    counts = jnp.where(active, m, 0)
+    return out, counts, jnp.where(active, nxt, feed), new_cache, new_draft
+
+
+def macro_step_slots_spec(params, draft_params, cache, draft_cache, feed,
+                          steps, has_admit, prompts, lengths, starts, slots,
+                          rems, seeds, tables, temps, top_ks, top_ps,
+                          stop_ids, chunk: int, n_spec: int, cfg: LlamaConfig,
+                          draft_cfg: LlamaConfig, sampled: bool = True):
+    """Speculative macro-step: the macro_step_slots_paged plan shape
+    where each of the up-to-`chunk` per-phase steps is a SPECULATIVE
+    ROUND (draft proposals + one target verification) instead of one
+    decode step — still ONE jitted dispatch, and the THIRD static
+    program family beside the PR-7 greedy/sampled pair (non-speculative
+    deployments never trace this function, so they pay zero draft
+    FLOPs). Admissions prefill BOTH pools: the target admission is the
+    stock admit_slots_paged; the draft pool mirrors the same suffix
+    through the same block tables, and the slot's tracked previous
+    token is reset. Returns (toks (K, chunk, B, n_spec+1),
+    counts (K, chunk, B), firsts (K, A), feed, cache, draft_cache) —
+    counts[k, t, b] is the number of real tokens in toks[k, t, b] (0
+    for skipped phases and inactive lanes); the host's plan-and-repair
+    loop reconciles its round ESTIMATES against these observed
+    accepted lengths."""
+    A = prompts.shape[1]
+    B = feed.shape[0]
+    S1 = n_spec + 1
+
+    def phase(carry, xs):
+        cache, draft_cache, feed = carry
+        (steps_k, admit_k, prompts_k, lengths_k, starts_k, slots_k, rems_k,
+         seeds_k, tables_k, temps_k, topk_k, topp_k, stop_k) = xs
+
+        def do_admit(op):
+            c, dc, fd = op
+            first, c, fd = admit_slots_paged(
+                params, prompts_k, lengths_k, starts_k, slots_k, rems_k,
+                seeds_k, c, fd, tables_k, temps_k, topk_k, topp_k, stop_k,
+                cfg, sampled=sampled,
+            )
+            if dc is None:
+                # shared-pool self-drafting: the target admission IS the
+                # draft admission — no mirror prefill, no bookkeeping
+                return first, c, None, fd
+            _, dk2, dv2 = _forward_tokens_paged(
+                draft_params, dc["k"], dc["v"], prompts_k,
+                tables_k[slots_k], starts_k, lengths_k > 0, draft_cfg,
+                with_logits=False,
+            )
+            # seed the slot's previous token with the last prompt token
+            # (position pos - 1, whose draft KV the mirror prefill just
+            # wrote — the first round's 2-wide pass rewrites it
+            # idempotently). Plan-padding rows route to index B and the
+            # scatter drops them, so a real admission is never clobbered.
+            last = jnp.take_along_axis(
+                prompts_k, jnp.maximum(lengths_k - 1, 0)[:, None],
+                axis=1)[:, 0]
+            prev = dc["prev"].at[
+                jnp.where(lengths_k > 0, slots_k, B)
+            ].set(last, mode="drop")
+            return first, c, {"k": dk2, "v": dv2, "prev": prev}, fd
+
+        def no_admit(op):
+            c, dc, fd = op
+            return jnp.zeros((A,), jnp.int32), c, dc, fd
+
+        first, cache, draft_cache, feed = jax.lax.cond(
+            admit_k, do_admit, no_admit, (cache, draft_cache, feed))
+
+        def step(c, t):
+            def run(op):
+                cc, dc, fd = op
+                out, counts, fd, cc, dc = spec_round_slots_paged(
+                    params, draft_params, cc, dc, fd, tables_k, temps_k,
+                    topk_k, topp_k, stop_k, n_spec, cfg, draft_cfg,
+                    sampled=sampled,
+                )
+                return (cc, dc, fd), (out, counts)
+
+            def skip(op):
+                return op, (jnp.zeros((B, S1), jnp.int32),
+                            jnp.zeros((B,), jnp.int32))
+
+            return jax.lax.cond(t < steps_k, run, skip, c)
+
+        (cache, draft_cache, feed), (toks, counts) = jax.lax.scan(
+            step, (cache, draft_cache, feed), jnp.arange(chunk))
+        return (cache, draft_cache, feed), (toks, counts, first)
+
+    (cache, draft_cache, feed), (toks, counts, firsts) = jax.lax.scan(
+        phase, (cache, draft_cache, feed),
+        (steps, has_admit, prompts, lengths, starts, slots, rems, seeds,
+         tables, temps, top_ks, top_ps, stop_ids),
+    )
+    return toks, counts, firsts, feed, cache, draft_cache
+
+
 @functools.lru_cache(maxsize=64)
 def _jitted_prefill(cfg: LlamaConfig):
     return jax.jit(functools.partial(prefill, cfg=cfg))
@@ -883,6 +1298,20 @@ def jitted_macro_step_slots_paged(cfg: LlamaConfig, chunk: int,
         functools.partial(macro_step_slots_paged, chunk=chunk, cfg=cfg,
                           sampled=sampled),
         donate_argnums=(1,),
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def jitted_macro_step_slots_spec(cfg: LlamaConfig, draft_cfg: LlamaConfig,
+                                 chunk: int, n_spec: int,
+                                 sampled: bool = True):
+    """The speculative macro program — the THIRD static variant family
+    beside the greedy/sampled pair. Keyed on (cfg, draft_cfg, chunk,
+    n_spec, sampled); both KV pools are donated."""
+    return jax.jit(
+        functools.partial(macro_step_slots_spec, chunk=chunk, n_spec=n_spec,
+                          cfg=cfg, draft_cfg=draft_cfg, sampled=sampled),
+        donate_argnums=(2, 3),
     )
 
 

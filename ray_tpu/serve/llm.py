@@ -81,7 +81,8 @@ class _LLMServer:
                  continuous: bool = False, n_slots: int = 8, chunk: int = 8,
                  macro_phases: int = 8, paged: Optional[bool] = None,
                  block_size: int = 16, n_blocks: int = 0,
-                 prefix_cache: bool = True, max_queue: Optional[int] = None):
+                 prefix_cache: bool = True, max_queue: Optional[int] = None,
+                 draft_model=None, num_speculative_tokens: int = 0):
         import jax
 
         from ray_tpu.models import llama
@@ -118,6 +119,12 @@ class _LLMServer:
                 macro_phases=macro_phases, paged=paged,
                 block_size=block_size, n_blocks=n_blocks,
                 prefix_cache=prefix_cache, max_queue=max_queue,
+                # lossless draft-model speculation: draft_model is None
+                # (off — the engine compiles the exact pre-speculation
+                # program), "self", a LlamaConfig, or a dict with cfg +
+                # params/checkpoint_dir/seed (see _internal/speculative)
+                draft_model=draft_model,
+                num_speculative_tokens=num_speculative_tokens,
                 # pid-unique name: each replica's engine publishes its
                 # own `engine:<name>` telemetry entry, so /api/serve
                 # shows PER-REPLICA serving metrics (same-named engines
@@ -206,7 +213,8 @@ def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
                    chunk: int = 8, macro_phases: int = 8,
                    paged: Optional[bool] = None, block_size: int = 16,
                    n_blocks: int = 0, prefix_cache: bool = True,
-                   max_queue: Optional[int] = None,
+                   max_queue: Optional[int] = None, draft_model=None,
+                   num_speculative_tokens: int = 0,
                    **deploy_kw):
     """A ready-to-run LLM generation application:
 
@@ -222,6 +230,22 @@ def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
     `max_queue` bounds admission (excess requests shed with a typed
     retryable error instead of queueing unboundedly).
 
+    `draft_model` + `num_speculative_tokens` turn on LOSSLESS
+    draft-model speculative decoding (paged engine only): a small draft
+    model proposes num_speculative_tokens tokens per lane each round
+    and the target verifies them all in one batched dispatch, emitting
+    every accepted token plus one correction/bonus token. Greedy output
+    is bit-identical to non-speculative decoding and sampled output
+    draws from the exact same distribution — the knob trades draft
+    FLOPs for fewer target dispatches, it never changes results.
+    `draft_model` accepts "self" (the target drafts for itself — only
+    useful for testing), "self:N" (self-speculative truncation: the
+    target's own first N layers draft, zero extra weights), a
+    LlamaConfig (random init), or a dict of
+    {"cfg": LlamaConfig, "checkpoint_dir"/"params"/"seed": ...}. With
+    draft_model=None the replica compiles a program with zero draft
+    FLOPs — speculation off costs nothing.
+
     Generation is side-effect-free, so the deployment opts into
     replica-death REDISPATCH by default: a request in flight on a
     SIGKILLed/wedged replica (from which no output can have escaped —
@@ -235,4 +259,6 @@ def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
                     checkpoint_dir=checkpoint_dir, continuous=continuous,
                     n_slots=n_slots, chunk=chunk, macro_phases=macro_phases,
                     paged=paged, block_size=block_size, n_blocks=n_blocks,
-                    prefix_cache=prefix_cache, max_queue=max_queue)
+                    prefix_cache=prefix_cache, max_queue=max_queue,
+                    draft_model=draft_model,
+                    num_speculative_tokens=num_speculative_tokens)

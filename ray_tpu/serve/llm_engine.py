@@ -23,7 +23,8 @@ engine to wrap, so this is the green-field TPU-native equivalent
   a stop, and the host repairs its plan when the resolved tokens
   reveal it — truncating delivery at the stop, freeing the slot and
   its blocks at the next plan boundary, and billing the discarded
-  planned steps as `speculative_waste_pct`. Block reuse under
+  planned steps as `plan_repair_waste_pct` (alias
+  `speculative_waste_pct`). Block reuse under
   speculation is safe by construction: tables are PER-DISPATCH host
   plans, so a zombie lane (stopped or cancelled but still riding
   already-planned phases) only ever writes blocks it owned at dispatch
@@ -206,7 +207,8 @@ class _LatencyHist:
 class _Request:
     __slots__ = ("prompt", "max_new_tokens", "tokens", "done", "error",
                  "exc", "on_done", "sampling", "finish_reason", "_first_dev",
-                 "_remaining", "_t_submit", "_t_first", "_t_done",
+                 "_remaining", "_rounds_est", "_rounds_inflight",
+                 "_t_submit", "_t_first", "_t_done",
                  "_trace_ctx", "_start", "_blocks", "_blocks_freed",
                  "_done_lock", "rid")
 
@@ -242,6 +244,11 @@ class _Request:
         self.exc: Optional[BaseException] = None
         self._first_dev = None   # device scalar: prefill's first token (legacy path)
         self._remaining = 0      # host-side plan counter (decode steps owed)
+        # speculative mode: acceptance is data-dependent, so the planner
+        # schedules verify ROUNDS from an estimate instead of exact
+        # steps — rounds still plannable / already dispatched-unresolved
+        self._rounds_est = 0
+        self._rounds_inflight = 0
         self._t_submit = time.perf_counter()
         self._t_first: Optional[float] = None
         self._t_done: Optional[float] = None
@@ -291,7 +298,8 @@ class ContinuousBatchingEngine:
                  chunk: int = 8, macro_phases: int = 8, name: str = "default",
                  paged: bool = False, block_size: int = 16,
                  n_blocks: int = 0, prefix_cache: bool = True,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None, draft_model=None,
+                 num_speculative_tokens: int = 0):
         import jax
 
         from ray_tpu.models import llama_decode as D
@@ -335,6 +343,47 @@ class ContinuousBatchingEngine:
                 cfg, chunk, sampled=False)
         else:
             self.cache = D.init_slot_cache(cfg, n_slots, self.max_len)
+        # draft-model speculative decoding (paged-only): the spec macro
+        # program is a THIRD static variant family beside the PR-7
+        # greedy/sampled pair — with speculation off these attributes
+        # stay None and the engine never traces a program containing a
+        # single draft parameter (lint-enforced)
+        self.n_spec = int(num_speculative_tokens)
+        self.draft_params = None
+        self.draft_cfg = None
+        self.draft_cache = None
+        if draft_model is not None:
+            if not self.paged:
+                raise ValueError(
+                    "speculative decoding requires the paged engine "
+                    "(paged=True)")
+            if self.n_spec < 1:
+                raise ValueError(
+                    "draft_model requires num_speculative_tokens >= 1, "
+                    f"got {self.n_spec}")
+            from ray_tpu.serve._internal.speculative import resolve_draft_model
+
+            self.draft_params, self.draft_cfg = resolve_draft_model(
+                draft_model, params, cfg)
+            if self.draft_params is params:
+                # "self"-drafting: draft weights ARE the target weights,
+                # so draft and verify writes are bit-identical and ONE
+                # pool serves both models — draft_cache stays None (the
+                # kernels' shared-pool mode): no mirror prefill at
+                # admission, no second pool's memory, no hole tracking
+                self.draft_cache = None
+            else:
+                # the draft pool mirrors the target's block geometry:
+                # one host allocator plan addresses both pools
+                self.draft_cache = D.init_spec_cache(
+                    self.draft_cfg, n_slots, self.n_blocks, block_size)
+            # acceptance EMA feeding the round planner: start optimistic
+            # (full acceptance) so the first plans don't over-schedule —
+            # resyncs against observed accepted lengths at resolution
+            self._accept_ema = float(self.n_spec + 1)
+        elif self.n_spec > 0:
+            raise ValueError(
+                "num_speculative_tokens > 0 requires a draft_model")
         # memoized per (cfg, chunk): same-geometry engines share one jit
         # wrapper, so engine construction never recompiles warm programs
         self._prefill_slots = D.jitted_prefill_into_slots(cfg)
@@ -367,7 +416,9 @@ class ContinuousBatchingEngine:
                    "useful_slot_steps": 0, "wasted_steps": 0,
                    "prefill_tokens": 0, "reused_prefix_tokens": 0,
                    "kv_blocks_peak_in_use": 0, "shed_queue_full": 0,
-                   "shed_eta": 0, "deadline_expired": 0}
+                   "shed_eta": 0, "deadline_expired": 0,
+                   "spec_verify_rounds": 0, "draft_proposed_tokens": 0,
+                   "draft_accepted_tokens": 0}
         shared = _engine_metrics()
         self._tags = {"engine": name}
         self._ttft = _LatencyHist(_TTFT_BOUNDS, shared["ttft"], self._tags)
@@ -526,6 +577,13 @@ class ContinuousBatchingEngine:
         self._running = False
         self._wake.set()
         self._thread.join(timeout=10)
+        if self._dead is None and not self._thread.is_alive():
+            # final drain: the loop can exit between the _resolve that
+            # completed a request and the _repair that frees its slot
+            # and KV blocks (the ONLY freeing path in spec mode, which
+            # never evicts at plan time) — run it here, single-threaded
+            # now, so shutdown leaves allocator refs == radix-cache refs
+            self._repair()
 
     def load(self) -> int:
         """Resident + queued request count — the autoscaling load
@@ -554,10 +612,26 @@ class ContinuousBatchingEngine:
         )
         # plan-and-repair bill: % of PLANNED useful steps whose tokens
         # were discarded (early stop / cancellation revealed after the
-        # speculative plan shipped)
-        m["speculative_waste_pct"] = round(
+        # speculative plan shipped). Historically named
+        # speculative_waste_pct — kept as an alias now that draft-model
+        # speculation has its own, distinct rejection metric below.
+        m["plan_repair_waste_pct"] = round(
             100.0 * m["wasted_steps"] / max(1, m["useful_slot_steps"]), 2
         )
+        m["speculative_waste_pct"] = m["plan_repair_waste_pct"]
+        # draft-model speculation ledger: % of proposed draft tokens the
+        # target rejected, and the headline win — verified tokens per
+        # verify round (= accepted drafts + the correction/bonus token;
+        # 1.0 would mean speculation is buying nothing)
+        proposed = m["draft_proposed_tokens"]
+        m["draft_rejection_pct"] = round(
+            100.0 * (proposed - m["draft_accepted_tokens"]) / max(1, proposed),
+            2,
+        )
+        rounds = m["spec_verify_rounds"]
+        m["accepted_tokens_per_dispatch"] = round(
+            (m["draft_accepted_tokens"] + rounds) / rounds, 3
+        ) if rounds else 0.0
         # admission-control ledger: total sheds + the ETA estimate the
         # next admission would be judged against
         m["shed_requests"] = m["shed_queue_full"] + m["shed_eta"]
@@ -707,6 +781,8 @@ class ContinuousBatchingEngine:
         bookkeeping to the post-macro-step state: slot assignments,
         per-request remaining counters, evictions, block
         allocations/frees."""
+        if self.draft_params is not None:
+            return self._plan_spec()
         phases = []
         while len(phases) < self.macro_phases:
             admissions = []
@@ -738,6 +814,65 @@ class ContinuousBatchingEngine:
                 if r is not None and r._remaining == 0:
                     self._slots[s] = None  # evict: freed for the next phase
                     self._free_request_blocks(r)
+            phases.append({"steps": steps, "admissions": admissions,
+                           "takes": takes, **snapshot})
+        return phases or None
+
+    def _rounds_for(self, tokens_owed: int) -> int:
+        """Verify rounds expected to cover `tokens_owed` tokens, from
+        the acceptance EMA (clamped to [1, n_spec + 1] tokens/round)."""
+        e = min(max(self._accept_ema, 1.0), float(self.n_spec + 1))
+        return max(1, int(np.ceil(tokens_owed / e)))
+
+    def _plan_spec(self) -> Optional[List[Dict[str, Any]]]:
+        """Speculative plan: phases of verify ROUNDS instead of decode
+        steps. Acceptance is data-dependent, so per-request round counts
+        are ESTIMATES from the acceptance EMA (resynced at resolution
+        against observed accepted lengths) — and, critically, slots are
+        NEVER evicted at plan time: an estimate saying a request is done
+        is not the request being done, and freeing its blocks while a
+        live device lane still writes them would hand corrupted blocks
+        to the next admission. Eviction happens only in _repair(), after
+        delivery confirms completion. A lane that finishes earlier than
+        estimated rides its planned rounds emitting zero-count rows (the
+        device zeroed its `remaining`); a lane that finishes later gets
+        more rounds planned after the resync."""
+        phases = []
+        while len(phases) < self.macro_phases:
+            admissions = []
+            free = [i for i, r in enumerate(self._slots) if r is None]
+            while free and self._waiting:
+                req = self._waiting[0]
+                if not self._try_admit_paged(req):
+                    break  # pool exhausted: stays queued, FIFO order kept
+                self._waiting.popleft()
+                slot = free.pop(0)
+                req._remaining = req.max_new_tokens - 1
+                req._rounds_est = self._rounds_for(req._remaining) \
+                    if req._remaining > 0 else 0
+                req._rounds_inflight = 0
+                self._slots[slot] = req
+                admissions.append((slot, req))
+            live = [(s, r) for s, r in enumerate(self._slots)
+                    if r is not None]
+            owing = [r._rounds_est for _, r in live if r._rounds_est > 0]
+            if not owing and not admissions:
+                break
+            snapshot = self._snapshot_phase()
+            steps = min([self.chunk] + owing) if owing else 0
+            takes = []
+            if steps > 0:
+                # EVERY occupied slot rides the phase, not just the ones
+                # the estimate says owe rounds: the device advances every
+                # active lane each round regardless of the plan, so a
+                # slot missing from `takes` would have its counts dropped
+                # on the floor — lost tokens, then a device lane whose
+                # `remaining` hits zero while the host still waits. Lanes
+                # the estimate got right just emit zero-count rows.
+                for s, r in live:
+                    r._rounds_est = max(0, r._rounds_est - steps)
+                    r._rounds_inflight += steps
+                    takes.append((s, r, steps))
             phases.append({"steps": steps, "admissions": admissions,
                            "takes": takes, **snapshot})
         return phases or None
@@ -828,6 +963,37 @@ class ContinuousBatchingEngine:
                     top_ks[k] = ph["top_ks"]
                     top_ps[k] = ph["top_ps"]
                     stops[k] = ph["stops"]
+                if self.draft_params is not None:
+                    # third static variant family: the speculative macro
+                    # program (drafts + batched verification per round)
+                    self._macro_paged_fn = self._D.jitted_macro_step_slots_spec(
+                        self.cfg, self.draft_cfg, self.chunk, self.n_spec,
+                        sampled=plan_sampled)
+                    (toks_dev, counts_dev, firsts_dev, self._next_dev,
+                     self.cache, self.draft_cache) = self._macro_paged_fn(
+                        self.params, self.draft_params, self.cache,
+                        self.draft_cache, self._next_dev,
+                        jnp.asarray(steps), jnp.asarray(has_admit),
+                        jnp.asarray(prompts), jnp.asarray(lengths),
+                        jnp.asarray(starts), jnp.asarray(slots),
+                        jnp.asarray(rems), jnp.asarray(seeds),
+                        jnp.asarray(tables), jnp.asarray(temps),
+                        jnp.asarray(top_ks), jnp.asarray(top_ps),
+                        jnp.asarray(stops),
+                    )
+                    self._record_dispatch(
+                        t0, time.perf_counter(), self._macro_paged_fn,
+                        [r for p in phases for _, r in p["admissions"]]
+                        + [r for p in phases for _, r, _ in p["takes"]],
+                    )
+                    self._m["dispatches"] += 1
+                    for ph in phases:
+                        self._m["slot_steps"] += ph["steps"] * self.n_slots
+                        self._m["useful_slot_steps"] += sum(
+                            t for _, _, t in ph["takes"])
+                    self._pending.append(
+                        ("spec", (toks_dev, counts_dev), firsts_dev, phases))
+                    return
                 toks_dev, firsts_dev, self._next_dev, self.cache = (
                     self._macro_paged_fn(
                         self.params, self.cache, self._next_dev,
@@ -918,9 +1084,19 @@ class ContinuousBatchingEngine:
             phases = self._plan()
             if phases:
                 self._dispatch_macro(phases)
-            # fetch one macro-step BEHIND: overlaps the one just dispatched
-            while len(self._pending) > 1:
+                # fetch one macro-step BEHIND: overlaps the one just
+                # dispatched
+                while len(self._pending) > 1:
+                    self._resolve(self._pending.popleft())
+            elif self._pending:
+                # nothing plannable until in-flight results land (spec
+                # mode: every resident lane's round estimate is spent) —
+                # resolve the frontier NOW so the acceptance resync can
+                # unblock the next plan instead of spinning
                 self._resolve(self._pending.popleft())
+            else:
+                self._wake.wait(timeout=0.01)
+                self._wake.clear()
 
     # ---- legacy per-chunk path (macro_phases=0): kept for A/B tests ----
     def _admit(self) -> None:
@@ -1120,6 +1296,49 @@ class ContinuousBatchingEngine:
             raise
 
     def _resolve_inner(self, entry) -> None:
+        if entry[0] == "spec":
+            _, toks_counts, firsts_dev, phases = entry
+            toks_dev, counts_dev = toks_counts
+            toks = np.asarray(toks_dev)      # (K, chunk, B, n_spec + 1)
+            counts = np.asarray(counts_dev)  # (K, chunk, B)
+            firsts = np.asarray(firsts_dev)
+            for k, ph in enumerate(phases):
+                for a, (_slot, req) in enumerate(ph["admissions"]):
+                    self._deliver(req, [int(firsts[k, a])])
+                for slot, req, take in ph["takes"]:
+                    req._rounds_inflight = max(0, req._rounds_inflight - take)
+                    for t in range(take):
+                        c = int(counts[k, t, slot])
+                        if c == 0:
+                            # the device lane went inactive before this
+                            # planned round — the spec-mode shape of a
+                            # plan overrun
+                            continue
+                        self._m["spec_verify_rounds"] += 1
+                        self._m["draft_proposed_tokens"] += self.n_spec
+                        self._m["draft_accepted_tokens"] += c - 1
+                        self._accept_ema = 0.9 * self._accept_ema + 0.1 * c
+                        row = [int(x) for x in toks[k, t, slot, :c]]
+                        if not req.done.is_set():
+                            # a round can overshoot the request's token
+                            # budget (it emits up to n_spec + 1 at once):
+                            # cap delivery at what's owed and bill the
+                            # excess as plan-repair waste
+                            owed = req.max_new_tokens - len(req.tokens)
+                            if c > owed:
+                                self._m["wasted_steps"] += c - owed
+                                row = row[:owed]
+                        self._deliver(req, row)
+                    if not req.done.is_set():
+                        # resync the planner's round estimate to observed
+                        # progress (the EMA moved, and the estimate this
+                        # plan was built from is now stale)
+                        owed = req.max_new_tokens - len(req.tokens)
+                        est = self._rounds_for(owed) - req._rounds_inflight
+                        if req._rounds_inflight <= 0:
+                            est = max(1, est)
+                        req._rounds_est = max(0, est)
+            return
         if entry[0] == "macro":
             _, toks_dev, firsts_dev, phases = entry
             toks = np.asarray(toks_dev)
@@ -1158,8 +1377,8 @@ class ContinuousBatchingEngine:
         self._dead = msg
         doomed = set()
         for entry in self._pending:
-            if entry[0] == "macro":
-                for ph in entry[3]:
+            if entry[0] in ("macro", "spec"):
+                for ph in entry[-1]:
                     doomed.update(r for _, r in ph["admissions"])
                     doomed.update(r for _, r, _ in ph["takes"])
             else:

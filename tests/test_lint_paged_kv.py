@@ -8,10 +8,13 @@ paged shapes can't collide: max_len=40 is NOT a multiple of
 block_size=16, so the per-layer gather workspace is (n_slots, 48, ...),
 never (n_slots, 40, ...).
 
-Plus the allocator block-leak audit companion (the engine-level one —
-the pure-allocator audit lives in test_paged_kv.py): a real engine
-serving a mixed admit/evict/prefix-hit/stop workload must return every
-non-cache block reference by the time the requests finish.
+Plus two companions: the zero-draft-FLOPs lint (speculation off must
+compile a program bit-identical to a draft-free build — the spec macro
+is a third static variant family, never a runtime branch) and the
+engine-level allocator block-leak audit (the pure-allocator audit
+lives in test_paged_kv.py): a real engine serving a mixed
+admit/evict/prefix-hit/stop workload must return every non-cache block
+reference by the time the requests finish.
 """
 import numpy as np
 
@@ -143,6 +146,106 @@ def test_greedy_variant_has_no_sampling_pipeline():
         sorted(greedy)
     sampled = prims(sampled=True)
     assert any("sort" in n for n in sampled)
+
+
+def test_non_speculative_program_has_zero_draft_flops():
+    """Speculation OFF must be FREE: the spec macro program is a third
+    static variant family, so a deployment that never sets draft_model
+    traces a program containing zero draft parameters and zero draft
+    FLOPs — bit-identical to a build that has never heard of drafts.
+    Marker: a draft config with widths (d_model=96, d_ff=192) that no
+    target-side shape can produce; the spec jaxpr must carry dim-96
+    avals (proving the marker detects draft compute) and the non-spec
+    jaxpr must not, before OR after the spec program is traced."""
+    import dataclasses
+
+    from ray_tpu.models import llama, llama_decode as D
+    from ray_tpu.serve._internal.speculative import resolve_draft_model
+
+    cfg, params = _cfg_params()
+    N_SPEC = 2
+
+    def paged_jaxpr():
+        cache = D.init_paged_cache(cfg, N_SLOTS, N_BLOCKS, BLOCK)
+        args = (
+            params, cache, jnp.zeros(N_SLOTS, jnp.int32),
+            jnp.zeros(K_PHASES, jnp.int32), jnp.zeros(K_PHASES, bool),
+            jnp.zeros((K_PHASES, A_ROWS, P_WIDTH), jnp.int32),
+            jnp.zeros((K_PHASES, A_ROWS), jnp.int32),
+            jnp.zeros((K_PHASES, A_ROWS), jnp.int32),
+            jnp.zeros((K_PHASES, A_ROWS), jnp.int32),
+            jnp.zeros((K_PHASES, A_ROWS), jnp.int32),
+            jnp.zeros((K_PHASES, A_ROWS), jnp.uint32),
+            jnp.zeros((K_PHASES, N_SLOTS, MB), jnp.int32),
+            jnp.zeros((K_PHASES, N_SLOTS), jnp.float32),
+            jnp.zeros((K_PHASES, N_SLOTS), jnp.int32),
+            jnp.ones((K_PHASES, N_SLOTS), jnp.float32),
+            jnp.full((K_PHASES, N_SLOTS, NS), -1, jnp.int32),
+        )
+        return jax.make_jaxpr(
+            lambda *a: D.macro_step_slots_paged(*a, chunk=CHUNK, cfg=cfg)
+        )(*args)
+
+    def dims(jaxpr):
+        out = set()
+        for aval in _walk_avals(jaxpr.jaxpr):
+            out.update(tuple(getattr(aval, "shape", ())))
+        return out
+
+    before = paged_jaxpr()
+    assert 96 not in dims(before) and 192 not in dims(before)
+    before_str = str(before)
+
+    # trace the speculative variant with the uniquely-dimensioned draft
+    draft_cfg = dataclasses.replace(cfg, d_model=96, d_ff=192)
+    draft_params, draft_cfg = resolve_draft_model(
+        {"cfg": draft_cfg}, params, cfg)
+    cache = D.init_paged_cache(cfg, N_SLOTS, N_BLOCKS, BLOCK)
+    draft_cache = D.init_spec_cache(draft_cfg, N_SLOTS, N_BLOCKS, BLOCK)
+    spec_args = (
+        params, draft_params, cache, draft_cache,
+        jnp.zeros(N_SLOTS, jnp.int32),
+        jnp.zeros(K_PHASES, jnp.int32), jnp.zeros(K_PHASES, bool),
+        jnp.zeros((K_PHASES, A_ROWS, P_WIDTH), jnp.int32),
+        jnp.zeros((K_PHASES, A_ROWS), jnp.int32),
+        jnp.zeros((K_PHASES, A_ROWS), jnp.int32),
+        jnp.zeros((K_PHASES, A_ROWS), jnp.int32),
+        jnp.zeros((K_PHASES, A_ROWS), jnp.int32),
+        jnp.zeros((K_PHASES, A_ROWS), jnp.uint32),
+        jnp.zeros((K_PHASES, N_SLOTS, MB), jnp.int32),
+        jnp.zeros((K_PHASES, N_SLOTS), jnp.float32),
+        jnp.zeros((K_PHASES, N_SLOTS), jnp.int32),
+        jnp.ones((K_PHASES, N_SLOTS), jnp.float32),
+        jnp.full((K_PHASES, N_SLOTS, NS), -1, jnp.int32),
+    )
+    spec = jax.make_jaxpr(
+        lambda *a: D.macro_step_slots_spec(
+            *a, chunk=CHUNK, n_spec=N_SPEC, cfg=cfg, draft_cfg=draft_cfg)
+    )(*spec_args)
+    spec_dims = dims(spec)
+    assert 96 in spec_dims and 192 in spec_dims, sorted(spec_dims)
+
+    # re-tracing after the spec program exists changes NOTHING
+    after = paged_jaxpr()
+    assert 96 not in dims(after) and 192 not in dims(after)
+    assert str(after) == before_str, "spec tracing perturbed the non-spec program"
+
+    # engine level: a spec-off engine binds the SAME lru-cached greedy
+    # program object as a plain build — not a spec variant with inert
+    # knobs — and carries no draft state at all
+    eng = None
+    try:
+        from ray_tpu.serve.llm_engine import ContinuousBatchingEngine
+
+        eng = ContinuousBatchingEngine(
+            params, cfg, n_slots=N_SLOTS, chunk=CHUNK, macro_phases=2,
+            max_len=MAX_LEN, paged=True, block_size=BLOCK)
+        assert eng._macro_paged_fn is D.jitted_macro_step_slots_paged(
+            cfg, CHUNK, sampled=False)
+        assert eng.draft_params is None and eng.draft_cache is None
+    finally:
+        if eng is not None:
+            eng.shutdown()
 
 
 def test_engine_block_leak_audit_mixed_workload():

@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 
+@pytest.mark.slow  # minutes of env stepping: RL learning curves are not tier-1
 def test_td3_pendulum_improves():
     """TD3 improves Pendulum well past random (~-1200 avg return)."""
     from ray_tpu.rllib import TD3Config

@@ -144,6 +144,7 @@ def test_framestack_pipeline_end_to_end():
     algo.stop()
 
 
+@pytest.mark.slow  # minutes of env stepping: RL learning curves are not tier-1
 def test_conv_ppo_learns_minatar_breakout():
     """Conv-PPO on the pixel env: the policy must track the ball with
     the paddle (random play scores ~0.23; the bar is >2.0 — ~10x random,
