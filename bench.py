@@ -1768,12 +1768,157 @@ def bench_serve_fault(extra):
     _settle()
 
 
+def bench_serve_disagg(extra):
+    """Disaggregated prefill/decode A/B at FIXED aggregate chips
+    (ISSUE 18): (1) burst of long-prompt requests against a unified
+    2-replica deployment vs pools={prefill:1, decode:1} — in the
+    unified engines chunked prefill interleaves with decode macro-steps
+    so running decodes stall behind every admission (TPOT
+    interference); the pooled deployment isolates decode lanes behind
+    the KV-plane handoff. Reported per pool: engine p99 TTFT, p99
+    TPOT, and the migration p50/p99 the handoff added. (2) K-session
+    workload on a 2-prefill pool with the cluster prefix cache on vs
+    off — same sessions, same routing; ON lets a replica graft a peer's
+    prefix over the object plane instead of re-prefilling it, so the
+    aggregate request hit rate must beat the per-replica baseline."""
+    import ray_tpu
+
+    try:
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+        import jax.numpy as jnp
+
+        from ray_tpu import serve
+        from ray_tpu.models import llama
+        from ray_tpu.serve.llm import llm_deployment
+        from ray_tpu.serve.loadgen import (
+            Phase,
+            Workload,
+            aggregate_prefix_cache,
+            replica_metrics,
+            run_load,
+        )
+
+        cfg = llama.LlamaConfig.tiny(
+            dtype=jnp.float32, attn_impl="blockwise", remat=False
+        )
+
+        def _deploy(pools=None, n=2, cluster_cache=None, prefill_len=0):
+            app = llm_deployment(
+                num_replicas=n, continuous=True, n_slots=4, chunk=4,
+                macro_phases=2, block_size=8, max_new_tokens=8, cfg=cfg,
+                n_blocks=96, pools=pools, cluster_cache=cluster_cache,
+                digest_prefix_len=16,
+            )
+            h = serve.run(app, name="bench_disagg")
+            total = sum(pools.values()) if pools else n
+            warm = [h.remote([1, 2, 3 + i, 4 + i]) for i in range(4 * total)]
+            for r in warm:
+                r.result(timeout=300)
+            return h
+
+        def _pool_stats(pool):
+            """Max-per-pool engine percentiles from an exact replica
+            scrape (unified replicas have no pool label: pool=None
+            matches them all)."""
+            out = {}
+            for m in replica_metrics("bench_disagg", "LLMServer").values():
+                if pool is not None and m.get("pool") != pool:
+                    continue
+                for k in ("ttft_ms_p99", "tpot_ms_p99", "migration_ms_p50",
+                          "migration_ms_p99", "migrated_blocks_out",
+                          "migrated_blocks_in"):
+                    if m.get(k) is not None:  # empty hist publishes None
+                        out[k] = max(out.get(k, 0), m[k])
+            return out
+
+        # long prompts (4-6 prefill chunks each) at a burst rate that
+        # keeps admissions queued: the interference workload
+        def _burst_wl(seed):
+            return Workload(rate_hz=6.0, prompt_len=(16, 24),
+                            max_new_tokens=(6, 8), seed=seed)
+
+        dropped = 0
+        # ---- A: unified pool, 2 replicas ----------------------------
+        h = _deploy(n=2)
+        ru = run_load(h, _burst_wl(11), phases=[Phase("burst", 8.0)],
+                      request_timeout_s=120.0)
+        dropped += ru["total"]["dropped"]
+        su = _pool_stats(None)
+        serve.delete("bench_disagg")
+
+        # ---- B: disaggregated, SAME aggregate chips (1+1) -----------
+        h = _deploy(pools={"prefill": 1, "decode": 1})
+        rp = run_load(h, _burst_wl(11), phases=[Phase("burst", 8.0)],
+                      request_timeout_s=120.0)
+        dropped += rp["total"]["dropped"]
+        sp_pre = _pool_stats("prefill")
+        sp_dec = _pool_stats("decode")
+        serve.delete("bench_disagg")
+
+        extra["serve_disagg_ttft_ms_p99_unified"] = su.get("ttft_ms_p99", 0.0)
+        extra["serve_disagg_ttft_ms_p99_pooled"] = sp_pre.get("ttft_ms_p99", 0.0)
+        extra["serve_disagg_tpot_ms_p99_unified"] = su.get("tpot_ms_p99", 0.0)
+        extra["serve_disagg_tpot_ms_p99_pooled"] = sp_dec.get("tpot_ms_p99", 0.0)
+        extra["serve_disagg_migration_ms_p50"] = sp_dec.get("migration_ms_p50", 0.0)
+        extra["serve_disagg_migration_ms_p99"] = sp_dec.get("migration_ms_p99", 0.0)
+        extra["serve_disagg_migrated_blocks"] = sp_dec.get("migrated_blocks_in", 0)
+        extra["serve_disagg_latency_ms_p99_unified"] = ru["total"]["latency_ms_p99"]
+        extra["serve_disagg_latency_ms_p99_pooled"] = rp["total"]["latency_ms_p99"]
+        log(f"[bench] serve_disagg burst @2 chips: TTFT p99 "
+            f"{su.get('ttft_ms_p99', 0.0)}ms unified vs "
+            f"{sp_pre.get('ttft_ms_p99', 0.0)}ms pooled; TPOT p99 "
+            f"{su.get('tpot_ms_p99', 0.0)}ms unified vs "
+            f"{sp_dec.get('tpot_ms_p99', 0.0)}ms pooled; migration p50/p99 "
+            f"{sp_dec.get('migration_ms_p50', 0.0)}/"
+            f"{sp_dec.get('migration_ms_p99', 0.0)}ms, "
+            f"{sp_dec.get('migrated_blocks_in', 0)} blocks migrated")
+
+        # ---- cluster prefix cache A/B: 8 sessions over 2 prefill
+        # replicas; least-loaded routing bounces a session's requests
+        # between replicas, so every prefix eventually lands on both —
+        # OFF re-prefills it per replica, ON fetches it from the owner
+        def _session_wl(seed):
+            return Workload(rate_hz=8.0, prompt_len=(3, 6),
+                            max_new_tokens=(4, 6), session_prefixes=8,
+                            session_prefix_len=16, seed=seed)
+
+        hits = {}
+        for label, on in (("on", True), ("off", False)):
+            h = _deploy(pools={"prefill": 2, "decode": 1}, cluster_cache=on)
+            rs = run_load(h, _session_wl(7), phases=[Phase("steady", 8.0)],
+                          request_timeout_s=120.0)
+            dropped += rs["total"]["dropped"]
+            hits[label] = aggregate_prefix_cache(
+                replica_metrics("bench_disagg", "LLMServer"))
+            serve.delete("bench_disagg")
+        extra["serve_disagg_prefix_req_hit_cluster_on"] = hits["on"]["request_hit_rate"]
+        extra["serve_disagg_prefix_req_hit_cluster_off"] = hits["off"]["request_hit_rate"]
+        extra["serve_disagg_prefix_tok_hit_cluster_on"] = hits["on"]["hit_rate"]
+        extra["serve_disagg_prefix_tok_hit_cluster_off"] = hits["off"]["hit_rate"]
+        extra["serve_disagg_dropped"] = dropped
+        log(f"[bench] serve_disagg cluster cache: request hit rate "
+            f"{hits['on']['request_hit_rate']} on vs "
+            f"{hits['off']['request_hit_rate']} off (token-weighted "
+            f"{hits['on']['hit_rate']} vs {hits['off']['hit_rate']}, "
+            f"{dropped} dropped)")
+        serve.shutdown()
+    except Exception as e:
+        log(f"[bench] serve_disagg bench skipped: {e}")
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+    _settle()
+
+
 def main():
     extra = {}
     bench_runtime(extra)
     bench_dispatch(extra)
     bench_serve_scale(extra)
     bench_serve_fault(extra)
+    bench_serve_disagg(extra)
     bench_broadcast(extra)
     bench_data_pipeline(extra)
     bench_telemetry_overhead(extra)

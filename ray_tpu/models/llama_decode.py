@@ -490,6 +490,46 @@ def copy_kv_blocks(cache: Dict[str, Any], src, dst) -> Dict[str, Any]:
     return out
 
 
+def gather_kv_blocks(cache, blocks):
+    """Lift `blocks` (N,) i32 out of the pool as contiguous device
+    slices: -> (k (L, N, bs, kvh, hd), v (...)). The KV-plane export
+    kernel — a migrating request's blocks leave the pool as ONE pair of
+    arrays (the object plane ships them zero-copy), never block by
+    block. Callers bucket-pad `blocks` with the null block; its slices
+    are garbage the importer writes straight back into ITS null block."""
+    return cache["k"][:, blocks], cache["v"][:, blocks]
+
+
+def import_kv_blocks(cache, dst, k, v, slot, pos, remaining, rng):
+    """KV-plane import: scatter gathered slices into this pool's `dst`
+    (N,) i32 blocks and arm `slot` to resume decoding mid-stream at
+    absolute position `pos` with `remaining` tokens owed and the
+    request's carried rng key (2,) u32. dst's bucket-padding entries
+    are the null block — duplicate index-0 writes race only over which
+    garbage lands in the garbage block. One fused dispatch per
+    migration; the pool buffers are donated."""
+    out = dict(cache)
+    out["k"] = cache["k"].at[:, dst].set(k)
+    out["v"] = cache["v"].at[:, dst].set(v)
+    out["pos"] = cache["pos"].at[slot].set(pos)
+    out["remaining"] = cache["remaining"].at[slot].set(remaining)
+    out["rng"] = cache["rng"].at[slot].set(rng)
+    return out
+
+
+def scatter_kv_blocks(cache, dst, k, v):
+    """Prefix-import scatter: land fetched cluster-cache KV slices in
+    this pool's `dst` blocks WITHOUT arming any slot — the blocks go to
+    the radix prefix cache, not a resuming request, so pos/remaining/rng
+    stay untouched (a slot-armed variant would corrupt slot 0 for
+    imports that have no slot). dst's padding entries are the null
+    block."""
+    out = dict(cache)
+    out["k"] = cache["k"].at[:, dst].set(k)
+    out["v"] = cache["v"].at[:, dst].set(v)
+    return out
+
+
 def _split_slot_keys(keys):
     """(B, 2) u32 raw keys -> (carried (B, 2), subkeys (B, 2))."""
     pairs = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
@@ -1299,6 +1339,28 @@ def jitted_macro_step_slots_paged(cfg: LlamaConfig, chunk: int,
                           sampled=sampled),
         donate_argnums=(1,),
     )
+
+
+@functools.lru_cache(maxsize=4)
+def jitted_gather_kv_blocks():
+    """KV-plane export gather. Shape-polymorphic: jit re-specializes
+    per bucketed block count, so callers pad block-id arrays to
+    power-of-2 buckets (null-block padding) to bound the variant set."""
+    return jax.jit(gather_kv_blocks)
+
+
+@functools.lru_cache(maxsize=4)
+def jitted_import_kv_blocks():
+    """KV-plane import scatter; the pool is donated (the engine swaps
+    its cache handle for the return value)."""
+    return jax.jit(import_kv_blocks, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=4)
+def jitted_scatter_kv_blocks():
+    """Slot-less prefix-import scatter (cluster prefix cache); donated
+    pool, same bucketing discipline as the gather."""
+    return jax.jit(scatter_kv_blocks, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=16)

@@ -43,6 +43,23 @@ _CONFIG_KEYS = (
     "metrics_window_s",
     "upscale_smoothing_factor",
     "downscale_smoothing_factor",
+    "pools",
+)
+
+# disaggregated serving pool roles (serve/_internal/kv_plane.py) and the
+# per-pool autoscaling knobs each sub-config may carry. The two pools
+# scale on DIFFERENT signals — prefill on queued prompt tokens (arrival
+# burst pressure), decode on busy token-loop lanes (steady occupancy) —
+# so each role names its own target knob and naming the wrong one is a
+# config error, not a silent zero.
+_POOL_NAMES = ("prefill", "decode")
+_POOL_SUB_KEYS = (
+    "min_replicas",
+    "max_replicas",
+    "target_queued_prefill_tokens",
+    "target_decode_lanes",
+    "upscale_delay_s",
+    "downscale_delay_s",
 )
 
 
@@ -61,6 +78,13 @@ class AutoscalingConfig:
         gap closed per decision (1.0 = jump straight to desired).
     min_replicas may be 0 (scale-to-zero): handles then PARK incoming
         requests and nudge the controller, which scales back to 1.
+    pools: per-pool overrides for disaggregated deployments
+        (pool_config on the deployment): {"prefill": {...}, "decode":
+        {...}} where each sub-dict may set min/max_replicas, the
+        up/downscale delays, and the pool's OWN signal target —
+        target_queued_prefill_tokens for the prefill pool (scale on
+        admission backlog), target_decode_lanes for the decode pool
+        (scale on token-loop occupancy).
     """
 
     min_replicas: int = 1
@@ -72,6 +96,7 @@ class AutoscalingConfig:
     metrics_window_s: float = 3.0
     upscale_smoothing_factor: float = 1.0
     downscale_smoothing_factor: float = 1.0
+    pools: Optional[Dict[str, Any]] = None
 
     def __post_init__(self):
         if self.min_replicas < 0:
@@ -114,6 +139,63 @@ class AutoscalingConfig:
                     f"autoscaling_config: {knob} must be in (0, 1], got "
                     f"{getattr(self, knob)}"
                 )
+        if self.pools is not None:
+            self._validate_pools(self.pools)
+
+    @staticmethod
+    def _validate_pools(pools) -> None:
+        if not isinstance(pools, dict):
+            raise ValueError(
+                f"autoscaling_config: pools must be a dict, got "
+                f"{type(pools).__name__}"
+            )
+        unknown_pools = set(pools) - set(_POOL_NAMES)
+        if unknown_pools:
+            raise ValueError(
+                f"autoscaling_config: unknown pool(s) "
+                f"{sorted(unknown_pools)}; valid pools: "
+                f"{sorted(_POOL_NAMES)}"
+            )
+        for role, sub in pools.items():
+            if not isinstance(sub, dict):
+                raise ValueError(
+                    f"autoscaling_config: pools[{role!r}] must be a dict, "
+                    f"got {type(sub).__name__}"
+                )
+            unknown = set(sub) - set(_POOL_SUB_KEYS)
+            if unknown:
+                raise ValueError(
+                    f"autoscaling_config: pools[{role!r}]: unknown key(s) "
+                    f"{sorted(unknown)}; valid keys: {sorted(_POOL_SUB_KEYS)}"
+                )
+            if role == "prefill" and "target_decode_lanes" in sub:
+                raise ValueError(
+                    "autoscaling_config: pools['prefill'] scales on "
+                    "target_queued_prefill_tokens, not target_decode_lanes"
+                )
+            if role == "decode" and "target_queued_prefill_tokens" in sub:
+                raise ValueError(
+                    "autoscaling_config: pools['decode'] scales on "
+                    "target_decode_lanes, not target_queued_prefill_tokens"
+                )
+            for knob in ("target_queued_prefill_tokens", "target_decode_lanes"):
+                if knob in sub and float(sub[knob]) <= 0:
+                    raise ValueError(
+                        f"autoscaling_config: pools[{role!r}].{knob} must "
+                        f"be positive, got {sub[knob]}"
+                    )
+            for knob in ("min_replicas", "max_replicas"):
+                if knob in sub and int(sub[knob]) < 0:
+                    raise ValueError(
+                        f"autoscaling_config: pools[{role!r}].{knob} must "
+                        f"be >= 0, got {sub[knob]}"
+                    )
+            for knob in ("upscale_delay_s", "downscale_delay_s"):
+                if knob in sub and float(sub[knob]) < 0:
+                    raise ValueError(
+                        f"autoscaling_config: pools[{role!r}].{knob} must "
+                        f"be >= 0, got {sub[knob]}"
+                    )
 
     @property
     def start_replicas(self) -> int:
@@ -144,8 +226,65 @@ def validate_autoscaling_config(cfg: Optional[Dict[str, Any]]) -> Optional[Dict[
     return dataclasses.asdict(AutoscalingConfig(**cfg))
 
 
+# ------------------------------------------------------------------- pools
+def validate_pool_config(cfg: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Validate a deployment pool_config ({"prefill": P, "decode": D} —
+    the disaggregated-serving replica split) at deployment() time.
+    Both pools are required (a prefill pool with nowhere to send its KV,
+    or a decode pool nothing feeds, is always a config error) and each
+    count must be >= 1."""
+    if cfg is None:
+        return None
+    if not isinstance(cfg, dict):
+        raise ValueError(
+            f"pool_config must be a dict, got {type(cfg).__name__}"
+        )
+    unknown = set(cfg) - set(_POOL_NAMES)
+    if unknown:
+        raise ValueError(
+            f"pool_config: unknown pool(s) {sorted(unknown)}; valid "
+            f"pools: {sorted(_POOL_NAMES)}"
+        )
+    missing = set(_POOL_NAMES) - set(cfg)
+    if missing:
+        raise ValueError(
+            f"pool_config: missing pool(s) {sorted(missing)} — "
+            f"disaggregated serving needs both a prefill and a decode pool"
+        )
+    out = {}
+    for role, n in cfg.items():
+        if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+            raise ValueError(
+                f"pool_config: pools[{role!r}] must be an int >= 1, "
+                f"got {n!r}"
+            )
+        out[role] = n
+    return out
+
+
+def pool_autoscaler_config(cfg: Dict[str, Any], role: str) -> Dict[str, Any]:
+    """Project a pooled autoscaling_config onto ONE pool's standard
+    AutoscalingConfig: base knobs minus `pools`, overlaid with the
+    pool's sub-config, with the pool's signal target
+    (target_queued_prefill_tokens / target_decode_lanes) mapped onto
+    target_ongoing_requests — so the shared AutoscalerState.decide()
+    engine scales toward total_signal / target without knowing which
+    signal it is steering."""
+    base = {k: v for k, v in cfg.items() if k != "pools"}
+    # start counts come from pool_config, never from the shared knob
+    base.pop("initial_replicas", None)
+    sub = dict((cfg.get("pools") or {}).get(role) or {})
+    target = sub.pop("target_queued_prefill_tokens",
+                     sub.pop("target_decode_lanes", None))
+    if target is not None:
+        base["target_ongoing_requests"] = float(target)
+    base.update(sub)
+    return base
+
+
 # ---------------------------------------------------------------- affinity
-_AFFINITY_KEYS = ("prefix_len", "spill_threshold", "vnodes", "mode")
+_AFFINITY_KEYS = ("prefix_len", "spill_threshold", "vnodes", "mode",
+                  "cluster")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -163,12 +302,17 @@ class AffinityConfig:
         membership refresh; more = smoother key redistribution).
     mode: "auto" (session_id when the request carries one, else prompt
         prefix), "session" (session_id only), "prefix" (prompt only).
+    cluster: consult the cluster-wide KV inventory
+        (serve/_internal/kv_plane.InventoryView) before the hash ring —
+        a prefix prefilled ANYWHERE routes its repeat traffic to the
+        replica that owns the blocks. Off = ring-only routing.
     """
 
     prefix_len: int = 32
     spill_threshold: int = 8
     vnodes: int = 32
     mode: str = "auto"
+    cluster: bool = True
 
     def __post_init__(self):
         if self.prefix_len < 1:

@@ -96,6 +96,25 @@ class RadixPrefixCache:
             self._alloc.incref(blocks)
         return blocks, len(blocks) * self._bs
 
+    def match_blocks(self, tokens: Sequence[int]) -> List[int]:
+        """Non-mutating full-block walk for the KV-plane EXPORT path:
+        every committed block covering `tokens` (all len//bs of them —
+        unlike lookup(), which caps at the proper prefix because an
+        admission must re-prefill its last token). No references are
+        taken and no counters/ticks move: the caller gathers the blocks
+        in the same engine-loop closure, before any other allocator
+        mutation can recycle them."""
+        node, out = self._root, []
+        n_full = len(tokens) // self._bs
+        for i in range(n_full):
+            chunk = tuple(tokens[i * self._bs:(i + 1) * self._bs])
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            out.append(child.block)
+            node = child
+        return out
+
     def record_lookup(self, n_prompt_tokens: int, n_matched_blocks: int) -> None:
         """Count one lookup toward the hit/miss/reuse-rate stats."""
         self.lookup_tokens += n_prompt_tokens

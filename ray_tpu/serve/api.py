@@ -61,11 +61,13 @@ class Deployment:
         autoscaling_config: Optional[dict] = None,
         affinity_config: Optional[dict] = None,
         fault_config: Optional[dict] = None,
+        pool_config: Optional[dict] = None,
     ):
         from ray_tpu.serve._internal.autoscaler import (
             validate_affinity_config,
             validate_autoscaling_config,
             validate_fault_config,
+            validate_pool_config,
         )
 
         self._callable = cls_or_fn
@@ -90,6 +92,19 @@ class Deployment:
         # survivors? (safe only for side-effect-free requests; see
         # serve/errors.py for the full taxonomy)
         self.fault_config = validate_fault_config(fault_config)
+        # {"prefill": P, "decode": D} — disaggregated serving: the
+        # deployment runs two replica pools with distinct roles joined
+        # by the KV plane (serve/_internal/kv_plane.py); replica counts
+        # here REPLACE num_replicas
+        self.pool_config = validate_pool_config(pool_config)
+        if self.pool_config is not None:
+            self.num_replicas = sum(self.pool_config.values())
+        if (self.autoscaling_config or {}).get("pools") and self.pool_config is None:
+            raise ValueError(
+                "autoscaling_config['pools'] requires pool_config on the "
+                "deployment (per-pool targets without pools to apply "
+                "them to)"
+            )
 
     def options(self, **kw) -> "Deployment":
         merged = dict(
@@ -101,6 +116,7 @@ class Deployment:
             autoscaling_config=self.autoscaling_config,
             affinity_config=self.affinity_config,
             fault_config=self.fault_config,
+            pool_config=self.pool_config,
         )
         merged.update(kw)
         return Deployment(self._callable, **merged)
@@ -159,6 +175,7 @@ def _deploy_tree(controller, app_name: str, app: Application, *, is_root: bool,
             bool(getattr(dep._callable, "__serve_is_ingress__", False)),
             dep.affinity_config,
             dep.fault_config,
+            dep.pool_config,
         )
     )
     seen[id(app)] = dep.name

@@ -144,6 +144,17 @@ class Replica:
 
         init_args = tuple(_resolve(a) for a in init_args)
         init_kwargs = {k: _resolve(v) for k, v in init_kwargs.items()}
+        if replica_name:
+            # record the actor name for the KV plane BEFORE the instance
+            # constructs: the deployment reads its own (app, deployment,
+            # replica) coordinates back from kv_plane to build pool
+            # handles without threading them through user init kwargs
+            try:
+                from ray_tpu.serve._internal import kv_plane
+
+                kv_plane.set_replica_name(replica_name)
+            except Exception:
+                pass
         if inspect.isclass(cls_or_fn):
             self.instance = cls_or_fn(*init_args, **init_kwargs)
         else:
@@ -204,6 +215,27 @@ class Replica:
                     "num_requests": self.num_requests,
                     "pid": self._pid,
                 }
+                # KV-plane duck-typed extras: pool role + per-pool
+                # autoscaling signals, and the block-inventory digests
+                # other replicas' InventoryViews resolve owners from
+                fn = getattr(self.instance, "__serve_pool_signals__", None)
+                if fn is not None:
+                    try:
+                        psig = fn()
+                    except Exception:
+                        psig = None
+                    if isinstance(psig, dict):
+                        payload["pool_signals"] = psig
+                        if psig.get("pool"):
+                            payload["pool"] = psig["pool"]
+                fn = getattr(self.instance, "__serve_kv_inventory__", None)
+                if fn is not None:
+                    try:
+                        inv = fn()
+                        if inv:
+                            payload["kv_inventory"] = list(inv)
+                    except Exception:
+                        pass
                 # idle backoff: an unchanged zero-load signal still
                 # publishes (the autoscaler treats >5s-stale stats as
                 # missing, which would BLOCK downscale-to-min) but at a
@@ -378,6 +410,9 @@ class ServeControllerActor:
                 "replicas": list(rec["replicas"]),
                 "affinity": rec.get("affinity"),
                 "fault": rec.get("fault"),
+                # replica -> pool role, so handles build per-role
+                # routing sub-rings from the same membership push
+                "roles": dict(rec.get("roles") or {}),
             }
         return None
 
@@ -396,6 +431,7 @@ class ServeControllerActor:
         is_ingress: bool = False,
         affinity_config: Optional[dict] = None,
         fault_config: Optional[dict] = None,
+        pool_config: Optional[dict] = None,
     ):
         import cloudpickle
 
@@ -404,6 +440,7 @@ class ServeControllerActor:
             validate_affinity_config,
             validate_autoscaling_config,
             validate_fault_config,
+            validate_pool_config,
         )
 
         cls = cloudpickle.loads(cls_blob)
@@ -412,6 +449,7 @@ class ServeControllerActor:
         autoscaling_config = validate_autoscaling_config(autoscaling_config)
         affinity_config = validate_affinity_config(affinity_config)
         fault_config = validate_fault_config(fault_config)
+        pool_config = validate_pool_config(pool_config)
         app = self.apps.setdefault(app_name, {})
         old = app.get(deployment_name)
         rec = {
@@ -425,24 +463,35 @@ class ServeControllerActor:
             "autoscaling": autoscaling_config,
             "affinity": affinity_config,
             "fault": fault_config,
+            # disaggregated pools: per-role replica targets + the live
+            # replica -> role map (kv_plane; None/{} for plain deploys)
+            "pools": pool_config,
+            "roles": {},
             "is_ingress": is_ingress,
             "deploy_time": time.time(),
         }
         # fresh decision state on EVERY redeploy (also when autoscaling
         # was just turned off — status() must stop reporting the stale
         # autoscaler block): old flap-guard timers and load samples must
-        # not drive the first decisions against the new replica set
-        self._autoscalers.pop((app_name, deployment_name), None)
+        # not drive the first decisions against the new replica set.
+        # Pooled deployments key their states (app, dep, role).
+        for key in [k for k in self._autoscalers
+                    if k[0] == app_name and k[1] == deployment_name]:
+            self._autoscalers.pop(key, None)
         # new code, new crash history: a redeploy closes the old
         # version's crash-loop breaker
         self._breakers.pop((app_name, deployment_name), None)
-        if autoscaling_config:
+        if autoscaling_config and not pool_config:
             rec["num_replicas"] = AutoscalingConfig(**autoscaling_config).start_replicas
         # stage new replicas BEFORE committing the record: a failed deploy
         # (e.g. __init__ raises) must leave the previous version serving
         import asyncio
 
-        self._scale_to(app_name, deployment_name, rec["num_replicas"], rec=rec)
+        if pool_config:
+            for role, n in pool_config.items():
+                self._scale_pool(app_name, deployment_name, rec, role, n)
+        else:
+            self._scale_to(app_name, deployment_name, rec["num_replicas"], rec=rec)
         try:
             await asyncio.gather(
                 *(ray_tpu.get_actor(name).health.remote() for name in rec["replicas"])
@@ -512,6 +561,42 @@ class ServeControllerActor:
                 asyncio.ensure_future(self._drain_and_kill(name))
         rec["replicas"] = cur
         rec["num_replicas"] = target
+
+    def _scale_pool(self, app_name: str, dep_name: str, rec, role: str,
+                    target: int, loads: Optional[Dict[str, float]] = None):
+        """Scale ONE pool of a disaggregated deployment to `target`
+        replicas. Same spawn/drain mechanics as _scale_to, restricted to
+        the replicas whose role matches; new replicas get the role
+        injected as the deployment's `pool` init kwarg, so the same user
+        class serves both sides of the KV plane. Callers own
+        rec["pools"][role] — a probe restart must not lower the stored
+        target."""
+        import asyncio
+
+        roles = rec.setdefault("roles", {})
+        cur = [n for n in rec["replicas"] if roles.get(n) == role]
+        while len(cur) < target:
+            self._counter += 1
+            name = f"SERVE_REPLICA::{app_name}::{dep_name}::{self._counter}"
+            opts = self._scheduler.place(name, rec["ray_actor_options"])
+            kw = dict(rec["init_kwargs"])
+            kw["pool"] = role
+            Replica.options(name=name, max_concurrency=16, **opts).remote(
+                rec["cls"], rec["init_args"], kw, name
+            )
+            self._born[name] = time.time()
+            cur.append(name)
+            rec["replicas"].append(name)
+            roles[name] = role
+        if len(cur) > target:
+            n_kill = len(cur) - target
+            victims = self._scheduler.downscale_order(cur, loads)[:n_kill]
+            for name in victims:
+                cur.remove(name)
+                rec["replicas"].remove(name)
+                roles.pop(name, None)
+                asyncio.ensure_future(self._drain_and_kill(name))
+        rec["num_replicas"] = len(rec["replicas"])
 
     async def _drain_and_kill(self, name: str, timeout_s: Optional[float] = None):
         import asyncio
@@ -620,6 +705,9 @@ class ServeControllerActor:
         the scheduler so the least-loaded replicas drain first."""
         from ray_tpu.serve._internal.autoscaler import AutoscalerState
 
+        if rec.get("pools"):
+            self._autoscale_pools(app_name, dep_name, rec, stats, now)
+            return
         key = (app_name, dep_name)
         state = self._autoscalers.get(key)
         if state is None:
@@ -670,6 +758,74 @@ class ServeControllerActor:
             })
         except Exception:
             pass
+
+    def _autoscale_pools(self, app_name, dep_name, rec, stats, now):
+        """Per-pool autoscaling for a disaggregated deployment: the two
+        pools scale INDEPENDENTLY on their own signals — prefill on
+        queued prompt tokens (arrival burst pressure), decode on busy
+        token-loop lanes (resident occupancy) — each through its own
+        AutoscalerState keyed (app, dep, role), so a prompt burst grows
+        the prefill pool without inflating the decode pool it will only
+        trickle into."""
+        from ray_tpu.serve._internal.autoscaler import (
+            AutoscalerState,
+            pool_autoscaler_config,
+        )
+
+        roles = rec.get("roles") or {}
+        changed = False
+        for role in list(rec["pools"]):
+            key = (app_name, dep_name, role)
+            state = self._autoscalers.get(key)
+            if state is None:
+                state = self._autoscalers[key] = AutoscalerState(
+                    pool_autoscaler_config(rec["autoscaling"], role))
+            cfg = state.cfg
+            members = [n for n in rec["replicas"] if roles.get(n) == role]
+            current = len(members)
+            if current == 0:
+                continue  # the health loop refills toward pools[role]
+            signal_key = ("queued_prefill_tokens" if role == "prefill"
+                          else "decode_lanes_busy")
+            loads: Dict[str, float] = {}
+            total = 0.0
+            for name in members:
+                s = stats.get(name)
+                sig = s.get("pool_signals") if isinstance(s, dict) else None
+                if (isinstance(sig, dict)
+                        and now - float(s.get("t", 0.0)) <= STATS_STALE_S):
+                    load = float(sig.get(signal_key, 0.0))
+                else:
+                    # missing/stale: neutral, exactly at target
+                    load = cfg.target_ongoing_requests
+                loads[name] = load
+                total += load
+            desired = state.decide(total, current, now)
+            if desired != current:
+                self._scale_pool(app_name, dep_name, rec, role, desired,
+                                 loads=loads)
+                rec["pools"][role] = desired
+                changed = True
+            try:
+                from ray_tpu import observability
+
+                observability.publish_snapshot("serve", {
+                    f"autoscaler:{app_name}::{dep_name}::{role}": {
+                        "t": now,
+                        "pool": role,
+                        "replicas": current,
+                        "signal": signal_key,
+                        "load": round(state.last_load, 3),
+                        "desired": state.last_desired,
+                        "min_replicas": cfg.min_replicas,
+                        "max_replicas": cfg.max_replicas,
+                        "target": cfg.target_ongoing_requests,
+                    }
+                })
+            except Exception:
+                pass
+        if changed:
+            self._bump(f"replicas::{app_name}::{dep_name}")
 
     # ------------------------------------------------------ replica health
     def _breaker(self, app_name: str, dep_name: str):
@@ -791,6 +947,7 @@ class ServeControllerActor:
         )
         if name in rec["replicas"]:
             rec["replicas"].remove(name)
+        (rec.get("roles") or {}).pop(name, None)
         self._scheduler.forget(name)
         self._born.pop(name, None)
         try:
@@ -808,6 +965,42 @@ class ServeControllerActor:
         target waits until the probe survives its window (a
         num_replicas=N crash-looper must not pay N doomed spawns per
         cooldown cycle)."""
+        pools = rec.get("pools")
+        if pools:
+            # pooled refill: deficits are PER ROLE (a dead decode
+            # replica must come back as a decode replica); the breaker
+            # stays deployment-wide — a crash-looping class crash-loops
+            # in both roles
+            roles = rec.get("roles") or {}
+            counts = {r: 0 for r in pools}
+            for n in rec["replicas"]:
+                r = roles.get(n)
+                if r in counts:
+                    counts[r] += 1
+            deficits = {r: pools[r] - counts[r]
+                        for r in pools if pools[r] > counts[r]}
+            if not deficits:
+                return
+            breaker = self._breaker(app_name, dep_name)
+            at = breaker.restart_at(now)
+            if at is None or at > now:
+                return
+            before = list(rec["replicas"])
+            if breaker.probing(now):
+                role = next(iter(deficits))
+                self._scale_pool(app_name, dep_name, rec, role,
+                                 counts[role] + 1)
+            else:
+                for role in deficits:
+                    self._scale_pool(app_name, dep_name, rec, role,
+                                     pools[role])
+            rec["num_replicas"] = len(rec["replicas"])
+            for name in rec["replicas"]:
+                if name not in before:
+                    breaker.record_restart(name, now)
+            self._bump(f"replicas::{app_name}::{dep_name}")
+            self._publish_lifecycle(app_name, dep_name, rec, now)
+            return
         desired = rec["num_replicas"]
         missing = desired - len(rec["replicas"])
         if missing <= 0:
@@ -914,6 +1107,17 @@ class ServeControllerActor:
                     entry["affinity"] = dict(d["affinity"])
                 if d.get("fault"):
                     entry["fault"] = dict(d["fault"])
+                if d.get("pools"):
+                    roles = d.get("roles") or {}
+                    entry["pools"] = {
+                        role: {
+                            "target": n,
+                            "replicas": sum(
+                                1 for x in d["replicas"]
+                                if roles.get(x) == role),
+                        }
+                        for role, n in d["pools"].items()
+                    }
                 breaker = self._breakers.get((app_name, name))
                 if breaker is not None and breaker.events:
                     st = breaker.state()

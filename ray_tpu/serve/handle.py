@@ -177,7 +177,15 @@ class DeploymentHandle:
         self._ring_points: List[int] = []   # sorted vnode hash points
         self._ring_names: List[str] = []    # replica name per ring point
         self._name_to_idx: Dict[str, int] = {}
-        self._astats = {"hits": 0, "spills": 0, "misses": 0}
+        # disaggregated pools: replica name -> role ("prefill"/"decode"),
+        # per-role consistent-hash rings, and this handle's role filter
+        # (options(pool=...); None = per-request resolution). The
+        # cluster-inventory view resolves lazily (False = disabled).
+        self._roles: Dict[str, str] = {}
+        self._role_rings: Dict[str, Any] = {}
+        self._pool: Optional[str] = None
+        self._inv: Any = None
+        self._astats = {"hits": 0, "spills": 0, "misses": 0, "inv_hits": 0}
         # failure-semantics state: the deployment's redispatch policy
         # (pushed with membership) + the failure/redispatch counters
         self._fault: Optional[Dict[str, Any]] = None
@@ -198,10 +206,12 @@ class DeploymentHandle:
             names = list(data.get("replicas") or ())
             affinity = data.get("affinity")
             fault = data.get("fault", self._fault)
+            roles = dict(data.get("roles") or {})
         else:
             names = list(data or ())
             affinity = self._affinity
             fault = self._fault
+            roles = self._roles
         handles, ok_names, submits = [], [], []
         for name in names:
             try:
@@ -236,6 +246,14 @@ class DeploymentHandle:
                     )
                     ring.append((point, name))
             ring.sort()
+        # pooled deployments route affinity WITHIN a role: each pool
+        # gets its own ring (same vnode hashes, filtered), so a prefill
+        # key never lands on a decode replica and vice versa
+        role_rings: Dict[str, Any] = {}
+        if ring and roles:
+            for role in {roles[n] for n in ok_names if roles.get(n)}:
+                sub = [(p, n) for p, n in ring if roles.get(n) == role]
+                role_rings[role] = ([p for p, _ in sub], [n for _, n in sub])
         with self._member_cv:
             old = self._outstanding
             # parallel lists stay index-aligned even when some names
@@ -253,6 +271,8 @@ class DeploymentHandle:
             self._fault = fault
             self._ring_points = [p for p, _ in ring]
             self._ring_names = [n for _, n in ring]
+            self._roles = roles
+            self._role_rings = role_rings
             self._name_to_idx = {n: i for i, n in enumerate(ok_names)}
             # wake parked requests: the zero-replica window just closed
             if ok_names:
@@ -299,10 +319,12 @@ class DeploymentHandle:
 
                 time.sleep(1.0)
 
-    def options(self, method_name: str = "__call__", multiplexed_model_id: str = "", **_):
+    def options(self, method_name: str = "__call__", multiplexed_model_id: str = "",
+                pool: Optional[str] = None, **_):
         h = DeploymentHandle(self.deployment_name, self.app_name)
         h._method = method_name
         h._model_id = multiplexed_model_id
+        h._pool = pool if pool is not None else self._pool
         with self._lock:
             h._replica_names = list(self._replica_names)
             h._replicas = list(self._replicas)
@@ -313,6 +335,8 @@ class DeploymentHandle:
             h._fault = self._fault
             h._ring_points = list(self._ring_points)
             h._ring_names = list(self._ring_names)
+            h._roles = dict(self._roles)
+            h._role_rings = dict(self._role_rings)
             h._name_to_idx = dict(self._name_to_idx)
             h.no_replica_timeout_s = self.no_replica_timeout_s
         if h._replicas:
@@ -322,16 +346,19 @@ class DeploymentHandle:
         return h
 
     # -- routing --------------------------------------------------------
-    def _pick(self) -> int:
+    def _pick(self, eligible: Optional[List[int]] = None) -> int:
         """Power of two choices on outstanding counts
-        (reference: pow_2_scheduler.py:44). With a multiplexed model id,
-        the two candidates come from rendezvous hashing on the model id
-        instead of randomness, so each model sticks to a stable pair of
-        replicas and their multiplex LRUs keep hitting (reference:
-        pow_2_scheduler's multiplexed-model-id preference)."""
-        n = len(self._replicas)
-        if n == 1:
-            return 0
+        (reference: pow_2_scheduler.py:44), optionally restricted to the
+        `eligible` index subset (pool-role routing). With a multiplexed
+        model id, the two candidates come from rendezvous hashing on the
+        model id instead of randomness, so each model sticks to a stable
+        pair of replicas and their multiplex LRUs keep hitting
+        (reference: pow_2_scheduler's multiplexed-model-id
+        preference)."""
+        cands = eligible if eligible is not None \
+            else list(range(len(self._replicas)))
+        if len(cands) == 1:
+            return cands[0]
         if self._model_id:
             import hashlib
 
@@ -339,10 +366,10 @@ class DeploymentHandle:
                 h = hashlib.md5(f"{self._model_id}|{self._replica_names[i]}".encode())
                 return h.digest()
 
-            ranked = sorted(range(n), key=score)
+            ranked = sorted(cands, key=score)
             a, b = ranked[0], ranked[1]
         else:
-            a, b = random.sample(range(n), 2)
+            a, b = random.sample(cands, 2)
         na, nb = self._replica_names[a], self._replica_names[b]
         return a if self._outstanding.get(na, 0) <= self._outstanding.get(nb, 0) else b
 
@@ -375,20 +402,56 @@ class DeploymentHandle:
             return None
         return int.from_bytes(hashlib.md5(key).digest()[:8], "big")
 
-    def _route_affinity(self, akey: int):
-        """Ring lookup (lock held): returns (idx, 'hits') for the
+    def _inventory(self):
+        """Lazy cluster-inventory view (False = disabled): resolved once
+        per handle, honoring the kill switch. Only pooled deployments
+        pay the background refresh."""
+        if self._inv is None:
+            try:
+                from ray_tpu.serve._internal import kv_plane
+
+                self._inv = (kv_plane.InventoryView.instance()
+                             if kv_plane.cluster_cache_enabled(None)
+                             else False)
+            except Exception:
+                self._inv = False
+        return self._inv or None
+
+    def _route_affinity(self, akey: int, role: Optional[str] = None,
+                        eligible: Optional[List[int]] = None):
+        """Affinity lookup (lock held): returns (idx, kind) for the
         preferred replica, or (None, 'spills') when its outstanding
         count exceeds the spill threshold and least-loaded routing
-        should take over. Per-request cost is one bisect — the ring was
-        hashed at membership-refresh time."""
-        i = bisect.bisect_left(self._ring_points, akey)
-        if i >= len(self._ring_points):
+        should take over. Per-request cost is one inventory dict probe
+        (pooled deployments with the cluster cache on — the affinity
+        digest IS the inventory key, so a prefix prefilled ANYWHERE
+        routes its repeat traffic to the replica that owns it, ahead of
+        the hash) plus one bisect on a ring hashed at membership-refresh
+        time. Pooled deployments bisect their role's sub-ring."""
+        spill_at = self._affinity.get("spill_threshold", 8)
+        if self._roles and self._affinity.get("cluster", True):
+            inv = self._inventory()
+            owner = inv.owner_of(akey) if inv is not None else None
+            if owner is not None:
+                oidx = self._name_to_idx.get(owner)
+                if (oidx is not None
+                        and (eligible is None or oidx in eligible)
+                        and self._outstanding.get(owner, 0) < spill_at):
+                    return oidx, "inv_hits"
+        points, names = self._ring_points, self._ring_names
+        if role is not None and self._role_rings:
+            sub = self._role_rings.get(role)
+            if sub is not None and sub[0]:
+                points, names = sub
+        if not points:
+            return None, "misses"
+        i = bisect.bisect_left(points, akey)
+        if i >= len(points):
             i = 0  # wrap: the ring is circular
-        name = self._ring_names[i]
+        name = names[i]
         idx = self._name_to_idx.get(name)
         if idx is None:
             return None, "misses"
-        spill_at = self._affinity.get("spill_threshold", 8)
         if self._outstanding.get(name, 0) < spill_at:
             return idx, "hits"
         return None, "spills"
@@ -442,28 +505,40 @@ class DeploymentHandle:
         except Exception:
             pass
 
-    def _reserve(self, akey: Optional[int] = None):
+    def _reserve(self, akey: Optional[int] = None,
+                 role: Optional[str] = None):
         """Pick a replica and charge it one in-flight request — pick AND
         read under one lock (the long-poll thread can swap _replicas for
         a shorter list at any moment). An empty replica set PARKS the
         request on the membership condition instead of raising; affinity
         keys route via the consistent-hash ring with spill-to-
-        least-loaded. Returns (name, submit_method)."""
+        least-loaded. With pool roles, candidates restrict to `role`'s
+        pool — unless that pool is momentarily empty (replica death
+        mid-restart), in which case any survivor serves: a paged engine
+        imports/serves resumes regardless of role, so degrading beats
+        parking. Returns (name, submit_method)."""
         with self._member_cv:
             if not self._replicas:
                 self._park_for_members()
+            eligible = None
+            if role is not None and self._roles:
+                eligible = [i for i, n in enumerate(self._replica_names)
+                            if self._roles.get(n) == role]
+                if not eligible:
+                    eligible = None
             idx = None
             if self._affinity is not None:
                 # keyless requests (no routable prompt/session) count as
                 # misses too, so hits+spills+misses == affinity-routed
                 # requests and the A/B counters don't understate traffic
-                if akey is not None and self._ring_points:
-                    idx, kind = self._route_affinity(akey)
+                if akey is not None and (self._ring_points
+                                         or self._role_rings):
+                    idx, kind = self._route_affinity(akey, role, eligible)
                 else:
                     kind = "misses"
                 self._astats[kind] += 1
             if idx is None:
-                idx = self._pick()
+                idx = self._pick(eligible)
             name = self._replica_names[idx]
             self._outstanding[name] = self._outstanding.get(name, 0) + 1
             return name, self._submits[idx]
@@ -512,7 +587,17 @@ class DeploymentHandle:
 
         akey = self._affinity_digest(args) if self._affinity else None
         record["akey"] = akey
-        record["replica"], submit = self._reserve(akey)
+        # pooled deployments: an explicit options(pool=...) wins;
+        # otherwise plain requests enter through the prefill pool and
+        # KV-resume bodies (migrations) go straight to decode. The role
+        # rides the record so a redispatch stays within the pool.
+        role = self._pool
+        if role is None and self._roles:
+            req0 = args[0] if args else None
+            role = "decode" if (isinstance(req0, dict)
+                                and req0.get("__kv_resume__")) else "prefill"
+        record["pool"] = role
+        record["replica"], submit = self._reserve(akey, role)
         try:
             # the prebound method rides the shm-ring direct transport
             # when negotiated, the RPC path otherwise — same call shape
@@ -520,7 +605,7 @@ class DeploymentHandle:
         except Exception:
             done()
             self._refresh()
-            record["replica"], submit = self._reserve(akey)
+            record["replica"], submit = self._reserve(akey, role)
             ref = submit.remote(self._method, args, kwargs)
         return DeploymentResponse(ref, on_done=done, handle=self, record=record)
 
@@ -596,7 +681,8 @@ class DeploymentHandle:
         )
         # _reserve parks under the zero-replica machinery when the dead
         # replica was the last one — the restart/scale-up push unparks
-        record["replica"], submit = self._reserve(record.get("akey"))
+        record["replica"], submit = self._reserve(
+            record.get("akey"), record.get("pool"))
         return submit.remote(record["method"], record["args"], record["kwargs"])
 
     def routing_stats(self) -> Dict[str, Any]:
@@ -608,7 +694,8 @@ class DeploymentHandle:
         with self._lock:
             out = dict(self._astats)
             out["total"] = (self._astats["hits"] + self._astats["spills"]
-                            + self._astats["misses"])
+                            + self._astats["misses"]
+                            + self._astats["inv_hits"])
             out["affinity_enabled"] = self._affinity is not None
             out["ring_points"] = len(self._ring_points)
             out["replicas"] = len(self._replica_names)

@@ -82,10 +82,18 @@ class _LLMServer:
                  macro_phases: int = 8, paged: Optional[bool] = None,
                  block_size: int = 16, n_blocks: int = 0,
                  prefix_cache: bool = True, max_queue: Optional[int] = None,
-                 draft_model=None, num_speculative_tokens: int = 0):
+                 draft_model=None, num_speculative_tokens: int = 0,
+                 pool: Optional[str] = None,
+                 cluster_cache: Optional[bool] = None,
+                 digest_prefix_len: int = 32):
         import jax
 
         from ray_tpu.models import llama
+
+        if pool is not None and not continuous:
+            raise ValueError(
+                "pool roles require the continuous engine "
+                "(llm_deployment(continuous=True, pools=...))")
 
         self.cfg = cfg or llama.LlamaConfig.tiny()
         if params is not None:
@@ -98,6 +106,16 @@ class _LLMServer:
             self.params = llama.init_params(jax.random.PRNGKey(seed), self.cfg)
         self.max_new_tokens = max_new_tokens
         self.engine = None
+        self.pool = pool
+        self._digest_prefix_len = digest_prefix_len
+        # KV-plane state (disaggregated serving): exported payload refs
+        # pinned until the decode pool acks, the lazy handle back into
+        # this deployment's decode pool, the migration pump threads, and
+        # the prefetch memo that rate-limits cluster-cache fetch attempts
+        self._export_refs: Any = None
+        self._decode_h: Any = None
+        self._pump: Any = None
+        self._prefetch_memo: Dict[str, float] = {}
         if continuous:
             # continuous batching: requests admit/evict per decode chunk,
             # with macro-step scheduling batching K chunks per dispatch;
@@ -125,6 +143,9 @@ class _LLMServer:
                 # params/checkpoint_dir/seed (see _internal/speculative)
                 draft_model=draft_model,
                 num_speculative_tokens=num_speculative_tokens,
+                # disaggregated pool role + cluster-wide prefix cache
+                role=pool, cluster_cache=cluster_cache,
+                digest_prefix_len=digest_prefix_len,
                 # pid-unique name: each replica's engine publishes its
                 # own `engine:<name>` telemetry entry, so /api/serve
                 # shows PER-REPLICA serving metrics (same-named engines
@@ -145,6 +166,150 @@ class _LLMServer:
         the replica's own in-flight counter can't see engine load."""
         return self.engine.load() if self.engine is not None else 0
 
+    # -- KV plane (disaggregated pools + cluster prefix cache) ----------
+    def __serve_pool_signals__(self) -> Optional[Dict[str, Any]]:
+        """Per-pool autoscaling signals (queued prefill tokens / decode
+        lane occupancy) published by the replica's report loop."""
+        if self.engine is None:
+            return None
+        return self.engine.pool_signals()
+
+    def __serve_kv_inventory__(self) -> List[str]:
+        """Digests of prompt prefixes whose KV blocks live in this
+        replica's radix cache — the telemetry payload other replicas'
+        InventoryViews read to resolve cluster prefix-cache owners."""
+        if self.engine is None:
+            return []
+        return self.engine.kv_inventory()
+
+    def export_prefix_kv(self, digest) -> Optional[Dict[str, Any]]:
+        """Peer RPC: gather the cached prefix behind `digest` and put it
+        on the object plane. Returns {"tokens", "ref" (hex),
+        "n_data_blocks", "block_size"} or None when the prefix was
+        evicted since it was advertised. The ObjectRef is pinned in a
+        bounded deque so the payload survives until the peer fetches it
+        (ring eviction after 64 exports is a re-fetchable miss, not a
+        correctness problem — the peer just sees a get timeout and skips
+        the import)."""
+        if self.engine is None:
+            return None
+        d = self.engine.export_prefix(digest)
+        if d is None:
+            return None
+        if self._export_refs is None:
+            from collections import deque
+
+            self._export_refs = deque(maxlen=64)
+        self._export_refs.append(d.pop("_ref"))
+        return d
+
+    def _decode_handle(self):
+        """Lazy handle back into THIS deployment, pinned to the decode
+        pool — the migration pump resubmits finished prefills through it
+        so decode-replica death reuses the handle's classify/redispatch
+        machinery instead of growing a second failure path."""
+        if self._decode_h is None:
+            from ray_tpu.serve._internal import kv_plane
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            ctx = kv_plane.current_replica_context()
+            if not ctx:
+                raise RuntimeError(
+                    "prefill replica has no serve context; cannot route "
+                    "to the decode pool")
+            h = DeploymentHandle(ctx["deployment"], ctx["app"])
+            h._pool = "decode"
+            self._decode_h = h
+        return self._decode_h
+
+    def _resume_body(self, req, rid) -> Dict[str, Any]:
+        from ray_tpu.serve._internal import kv_plane
+
+        exp = req.export
+        return kv_plane.make_resume_body(
+            prompt=req.prompt, first_token=req.tokens[0],
+            max_new_tokens=req.max_new_tokens, sampling=req.sampling,
+            ref_hex=exp["ref_hex"], n_data_blocks=exp["n_data_blocks"],
+            block_size=exp["block_size"], rid=rid,
+            t_export=exp["t_export"])
+
+    def _chain_decode(self, req, rid) -> List[int]:
+        """Synchronous second hop: ship the migrated request's resume
+        body to the decode pool and wait for the full token list. Holds
+        `req` (and so the exported ObjectRef) alive until the decode
+        side replied — the put must outlive the peer's get."""
+        resp = self._decode_handle().remote(self._resume_body(req, rid))
+        try:
+            return resp.result(timeout=120.0)
+        finally:
+            del req  # release the KV payload ref only after the reply
+
+    def _pump_migration(self, req, rid, deferred) -> None:
+        """Deferred-path second hop, off the engine loop thread: the
+        handle call blocks on the decode pool, so it runs on the pump
+        executor and completes the caller's deferred when decode
+        finishes (or fails it with the typed error so the CALLER's
+        handle can classify — by then the prefill output already
+        escaped, so only the decode hop is retried, internally)."""
+        if self._pump is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pump = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="kv-migrate")
+
+        def _run():
+            try:
+                deferred.complete(self._chain_decode(req, rid))
+            except Exception as e:
+                deferred.fail(e)
+
+        self._pump.submit(_run)
+
+    def _maybe_prefetch_prefix(self, prompt: List[int]) -> None:
+        """Cluster prefix-cache read path: ONE digest + ONE inventory
+        probe per request (lint-pinned). If another replica advertises
+        this prompt's prefix and it is not cached locally, fetch its KV
+        blocks over the object plane and graft them into the local radix
+        cache BEFORE submit, so admission's ordinary lookup() hits.
+        Strictly best-effort: every failure path degrades to a local
+        prefill, and a per-digest memo rate-limits repeat attempts."""
+        import time as _time
+
+        from ray_tpu.serve._internal import kv_plane
+
+        eng = self.engine
+        if eng is None or not getattr(eng, "_cluster_cache", False):
+            return
+        if self.pool == "decode" or len(prompt) < self._digest_prefix_len:
+            return
+        dig = kv_plane.prefix_digest(prompt, self._digest_prefix_len)
+        if eng.has_local_prefix(dig):
+            return
+        owner = kv_plane.InventoryView.instance().owner_of(dig)
+        if owner is None or owner == kv_plane.current_replica_name():
+            return
+        now = _time.monotonic()
+        last = self._prefetch_memo.get(str(dig))
+        if last is not None and now - last < 5.0:
+            return
+        if len(self._prefetch_memo) > 512:
+            self._prefetch_memo.clear()
+        self._prefetch_memo[str(dig)] = now
+        try:
+            import ray_tpu
+
+            peer = ray_tpu.get_actor(owner)
+            exp = ray_tpu.get(
+                peer.handle_request.remote("export_prefix_kv", (dig,), {}),
+                timeout=10.0)
+            if not exp:
+                return
+            payload = kv_plane.fetch_kv_payload(exp["ref"], timeout=10.0)
+            eng.import_prefix(exp["tokens"], payload["k"], payload["v"],
+                              exp["n_data_blocks"])
+        except Exception:
+            pass  # cluster cache is an optimization, never a failure
+
     @batch(max_batch_size=32, batch_wait_timeout_s=0.02)
     def _generate(self, prompts: List[List[int]]) -> List[List[int]]:
         from ray_tpu.models import llama_decode
@@ -164,13 +329,59 @@ class _LLMServer:
                 out[i] = toks[row].tolist()
         return out
 
-    def __call__(self, request) -> List[int]:
+    def _call_resume(self, body) -> Optional[List[int]]:
+        """Decode-pool entry for a migrated request: ONE object-plane
+        get resolves the prefill side's KV payload, then the request
+        resumes mid-stream via submit_resumed (no admission control —
+        the prefill pool already admitted it; shedding here would lose
+        a request whose first token was already produced)."""
+        from ray_tpu.serve._internal import kv_plane
+        from ray_tpu.experimental.direct_transport import maybe_defer
+
+        if self.engine is None:
+            raise ValueError("__kv_resume__ requires the continuous engine")
+        payload = kv_plane.fetch_kv_payload(body["ref"])
+        sampling = SamplingParams.from_request(body.get("sampling"))
+        kw = dict(
+            prompt=[int(t) for t in body["prompt"]],
+            first_token=int(body["first"]),
+            max_new_tokens=int(body["max_new_tokens"]),
+            k=payload["k"], v=payload["v"],
+            n_data_blocks=int(body["n_data_blocks"]),
+            sampling=sampling, rid=body.get("rid"),
+            t_export=body.get("t_export"),
+        )
+        deferred = maybe_defer()
+        if deferred is not None:
+            def _complete(req):
+                if req.error is None:
+                    deferred.complete(req.tokens)
+                else:
+                    deferred.fail(req.exc or RuntimeError(
+                        f"generation failed: {req.error}"))
+
+            self.engine.submit_resumed(on_done=_complete, **kw)
+            return None
+        req = self.engine.submit_resumed(**kw)
+        if not req.done.wait(120.0):
+            self.engine.cancel(req, "cancelled: resume timed out")
+            raise TimeoutError("resumed generation timed out")
+        if req.error is not None:
+            raise req.exc or RuntimeError(f"generation failed: {req.error}")
+        return req.tokens
+
+    def __call__(self, request) -> Optional[List[int]]:
+        from ray_tpu.serve._internal import kv_plane
+
+        if kv_plane.is_resume_body(request):
+            return self._call_resume(request)
         if self.engine is not None:
             prompt, max_new, sampling, rid = _parse_request(
                 request, self.max_new_tokens
             )
             from ray_tpu.experimental.direct_transport import maybe_defer
 
+            self._maybe_prefetch_prefix(prompt)
             deferred = maybe_defer()
             if deferred is not None:
                 # direct-transport fast path: submit() enqueues onto the
@@ -179,15 +390,21 @@ class _LLMServer:
                 # thread parks on the done event and the completion costs
                 # one ring write instead of an object-store round trip
                 def _complete(req):
-                    if req.error is None:
-                        deferred.complete(req.tokens)
-                    else:
+                    if req.error is not None:
                         # typed failure when the engine recorded one
                         # (shed / deadline / replica-death) — the class
                         # crosses the ring pickled, so the handle's
                         # redispatch policy classifies by isinstance
                         deferred.fail(req.exc or RuntimeError(
                             f"generation failed: {req.error}"))
+                    elif req.finish_reason == "migrated":
+                        # prefill pool: the prompt pass is done and the
+                        # KV payload is on the object plane — hand off
+                        # to the decode pool off-loop; the caller's
+                        # deferred completes when decode finishes
+                        self._pump_migration(req, rid, deferred)
+                    else:
+                        deferred.complete(req.tokens)
 
                 # a submit() raise (dead engine, shed, bad request)
                 # propagates: the transport surfaces it and disarms the
@@ -197,8 +414,18 @@ class _LLMServer:
                     rid=rid,
                 )
                 return None
-            return self.engine.generate(prompt, max_new, sampling=sampling,
-                                        rid=rid)
+            req = self.engine.submit(prompt, max_new, sampling=sampling,
+                                     rid=rid)
+            if not req.done.wait(120.0):
+                self.engine.cancel(req, "cancelled: generation timed out")
+                raise TimeoutError(
+                    "generation timed out (request cancelled)")
+            if req.error is not None:
+                raise req.exc or RuntimeError(
+                    f"generation failed: {req.error}")
+            if req.finish_reason == "migrated":
+                return self._chain_decode(req, rid)
+            return req.tokens
         if isinstance(request, dict):
             raise ValueError(
                 "per-request sampling needs the continuous engine "
@@ -215,6 +442,9 @@ def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
                    n_blocks: int = 0, prefix_cache: bool = True,
                    max_queue: Optional[int] = None, draft_model=None,
                    num_speculative_tokens: int = 0,
+                   pools: Optional[Dict[str, int]] = None,
+                   cluster_cache: Optional[bool] = None,
+                   digest_prefix_len: int = 32,
                    **deploy_kw):
     """A ready-to-run LLM generation application:
 
@@ -246,12 +476,37 @@ def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
     draft_model=None the replica compiles a program with zero draft
     FLOPs — speculation off costs nothing.
 
+    `pools={"prefill": P, "decode": D}` turns on DISAGGREGATED serving
+    (continuous paged engine only): the deployment runs P prefill
+    replicas (admission + prompt pass, compute-bound) and D decode
+    replicas (the token loop, bandwidth-bound); finished prefills ship
+    their KV blocks to a decode replica over the object plane and the
+    request resumes mid-stream there. With pools set, `num_replicas` is
+    ignored (the pool counts ARE the replica counts) and per-pool
+    autoscaling targets can ride autoscaling_config={"pools": {...}}.
+    `cluster_cache` (default: on, kill switch
+    RAY_TPU_SERVE_CLUSTER_CACHE=0) makes the radix prefix cache
+    cluster-wide: replicas advertise committed prefix digests through
+    telemetry, the router prefers the owning replica, and misses fetch
+    the owner's KV blocks instead of re-prefilling;
+    `digest_prefix_len` is the token window the cluster cache keys on.
+
     Generation is side-effect-free, so the deployment opts into
     replica-death REDISPATCH by default: a request in flight on a
     SIGKILLed/wedged replica (from which no output can have escaped —
     results deliver only at completion) is requeued onto a survivor by
     the handle; pass fault_config={"redispatch": False} to disable."""
     deploy_kw.setdefault("fault_config", {"redispatch": True})
+    if pools is not None:
+        if not continuous:
+            raise ValueError(
+                "pools= requires continuous=True (disaggregated serving "
+                "runs on the continuous paged engine)")
+        if paged is False or macro_phases <= 0:
+            raise ValueError(
+                "pools= requires the paged macro-step engine "
+                "(macro_phases > 0 and paged != False)")
+        deploy_kw["pool_config"] = dict(pools)
     dep = deployment(
         _LLMServer, name="LLMServer", num_replicas=num_replicas, **deploy_kw
     )
@@ -261,4 +516,6 @@ def llm_deployment(num_replicas: int = 1, max_new_tokens: int = 32,
                     paged=paged, block_size=block_size, n_blocks=n_blocks,
                     prefix_cache=prefix_cache, max_queue=max_queue,
                     draft_model=draft_model,
-                    num_speculative_tokens=num_speculative_tokens)
+                    num_speculative_tokens=num_speculative_tokens,
+                    cluster_cache=cluster_cache,
+                    digest_prefix_len=digest_prefix_len)

@@ -119,6 +119,10 @@ def _engine_metrics_factory():
         occupancy=metrics.Gauge(
             "ray_tpu_llm_lane_occupancy_pct",
             "useful slot-steps / total slot-steps", tag_keys=("engine",)),
+        migration=metrics.Histogram(
+            "ray_tpu_llm_migration_s",
+            "prefill->decode KV handoff latency",
+            boundaries=_TTFT_BOUNDS, tag_keys=("engine",)),
     )
 
 
@@ -210,7 +214,8 @@ class _Request:
                  "_remaining", "_rounds_est", "_rounds_inflight",
                  "_t_submit", "_t_first", "_t_done",
                  "_trace_ctx", "_start", "_blocks", "_blocks_freed",
-                 "_done_lock", "rid")
+                 "_done_lock", "rid", "_migrate", "export", "_resume",
+                 "_qtok")
 
     def __init__(self, prompt, max_new_tokens, on_done=None, sampling=None,
                  rid: Optional[str] = None):
@@ -244,6 +249,15 @@ class _Request:
         self.exc: Optional[BaseException] = None
         self._first_dev = None   # device scalar: prefill's first token (legacy path)
         self._remaining = 0      # host-side plan counter (decode steps owed)
+        # KV-plane state: _migrate marks a prefill-pool request that
+        # hands off after its first token; export holds the exporter's
+        # {ref, ...} handoff metadata (keeps the ObjectRef alive until
+        # the decode side's reply lands); _resume carries an inbound
+        # migration's fetched payload until the import admits it
+        self._migrate = False
+        self.export: Optional[Dict[str, Any]] = None
+        self._resume: Optional[Dict[str, Any]] = None
+        self._qtok = 0           # queued-prefill-token accounting (idempotent)
         # speculative mode: acceptance is data-dependent, so the planner
         # schedules verify ROUNDS from an estimate instead of exact
         # steps — rounds still plannable / already dispatched-unresolved
@@ -299,10 +313,23 @@ class ContinuousBatchingEngine:
                  paged: bool = False, block_size: int = 16,
                  n_blocks: int = 0, prefix_cache: bool = True,
                  max_queue: Optional[int] = None, draft_model=None,
-                 num_speculative_tokens: int = 0):
+                 num_speculative_tokens: int = 0,
+                 role: Optional[str] = None,
+                 cluster_cache: Optional[bool] = None,
+                 digest_prefix_len: int = 32):
         import jax
 
         from ray_tpu.models import llama_decode as D
+
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"engine role must be None, 'prefill' or 'decode', got "
+                f"{role!r}")
+        if role is not None and not paged:
+            raise ValueError(
+                "disaggregated pool roles require the paged engine "
+                "(paged=True) — KV migration is block-granular")
+        self.role = role
 
         self._jax = jax
         self._D = D
@@ -384,6 +411,14 @@ class ContinuousBatchingEngine:
         elif self.n_spec > 0:
             raise ValueError(
                 "num_speculative_tokens > 0 requires a draft_model")
+        if role is not None and self.draft_cache is not None:
+            # a SEPARATE draft pool cannot follow a migration (only the
+            # target pool's blocks ship) — the resumed request's draft
+            # lane would verify against garbage. Shared-pool
+            # self-drafting (draft_cache None) migrates fine.
+            raise ValueError(
+                "disaggregated pools require a shared-pool draft model "
+                "(separate draft KV cannot migrate across replicas)")
         # memoized per (cfg, chunk): same-geometry engines share one jit
         # wrapper, so engine construction never recompiles warm programs
         self._prefill_slots = D.jitted_prefill_into_slots(cfg)
@@ -396,6 +431,26 @@ class ContinuousBatchingEngine:
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._waiting: deque = deque()       # planner-side FIFO (loop thread only)
         self._pending: deque = deque()       # fetch frontier: tagged entries
+        # KV-plane plumbing: inbound migrations (fetched payloads
+        # awaiting a slot), cross-thread jobs the loop executes at plan
+        # boundaries (allocator/trie mutation stays loop-thread-only),
+        # and the queued-prefill-token gauge feeding the prefill pool's
+        # autoscaling signal
+        self._rqueue: "queue.Queue[_Request]" = queue.Queue()
+        self._resuming: deque = deque()      # loop thread only
+        self._jobs: "queue.Queue" = queue.Queue()
+        self._qtok_lock = threading.Lock()
+        self._queued_prefill_tokens = 0
+        self._kv_inv = None
+        if self.paged and self._prefix is not None:
+            from ray_tpu.serve._internal.kv_plane import (
+                PrefixInventory, cluster_cache_enabled)
+
+            self._cluster_cache = cluster_cache_enabled(cluster_cache)
+            if self._cluster_cache:
+                self._kv_inv = PrefixInventory(digest_prefix_len)
+        else:
+            self._cluster_cache = False
         self._dead: Optional[str] = None
         # admission bound: max requests WAITING (beyond the resident
         # slots) before submit() sheds with a typed 503-shaped error —
@@ -418,11 +473,15 @@ class ContinuousBatchingEngine:
                    "kv_blocks_peak_in_use": 0, "shed_queue_full": 0,
                    "shed_eta": 0, "deadline_expired": 0,
                    "spec_verify_rounds": 0, "draft_proposed_tokens": 0,
-                   "draft_accepted_tokens": 0}
+                   "draft_accepted_tokens": 0, "migrations_out": 0,
+                   "migrations_in": 0, "migrated_blocks_out": 0,
+                   "migrated_blocks_in": 0, "prefix_exports": 0,
+                   "prefix_imports": 0}
         shared = _engine_metrics()
         self._tags = {"engine": name}
         self._ttft = _LatencyHist(_TTFT_BOUNDS, shared["ttft"], self._tags)
         self._tpot = _LatencyHist(_TPOT_BOUNDS, shared["tpot"], self._tags)
+        self._mig = _LatencyHist(_TTFT_BOUNDS, shared["migration"], self._tags)
         # device-step telemetry for each dispatch: host dispatch slices
         # land on the unified trace's device rows, parented under the
         # trace contexts of the requests each dispatch serves
@@ -514,8 +573,13 @@ class ContinuousBatchingEngine:
                 "temperature sampling and stop tokens require the paged "
                 "engine (paged=True)"
             )
+        # prefill-pool requests hand off after their first token, so
+        # they reserve blocks for the PROMPT only (admission writes
+        # prompt positions; the decode pool reserves the full span)
+        will_migrate = self.role == "prefill" and max_new_tokens > 1
         if self.paged:
-            need = self._alloc.blocks_for_tokens(len(prompt) + max_new_tokens)
+            span = len(prompt) if will_migrate else len(prompt) + max_new_tokens
+            need = self._alloc.blocks_for_tokens(span)
             if need > self.n_blocks - 1:
                 raise ValueError(
                     f"request needs {need} KV blocks, pool only has "
@@ -524,6 +588,10 @@ class ContinuousBatchingEngine:
         self._check_admission(sampling)
         req = _Request([int(t) for t in prompt], max_new_tokens,
                        on_done=on_done, sampling=sampling, rid=rid)
+        req._migrate = will_migrate
+        req._qtok = len(req.prompt)
+        with self._qtok_lock:
+            self._queued_prefill_tokens += req._qtok
         try:
             from ray_tpu.util import tracing
 
@@ -593,8 +661,201 @@ class ContinuousBatchingEngine:
         return (
             self._queue.qsize()
             + len(self._waiting)
+            + self._rqueue.qsize()
+            + len(self._resuming)
             + sum(1 for s in self._slots if s is not None)
         )
+
+    # ------------------------------------------------------- KV plane
+    def _dec_qtok(self, req: _Request) -> None:
+        """Retire a request's queued-prefill-token contribution
+        (idempotent — admission, shedding and death can race only in
+        program order on the loop thread, but belt and braces)."""
+        n, req._qtok = req._qtok, 0
+        if n:
+            with self._qtok_lock:
+                self._queued_prefill_tokens -= n
+
+    def pool_signals(self) -> Dict[str, Any]:
+        """The per-pool autoscaling signals (ISSUE 18): queued prefill
+        tokens for the prefill pool (work not yet admitted — slot-count
+        load signals under-weigh long prompts), decode lane occupancy
+        for the decode pool (resident + inbound migrations). Counter
+        reads only; published by the Replica stat reporter."""
+        with self._qtok_lock:
+            qtok = self._queued_prefill_tokens
+        resumes = self._rqueue.qsize() + len(self._resuming)
+        return {
+            "pool": self.role,
+            "queued_prefill_tokens": max(0, qtok),
+            "decode_lanes_busy":
+                sum(1 for s in self._slots if s is not None) + resumes,
+            "resume_queue": resumes,
+        }
+
+    def kv_inventory(self) -> List[str]:
+        """Digest list of locally committed prompt prefixes — the
+        replica's contribution to the cluster-wide cache inventory
+        (JSON-safe, atomic snapshot)."""
+        return self._kv_inv.published() if self._kv_inv is not None else []
+
+    def has_local_prefix(self, digest) -> bool:
+        return self._kv_inv is not None and digest in self._kv_inv
+
+    def _register_prefix(self, prompt: List[int]) -> None:
+        """Record a radix-committed prefix in the publishable inventory
+        (loop thread, right after the trie insert)."""
+        if self._kv_inv is None:
+            return
+        n_committed = (len(prompt) // self.block_size) * self.block_size
+        self._kv_inv.register(prompt, n_committed)
+
+    def call_on_loop(self, fn, timeout: float = 30.0):
+        """Run `fn` on the engine loop thread (the only thread allowed
+        to touch the allocator, the radix trie and the cache handle) and
+        return its result. Blocks the CALLER, never the loop."""
+        import concurrent.futures
+
+        if self._dead is not None:
+            raise RuntimeError(f"engine is dead: {self._dead}")
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        self._jobs.put((fn, fut))
+        self._wake.set()
+        return fut.result(timeout)
+
+    def _drain_jobs(self) -> None:
+        while True:
+            try:
+                fn, fut = self._jobs.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                fut.set_result(fn())
+            except Exception as e:  # noqa: BLE001 — job errors go to the caller
+                fut.set_exception(e)
+
+    def submit_resumed(self, prompt: List[int], first_token: int,
+                       max_new_tokens: int, k, v, n_data_blocks: int,
+                       on_done=None, sampling=None, rid: Optional[str] = None,
+                       t_export: Optional[float] = None) -> _Request:
+        """Admit a MIGRATED request: the prompt was prefilled (and its
+        first token sampled) on a prefill-pool replica; `k`/`v` are its
+        gathered KV block slices fetched from the object plane (padded
+        to the exporter's bucket). The request joins the resume queue
+        and the loop imports it at the next plan boundary — no admission
+        control (it already paid admission at the prefill pool; shedding
+        mid-migration would discard finished prefill work)."""
+        from ray_tpu.serve._internal.sampling import SamplingParams
+
+        if self._dead is not None:
+            raise RuntimeError(f"engine is dead: {self._dead}")
+        if not self.paged:
+            raise ValueError("KV resume requires the paged engine")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt+generation ({len(prompt)}+{max_new_tokens}) exceeds "
+                f"engine max_len {self.max_len}")
+        need = self._alloc.blocks_for_tokens(len(prompt) + max_new_tokens)
+        if need > self.n_blocks - 1:
+            raise ValueError(
+                f"resumed request needs {need} KV blocks, pool only has "
+                f"{self.n_blocks - 1}")
+        sampling = SamplingParams.from_request(sampling)
+        req = _Request([int(t) for t in prompt], max_new_tokens,
+                       on_done=on_done, sampling=sampling, rid=rid)
+        req._resume = {"k": k, "v": v, "n_data": int(n_data_blocks),
+                       "first": int(first_token), "t_export": t_export}
+        try:
+            from ray_tpu.util import tracing
+
+            req._trace_ctx = tracing.current_context()
+        except Exception:
+            pass
+        self._rqueue.put(req)
+        if self._dead is not None:
+            msg = f"engine is dead: {self._dead}"
+            _finish(req, error=msg)
+            raise RuntimeError(msg)
+        self._wake.set()
+        return req
+
+    def export_prefix(self, digest) -> Optional[Dict[str, Any]]:
+        """Cluster prefix-cache export: look `digest` up in the local
+        inventory, gather its committed blocks (dispatched on the loop
+        thread, BEFORE any later mutation can recycle them — device
+        programs serialize) and publish ONE object-plane put (this
+        thread: serialization syncs on the gather, off the loop).
+        Returns the handoff dict (tokens + hex ref + a live "_ref" the
+        caller must hold until importers are done) or None on miss."""
+        from ray_tpu.serve._internal import kv_plane
+
+        def job():
+            if self._kv_inv is None:
+                return None
+            tokens = self._kv_inv.tokens_for(digest)
+            if tokens is None:
+                return None
+            blocks = self._prefix.match_blocks(tokens)
+            if not blocks:
+                return None
+            import jax.numpy as jnp
+
+            ids = kv_plane.pad_block_ids(blocks)
+            k, v = self._D.jitted_gather_kv_blocks()(
+                self.cache, jnp.asarray(ids))
+            return list(tokens[: len(blocks) * self.block_size]), k, v, \
+                len(blocks)
+
+        res = self.call_on_loop(job)
+        if res is None:
+            return None
+        tokens, k, v, n = res
+        import ray_tpu
+
+        ref = ray_tpu.put({"k": k, "v": v, "n": n})
+        self._m["prefix_exports"] += 1
+        return {"tokens": tokens, "ref": ref.hex(), "n_data_blocks": n,
+                "block_size": self.block_size, "_ref": ref}
+
+    def import_prefix(self, tokens: List[int], k, v,
+                      n_data_blocks: int) -> int:
+        """Cluster prefix-cache import: scatter a peer's committed
+        prefix blocks into the local pool and commit them to the radix
+        trie, so later admissions here reuse a prefix prefilled on
+        ANOTHER replica. Opportunistic — pool exhaustion drops the
+        import silently (it's a cache fill, not a request). Returns
+        blocks newly committed."""
+
+        def job():
+            if self._prefix is None:
+                return 0
+            have = self._prefix.match_blocks(tokens)
+            if len(have) >= n_data_blocks:
+                return 0  # already resident
+            from ray_tpu.serve._internal import kv_plane
+            from ray_tpu.serve._internal.kv_blocks import BlockPoolExhausted
+
+            try:
+                blocks = self._alloc.alloc(n_data_blocks)
+            except BlockPoolExhausted:
+                return 0
+            import jax.numpy as jnp
+
+            dst = kv_plane.pad_block_ids(blocks)
+            self.cache = self._D.jitted_scatter_kv_blocks()(
+                self.cache, jnp.asarray(dst), k, v)
+            committed = tokens[: n_data_blocks * self.block_size]
+            added = self._prefix.insert(committed, blocks)
+            # hand ownership to the cache: drop the alloc refs so the
+            # trie's increfs are the only pins (duplicate blocks for
+            # already-present nodes free right here — leak-audit clean)
+            self._alloc.decref(blocks)
+            self._register_prefix(committed)
+            self._m["prefix_imports"] += 1
+            self._m["migrated_blocks_in"] += added
+            return added
+
+        return self.call_on_loop(job)
 
     def metrics(self) -> Dict[str, Any]:
         """Serving metrics since construction (or reset_metrics()):
@@ -648,11 +909,17 @@ class ContinuousBatchingEngine:
             )
             if self._prefix is not None:
                 m.update(self._prefix.stats())
-        for key, hist in (("ttft", self._ttft), ("tpot", self._tpot)):
+        for key, hist in (("ttft", self._ttft), ("tpot", self._tpot),
+                          ("migration", self._mig)):
             p50, p95, p99 = hist.percentiles_ms()
             m[f"{key}_ms_p50"] = p50
             m[f"{key}_ms_p95"] = p95
             m[f"{key}_ms_p99"] = p99
+        if self.role is not None:
+            # pool label: /api/serve groups each engine's token counters
+            # (prefill_tokens / reused_prefix_tokens / tokens_out) and
+            # migration ledger into per-pool views by this key
+            m["pool"] = self.role
         try:
             g = _engine_metrics()
             g["dpt"].set(m["dispatches_per_token"], tags=self._tags)
@@ -665,6 +932,7 @@ class ContinuousBatchingEngine:
         self._m = {k: 0 for k in self._m}
         self._ttft.reset()
         self._tpot.reset()
+        self._mig.reset()
         self._tel.reset()
         if self._prefix is not None:
             for c in ("hits", "misses", "evictions", "hit_tokens",
@@ -707,9 +975,12 @@ class ContinuousBatchingEngine:
             # plan tick and must not inflate the hit-rate counters —
             # record_lookup() fires once, on the admission that lands
             shared, matched = self._prefix.lookup(req.prompt, record=False)
-        need_total = self._alloc.blocks_for_tokens(
+        # migrating (prefill-pool) requests reserve prompt blocks only:
+        # they ship their KV after the first token, so decode-span
+        # blocks would just starve the prefill pool's admission rate
+        span = len(req.prompt) if req._migrate else \
             len(req.prompt) + req.max_new_tokens
-        )
+        need_total = self._alloc.blocks_for_tokens(span)
         need = need_total - len(shared)
         from ray_tpu.serve._internal.kv_blocks import BlockPoolExhausted
 
@@ -734,12 +1005,14 @@ class ContinuousBatchingEngine:
         self._m["kv_blocks_peak_in_use"] = max(
             self._m["kv_blocks_peak_in_use"], self._alloc.used_blocks
         )
+        self._dec_qtok(req)
         if self._prefix is not None:
             # commit the full prompt blocks NOW: the prefill that fills
             # them rides the same (or an earlier) phase of the very
             # dispatch this plan compiles to, and phases execute in plan
             # order — so even a same-plan admission can share them
             self._prefix.insert(req.prompt, req._blocks)
+            self._register_prefix(req.prompt)
         return True
 
     def _table_row(self, req: Optional[_Request]) -> "np.ndarray":
@@ -773,6 +1046,135 @@ class ContinuousBatchingEngine:
         return {"tables": tables, "temps": temps, "top_ks": top_ks,
                 "top_ps": top_ps, "stops": stops}
 
+    def _admit_resumes(self) -> None:
+        """Import inbound migrations at the plan boundary: for each
+        fetched payload in the resume queue, claim a free slot, reserve
+        the FULL decode span, and land the KV with ONE fused scatter
+        dispatch that also arms the slot (absolute position, remaining
+        budget, recomputed rng). The slot then rides the next plan's
+        phases as an ordinary live lane — the request continues
+        mid-stream exactly where the prefill replica left it. Pool
+        exhaustion leaves the head queued (FIFO, retried next tick)
+        after a prefix-cache evict attempt."""
+        while True:
+            try:
+                self._resuming.append(self._rqueue.get_nowait())
+            except queue.Empty:
+                break
+        if not self._resuming:
+            return
+        import jax.numpy as jnp
+
+        from ray_tpu.serve._internal import kv_plane
+        from ray_tpu.serve._internal.kv_blocks import BlockPoolExhausted
+
+        while self._resuming:
+            req = self._resuming[0]
+            if req.done.is_set():  # cancelled while queued
+                self._resuming.popleft()
+                continue
+            slot = next(
+                (i for i, r in enumerate(self._slots) if r is None), None)
+            if slot is None:
+                return
+            need = self._alloc.blocks_for_tokens(
+                len(req.prompt) + req.max_new_tokens)
+            try:
+                blocks = self._alloc.alloc(need)
+            except BlockPoolExhausted:
+                if self._prefix is not None:
+                    self._prefix.evict(need - self._alloc.free_blocks)
+                try:
+                    blocks = self._alloc.alloc(need)
+                except BlockPoolExhausted:
+                    return
+            self._resuming.popleft()
+            payload, req._resume = req._resume, None
+            req._blocks = blocks
+            req._blocks_freed = False
+            req._start = len(req.prompt)  # fully resident: no prefill owed
+            n_data = payload["n_data"]
+            dst = kv_plane.pad_block_ids(blocks[:n_data])
+            if req.sampling.greedy:
+                rng = np.zeros(2, np.uint32)
+            else:
+                # bit-exact recompute of the carried key the prefill
+                # side's admission would have stored in its slot — rng
+                # state never rides the wire
+                rng = kv_plane.carried_rng_for_seed(req.sampling.seed or 0)
+            self.cache = self._D.jitted_import_kv_blocks()(
+                self.cache, jnp.asarray(dst), payload["k"], payload["v"],
+                jnp.int32(slot), jnp.int32(len(req.prompt)),
+                jnp.int32(req.max_new_tokens - 1), jnp.asarray(rng))
+            self._next_dev = self._next_dev.at[slot].set(
+                jnp.int32(payload["first"]))
+            req.tokens.append(payload["first"])
+            req._t_first = time.perf_counter()  # TTFT was paid at prefill
+            req._remaining = req.max_new_tokens - 1
+            if self.draft_params is not None:
+                req._rounds_est = self._rounds_for(req._remaining) \
+                    if req._remaining > 0 else 0
+                req._rounds_inflight = 0
+            self._slots[slot] = req
+            if self._prefix is not None:
+                self._prefix.insert(req.prompt, req._blocks)
+                self._register_prefix(req.prompt)
+            self._m["migrations_in"] += 1
+            self._m["migrated_blocks_in"] += n_data
+            self._m["kv_blocks_peak_in_use"] = max(
+                self._m["kv_blocks_peak_in_use"], self._alloc.used_blocks)
+            if payload.get("t_export") is not None:
+                # end-to-end handoff latency (cross-process wall clock)
+                self._mig.observe(max(0.0, time.time() - payload["t_export"]))
+            if req._remaining <= 0:
+                # max_new_tokens == 1: the migrated first token IS the
+                # whole answer (prefill normally keeps these local, but
+                # a redispatched resume can land here)
+                self._slots[slot] = None
+                self._free_request_blocks(req)
+                _finish(req, reason="length")
+
+    def _migrate_out(self, req: _Request) -> None:
+        """Export a prefill-pool request's KV at its first token: ONE
+        fused gather + ONE object-plane put (the migration hot path's
+        entire per-handoff cost — lint-pinned), then complete the
+        request with reason "migrated"; the serving layer chains the
+        decode-pool call from req.export. The put synchronizes on the
+        gather before returning, so the blocks free immediately after;
+        the ObjectRef stays alive on req.export until the decode side's
+        reply lands. Export failure is a typed RETRYABLE failure — no
+        output escaped (the first token rides the resume body, not the
+        caller's reply)."""
+        from ray_tpu.serve._internal import kv_plane
+
+        t0 = time.perf_counter()
+        try:
+            n_data = self._alloc.blocks_for_tokens(len(req.prompt))
+            ref, _w = kv_plane.export_kv_blocks(
+                self.cache, req._blocks[:n_data])
+        except Exception as e:  # noqa: BLE001 — device/object-plane errors
+            from ray_tpu.serve.errors import ReplicaDiedError
+
+            self._free_request_blocks(req)
+            _finish(req, exc=ReplicaDiedError(
+                f"kv export failed: {type(e).__name__}: {e}", started=False))
+            self._wake.set()
+            return
+        req.export = {
+            "ref": ref, "ref_hex": ref.hex(), "n_data_blocks": n_data,
+            "block_size": self.block_size, "t_export": time.time(),
+        }
+        self._m["migrations_out"] += 1
+        self._m["migrated_blocks_out"] += n_data
+        self._mig.observe(time.perf_counter() - t0)
+        req._t_done = time.perf_counter()
+        if _finish(req, reason="migrated"):
+            dur = req._t_done - req._t_submit
+            ema = self._ema_service_s
+            self._ema_service_s = dur if ema <= 0.0 else 0.8 * ema + 0.2 * dur
+        self._free_request_blocks(req)
+        self._wake.set()
+
     def _plan(self) -> Optional[List[Dict[str, Any]]]:
         """Plan up to macro_phases phases of admissions + adaptive decode
         chunks purely from host counters. Greedy requests make this
@@ -783,6 +1185,8 @@ class ContinuousBatchingEngine:
         allocations/frees."""
         if self.draft_params is not None:
             return self._plan_spec()
+        if self.paged:
+            self._admit_resumes()
         phases = []
         while len(phases) < self.macro_phases:
             admissions = []
@@ -792,8 +1196,13 @@ class ContinuousBatchingEngine:
                 if self.paged and not self._try_admit_paged(req):
                     break  # pool exhausted: stays queued, FIFO order kept
                 self._waiting.popleft()
+                self._dec_qtok(req)
                 slot = free.pop(0)
-                req._remaining = req.max_new_tokens - 1
+                # migrating requests are prefill-only: zero decode steps
+                # owed here, so the slot frees this very phase and the
+                # device lane goes inactive right after its admission
+                # prefill (rems row 0 in _dispatch_macro)
+                req._remaining = 0 if req._migrate else req.max_new_tokens - 1
                 self._slots[slot] = req
                 admissions.append((slot, req))
             live = [(s, r) for s, r in enumerate(self._slots)
@@ -813,7 +1222,12 @@ class ContinuousBatchingEngine:
             for s, r in enumerate(self._slots):
                 if r is not None and r._remaining == 0:
                     self._slots[s] = None  # evict: freed for the next phase
-                    self._free_request_blocks(r)
+                    if not r._migrate:
+                        # a migrating request's blocks must survive to
+                        # the export gather (fired from _deliver when
+                        # its first token resolves) — _migrate_out and
+                        # the _deliver stop/cancel paths free them
+                        self._free_request_blocks(r)
             phases.append({"steps": steps, "admissions": admissions,
                            "takes": takes, **snapshot})
         return phases or None
@@ -837,6 +1251,7 @@ class ContinuousBatchingEngine:
         estimated rides its planned rounds emitting zero-count rows (the
         device zeroed its `remaining`); a lane that finishes later gets
         more rounds planned after the resync."""
+        self._admit_resumes()
         phases = []
         while len(phases) < self.macro_phases:
             admissions = []
@@ -847,7 +1262,7 @@ class ContinuousBatchingEngine:
                     break  # pool exhausted: stays queued, FIFO order kept
                 self._waiting.popleft()
                 slot = free.pop(0)
-                req._remaining = req.max_new_tokens - 1
+                req._remaining = 0 if req._migrate else req.max_new_tokens - 1
                 req._rounds_est = self._rounds_for(req._remaining) \
                     if req._remaining > 0 else 0
                 req._rounds_inflight = 0
@@ -935,7 +1350,11 @@ class ContinuousBatchingEngine:
                     prompts[k, a, : len(req.prompt)] = req.prompt
                     lengths[k, a] = len(req.prompt)
                 slots[k, a] = slot
-                rems[k, a] = req.max_new_tokens - 1
+                # migrating rows arm ZERO decode steps: the admission
+                # prefill still samples their first token, then the lane
+                # goes inactive (writes aim at the null block) — decode
+                # happens on the importing replica
+                rems[k, a] = 0 if req._migrate else req.max_new_tokens - 1
         t0 = time.perf_counter()
         try:
             if self.paged:
@@ -1066,15 +1485,21 @@ class ContinuousBatchingEngine:
                 self._slots[s] = None
                 self._free_request_blocks(r)
         if any(r.done.is_set() for r in self._waiting):
+            for r in self._waiting:
+                if r.done.is_set():
+                    self._dec_qtok(r)
             self._waiting = deque(
                 r for r in self._waiting if not r.done.is_set())
 
     def _loop_macro(self) -> None:
         while self._running:
             self._drain_queue()
+            self._drain_jobs()
             self._shed_expired()
             self._repair()
-            if not self._waiting and not any(r is not None for r in self._slots):
+            if (not self._waiting
+                    and not any(r is not None for r in self._slots)
+                    and self._rqueue.empty() and not self._resuming):
                 while self._pending:
                     self._resolve(self._pending.popleft())
                 self._repair()
@@ -1111,6 +1536,7 @@ class ContinuousBatchingEngine:
         batch: List[tuple] = []
         while free and self._waiting:
             slot, req = free.pop(0), self._waiting.popleft()
+            self._dec_qtok(req)
             # claim the slot BEFORE the prefill dispatch so a failed
             # dispatch still leaves the request reachable by _die
             self._slots[slot] = req
@@ -1247,6 +1673,11 @@ class ContinuousBatchingEngine:
             # cancel, timeout): these planned steps produced tokens
             # nobody wants — the plan-and-repair bill
             self._m["wasted_steps"] += len(toks)
+            if req._migrate:
+                # cancelled before its first token resolved: plan-time
+                # eviction skipped this request's free expecting an
+                # export that now never happens
+                self._free_request_blocks(req)
             return
         stopped = False
         stop_set = req.sampling.stop
@@ -1281,6 +1712,14 @@ class ContinuousBatchingEngine:
                 ema = self._ema_service_s
                 self._ema_service_s = dur if ema <= 0.0 else 0.8 * ema + 0.2 * dur
                 self._wake.set()  # repair promptly: slot + blocks are free
+            if req._migrate:
+                # stopped AT its first token: finished here, no export —
+                # reclaim the blocks plan-time eviction left pinned
+                self._free_request_blocks(req)
+        elif req._migrate:
+            # first token resolved and the request is live: hand off to
+            # the decode pool (gather + put + finish("migrated"))
+            self._migrate_out(req)
 
     def _resolve(self, entry) -> None:
         """Fetch one macro-step's (or legacy chunk's) tokens — the only
@@ -1388,12 +1827,26 @@ class ContinuousBatchingEngine:
         self._slots = [None] * self.n_slots
         doomed.update(self._waiting)
         self._waiting.clear()
+        doomed.update(self._resuming)
+        self._resuming.clear()
         while True:
             try:
                 doomed.add(self._queue.get_nowait())
             except queue.Empty:
                 break
+        while True:
+            try:
+                doomed.add(self._rqueue.get_nowait())
+            except queue.Empty:
+                break
+        while True:
+            try:
+                _fn, fut = self._jobs.get_nowait()
+                fut.set_exception(RuntimeError(f"engine died: {msg}"))
+            except queue.Empty:
+                break
         for req in doomed:
+            self._dec_qtok(req)
             self._free_request_blocks(req)
             _finish(req, error=msg, exc=ReplicaDiedError(
                 f"engine died: {msg}", started=len(req.tokens) > 0))

@@ -1,0 +1,105 @@
+"""Source-shape lints for the KV plane's hot-path discipline.
+
+The disaggregation design promises exactly ONE object-plane put per
+handoff, ONE get per resume, and ONE digest + ONE inventory probe per
+routed request. Those invariants are easy to erode one refactor at a
+time (a second put "for safety", a re-hash in a helper), and nothing
+functional breaks when they do — the system just gets quietly slower.
+These lints pin the counts with inspect.getsource so the erosion is a
+test failure, not a perf regression three PRs later.
+
+(Same idiom as the other test_lint_* files: count CALL forms — the
+name followed by an open paren — so docstrings and comments that
+mention an API don't trip the lint.)
+"""
+import inspect
+
+from ray_tpu.serve import handle as handle_mod
+from ray_tpu.serve import llm as llm_mod
+from ray_tpu.serve import llm_engine as engine_mod
+from ray_tpu.serve._internal import kv_plane
+
+
+def _calls(fn, name):
+    return inspect.getsource(fn).count(name + "(")
+
+
+# ---------------------------------------------------- one put per handoff
+def test_export_kv_blocks_is_the_only_put():
+    """The wire discipline lives in ONE place: export_kv_blocks does
+    exactly one fused gather and one object-plane put."""
+    assert _calls(kv_plane.export_kv_blocks, "ray_tpu.put") == 1
+    src = inspect.getsource(kv_plane.export_kv_blocks)
+    assert src.count("gather_kv_blocks(") == 1
+
+
+def test_migrate_out_delegates_single_put():
+    """The engine's migration path never puts directly — it delegates
+    to export_kv_blocks exactly once, so a handoff can never double-put."""
+    fn = engine_mod.ContinuousBatchingEngine._migrate_out
+    assert _calls(fn, "ray_tpu.put") == 0
+    assert _calls(fn, "kv_plane.export_kv_blocks") == 1
+
+
+def test_prefix_export_single_put():
+    """Cluster-cache prefix export is also one put per export call."""
+    assert _calls(engine_mod.ContinuousBatchingEngine.export_prefix,
+                  "ray_tpu.put") == 1
+
+
+def test_no_stray_puts_in_serving_modules():
+    """No other serving-layer code talks to the object plane on the
+    request path: every put in llm.py / handle.py / kv_plane.py is one
+    of the two audited call sites above."""
+    assert inspect.getsource(llm_mod).count("ray_tpu.put(") == 0
+    assert inspect.getsource(handle_mod).count("ray_tpu.put(") == 0
+    assert inspect.getsource(kv_plane).count("ray_tpu.put(") == 1
+
+
+# ----------------------------------------------------- one get per resume
+def test_fetch_kv_payload_is_the_only_get():
+    assert _calls(kv_plane.fetch_kv_payload, "ray_tpu.get") == 1
+    # and the whole module performs no other object-plane reads
+    assert inspect.getsource(kv_plane).count("ray_tpu.get(") == 1
+
+
+def test_resume_path_fetches_once():
+    """A resume body is materialized with exactly one payload fetch —
+    the decode side never re-reads the ref."""
+    fn = llm_mod._LLMServer._call_resume
+    assert _calls(fn, "fetch_kv_payload") == 1
+    assert _calls(fn, "ray_tpu.get") == 0
+
+
+# --------------------------------- one digest + one probe per request
+def test_router_hashes_once_per_request():
+    """DeploymentHandle.remote computes the affinity digest exactly
+    once; _route_affinity consults the cluster inventory at most once."""
+    assert _calls(handle_mod.DeploymentHandle.remote,
+                  "_affinity_digest") == 1
+    assert _calls(handle_mod.DeploymentHandle._route_affinity,
+                  "owner_of") == 1
+    assert _calls(handle_mod.DeploymentHandle._route_affinity,
+                  "prefix_digest") == 0
+
+
+def test_replica_prefetch_hashes_and_probes_once():
+    """The replica-side prefetch hook re-derives the digest once and
+    probes the inventory once per request — never per candidate peer."""
+    fn = llm_mod._LLMServer._maybe_prefetch_prefix
+    assert _calls(fn, "prefix_digest") == 1
+    assert _calls(fn, "owner_of") == 1
+
+
+def test_digest_has_single_definition():
+    """prefix_digest is THE digest: the handle's affinity hash and the
+    engine's inventory keys both route through kv_plane.prefix_digest,
+    so the two can never drift apart."""
+    assert _calls(kv_plane.prefix_digest, "md5") == 1
+    # the handle's token digest is md5 over the same window (the
+    # equality is asserted behaviorally in test_kv_plane.py); the ring
+    # and model-id hashes in handle.py hash names, not tokens
+    assert _calls(handle_mod.DeploymentHandle._affinity_digest, "md5") == 1
+    assert inspect.getsource(engine_mod).count("md5(") == 0
+    assert _calls(engine_mod.ContinuousBatchingEngine.kv_inventory,
+                  "md5") == 0
