@@ -1768,6 +1768,78 @@ def bench_serve_fault(extra):
     _settle()
 
 
+def bench_serve_lifeline(extra):
+    """Request-lifeline overhead gate: the lifeline + flight-recorder
+    layer must cost ≤ 1% of steady-state engine throughput. Paired
+    interleaved A/B on ONE in-process tiny engine — the ON arm runs the
+    default recorder, the OFF arm swaps in a kill-switched recorder
+    (the RAY_TPU_FLIGHT_RECORDER=0 path: write() no-ops before touching
+    state) — so both arms share the compiled programs, the process, and
+    the same background noise."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models import llama
+        from ray_tpu.observability import flight_recorder
+        from ray_tpu.serve.llm_engine import ContinuousBatchingEngine
+
+        cfg = llama.LlamaConfig.tiny(
+            dtype=jnp.float32, attn_impl="blockwise", remat=False
+        )
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        eng = ContinuousBatchingEngine(
+            params, cfg, n_slots=4, chunk=4, macro_phases=2,
+            paged=True, block_size=8, n_blocks=128,
+        )
+        on_rec = eng._fr
+        off_rec = flight_recorder.FlightRecorder(enabled=False)
+        rng = np.random.default_rng(0)
+        prompts = [[int(t) for t in rng.integers(1, 400, size=12)]
+                   for _ in range(8)]
+
+        rounds = iter(range(10_000))
+
+        def _round(arm):
+            rec = on_rec if arm == "on" else off_rec
+            eng._fr = rec
+            flight_recorder._recorder = rec  # lifeline's ring sink
+            rnd = next(rounds)
+            t0 = time.perf_counter()
+            # rids on: serve traffic always carries one, and the rid is
+            # what routes the per-request events through the lifeline
+            # store + ring (the layer under test)
+            reqs = [eng.submit(p, 16, rid=f"bench-{rnd}-{i}")
+                    for i, p in enumerate(prompts)]
+            for r in reqs:
+                assert r.done.wait(300) and r.error is None, r.error
+            dt = time.perf_counter() - t0
+            return sum(len(r.tokens) for r in reqs) / dt
+
+        _round("on"), _round("off")  # warm both arms past compiles
+        on_s, off_s = [], []
+        for _ in range(6):  # interleaved ABAB: drift hits both arms
+            on_s.append(_round("on"))
+            off_s.append(_round("off"))
+        on_med = sorted(on_s)[len(on_s) // 2]
+        off_med = sorted(off_s)[len(off_s) // 2]
+        overhead_pct = round((off_med - on_med) / off_med * 100.0, 2)
+        extra["serve_lifeline_tok_s_on"] = round(on_med, 1)
+        extra["serve_lifeline_tok_s_off"] = round(off_med, 1)
+        extra["serve_lifeline_overhead_pct"] = overhead_pct
+        extra["serve_lifeline_ring_events"] = on_rec.events_written
+        log(f"[bench] serve_lifeline: {on_med:.1f} tok/s recorder-on vs "
+            f"{off_med:.1f} tok/s off — overhead {overhead_pct}% "
+            f"({on_rec.events_written} ring events)")
+        eng._fr = on_rec
+        flight_recorder._recorder = on_rec
+        eng.shutdown()
+    except Exception as e:
+        log(f"[bench] serve_lifeline bench skipped: {e}")
+    _settle()
+
+
 def bench_serve_disagg(extra):
     """Disaggregated prefill/decode A/B at FIXED aggregate chips
     (ISSUE 18): (1) burst of long-prompt requests against a unified
@@ -1918,6 +1990,7 @@ def main():
     bench_dispatch(extra)
     bench_serve_scale(extra)
     bench_serve_fault(extra)
+    bench_serve_lifeline(extra)
     bench_serve_disagg(extra)
     bench_broadcast(extra)
     bench_data_pipeline(extra)

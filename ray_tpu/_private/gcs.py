@@ -1476,10 +1476,26 @@ class GcsServer:
                 n += 1
         return n
 
+    async def _rpc_telemetry_epoch(self, d, conn):
+        """Bump the telemetry epoch fence for a kind (None = all kinds).
+        Reads after this exclude snapshots published BEFORE the fence —
+        the A/B hygiene primitive: a paired run's second arm must not
+        read the first arm's dead reporters riding out the 120s
+        retention window (observability.reset_epoch)."""
+        if not hasattr(self, "telemetry_epochs"):
+            self.telemetry_epochs: Dict[str, float] = {}
+        now = time.time()
+        self.telemetry_epochs[d.get("kind") or "*"] = now
+        return now
+
     async def _rpc_telemetry_get(self, d, conn):
-        """Snapshots for one kind, stale reporters (>120s) dropped."""
-        table = getattr(self, "telemetry", {}).get(d.get("kind", ""), {})
-        cutoff = time.time() - 120
+        """Snapshots for one kind, stale reporters (>120s) dropped and
+        pre-epoch snapshots fenced out (see telemetry.epoch)."""
+        kind = d.get("kind", "")
+        table = getattr(self, "telemetry", {}).get(kind, {})
+        epochs = getattr(self, "telemetry_epochs", {})
+        cutoff = max(time.time() - 120,
+                     epochs.get(kind, 0.0), epochs.get("*", 0.0))
         return {
             reporter[:12]: rec["snapshot"]
             for reporter, rec in table.items()

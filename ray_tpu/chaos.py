@@ -318,8 +318,9 @@ class ServeChaosInjector:
       wedge (health pings, stat reports and requests all stall for the
       window — what a stuck driver looks like from outside).
 
-    ``fired`` records ``{"t_s", "kind", "replica"}`` per applied event
-    for the loadgen report's chaos section.
+    ``fired`` records ``{"t_s", "kind", "replica", "pid"}`` per applied
+    event (pid only for kills — the flight-recorder post-mortem key) for
+    the loadgen report's chaos section.
     """
 
     def __init__(self, schedule: ChaosSchedule, app_name: str,
@@ -384,6 +385,7 @@ class ServeChaosInjector:
 
         import ray_tpu
 
+        pid = None
         names = self._members()
         if not names:
             raise RuntimeError("no live replicas to target")
@@ -407,4 +409,7 @@ class ServeChaosInjector:
         else:  # pragma: no cover — schedule validation rejects these
             raise ValueError(f"unknown chaos kind {event.kind}")
         logger.info("chaos: %s replica %s (t=%.2fs)", event.kind, name, event.t_s)
-        self.fired.append({"t_s": event.t_s, "kind": event.kind, "replica": name})
+        # the victim's pid rides the record: post-mortem assertions read
+        # the SIGKILLed worker's flight-recorder ring by pid
+        self.fired.append({"t_s": event.t_s, "kind": event.kind,
+                           "replica": name, "pid": pid})

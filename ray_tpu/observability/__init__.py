@@ -38,6 +38,7 @@ __all__ = [
     "publish_snapshot",
     "fetch_snapshots",
     "prune_snapshot_key",
+    "reset_epoch",
     "flush",
     "flush_async",
     "snapshot",
@@ -80,6 +81,27 @@ def prune_snapshot_key(kind: str, key: str, timeout: float = 5.0) -> int:
         ) or 0)
     except Exception:
         return 0
+
+
+def reset_epoch(kind: Optional[str] = None, timeout: float = 5.0) -> float:
+    """Start a fresh telemetry epoch: bump the GCS table's generation
+    fence so `fetch_snapshots` excludes every snapshot published BEFORE
+    this call. `kind=None` fences all kinds.
+
+    This is the A/B hygiene primitive: the table retains a dead
+    reporter's last snapshot for up to 120s, so a paired run starting
+    inside that window used to read the previous arm's corpses (the
+    PR-8 loadgen worked around it by scraping live replicas directly —
+    that workaround is now just a fallback). Returns the epoch
+    timestamp (0.0 when no cluster is reachable)."""
+    try:
+        from ray_tpu._private.worker import get_global_core
+
+        return float(get_global_core().gcs_request(
+            "telemetry.epoch", {"kind": kind}, timeout=timeout
+        ) or 0.0)
+    except Exception:
+        return 0.0
 
 
 # driver-side extras merged into the published snapshot per kind

@@ -38,6 +38,14 @@ def _span_events(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         if s.get("kind") == "DEVICE":
             pid, tid = "device", f"{s.get('device', '?')}/{s.get('step_name', '?')}"
             cat = "device_step"
+        elif s.get("kind") == "LIFELINE":
+            # request lifelines: one row PER RID, so a request's
+            # submit → route → admit → kv_export → resume → finish
+            # reads left-to-right on a single track even when the
+            # events came from different processes (prefill replica,
+            # KV plane, decode replica) — the rid stitches them
+            pid, tid = "lifeline", (s.get("rid") or "?")[:24]
+            cat = "lifeline"
         else:
             pid, tid = "rpc", (s.get("trace_id") or "?")[:12]
             cat = "span"
@@ -49,6 +57,10 @@ def _span_events(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             args["status"] = s["status"]
         if s.get("links"):
             args["links"] = s["links"]
+        if s.get("kind") == "LIFELINE":
+            for k in ("rid", "where", "replica"):
+                if s.get(k):
+                    args[k] = s[k]
         events.append({
             "name": s.get("name", "span"), "cat": cat, "ph": "X",
             "ts": start * 1e6, "dur": max(0.0, (end - start)) * 1e6,
@@ -73,6 +85,28 @@ def _span_events(spans: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             "ts": s.get("start", 0.0) * 1e6,
             "pid": "device", "tid": f"{s.get('device', '?')}/{s.get('step_name', '?')}",
         })
+    # rid-keyed flow arrows chain a request's consecutive lifeline
+    # events so Perfetto draws the cross-replica hop (prefill kv_export
+    # → decode resume_submit) as one connected path
+    by_rid: Dict[str, List[Dict[str, Any]]] = {}
+    for s in spans:
+        if s.get("kind") == "LIFELINE" and s.get("rid"):
+            by_rid.setdefault(s["rid"], []).append(s)
+    for rid, chain in by_rid.items():
+        chain.sort(key=lambda s: s.get("start", 0.0))
+        for i in range(len(chain) - 1):
+            a, b = chain[i], chain[i + 1]
+            fid = f"lifeline:{rid}:{i}"
+            events.append({
+                "name": "lifeline", "cat": "ctx", "ph": "s", "id": fid,
+                "ts": a.get("start", 0.0) * 1e6,
+                "pid": "lifeline", "tid": rid[:24],
+            })
+            events.append({
+                "name": "lifeline", "cat": "ctx", "ph": "f", "bp": "e",
+                "id": fid, "ts": b.get("start", 0.0) * 1e6,
+                "pid": "lifeline", "tid": rid[:24],
+            })
     return events
 
 

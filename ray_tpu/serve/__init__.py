@@ -15,6 +15,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     deployment,
     get_app_handle,
     get_deployment_handle,
+    request_timeline,
     run,
     shutdown,
     status,
@@ -25,6 +26,7 @@ from ray_tpu.serve._internal.autoscaler import (  # noqa: F401
     AutoscalingConfig,
 )
 from ray_tpu.serve._internal.sampling import SamplingParams  # noqa: F401
+from ray_tpu.serve._internal.slo import SloConfig  # noqa: F401
 from ray_tpu.serve.config import build_app, deploy_config  # noqa: F401
 from ray_tpu.serve.errors import (  # noqa: F401
     DeadlineExceededError,

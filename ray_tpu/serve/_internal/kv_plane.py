@@ -137,13 +137,16 @@ def pad_block_ids(blocks: Sequence[int]) -> "Any":
     return out
 
 
-def export_kv_blocks(cache: Dict[str, Any], blocks: Sequence[int]):
+def export_kv_blocks(cache: Dict[str, Any], blocks: Sequence[int],
+                     rid: Optional[str] = None):
     """Lift `blocks` out of a paged pool and publish them to the object
     plane. ONE fused gather dispatch + ONE ray_tpu.put per call — the
     migration hot path's pinned cost (tests/test_lint_kv_plane.py).
     Returns (ObjectRef, padded_width). The put serializes via the
     dlpack path, which synchronizes on the gather's result, so callers
-    may free the source blocks the moment this returns."""
+    may free the source blocks the moment this returns. `rid` stamps a
+    ``kv_put`` event on the request's lifeline (per-handoff, never
+    per-block — the lint budget is unchanged)."""
     import jax.numpy as jnp
 
     import ray_tpu
@@ -152,17 +155,39 @@ def export_kv_blocks(cache: Dict[str, Any], blocks: Sequence[int]):
     ids = pad_block_ids(blocks)
     k, v = D.jitted_gather_kv_blocks()(cache, jnp.asarray(ids))
     ref = ray_tpu.put({"k": k, "v": v, "n": len(blocks)})
+    if rid:
+        try:
+            from ray_tpu.observability import lifeline
+
+            lifeline.record(rid, "kv_put", blocks=len(blocks),
+                            ref=ref.hex()[:16], a=float(len(blocks)))
+        except Exception:
+            pass
     return ref, len(ids)
 
 
-def fetch_kv_payload(ref_hex: str, timeout: float = 30.0) -> Dict[str, Any]:
+def fetch_kv_payload(ref_hex: str, timeout: float = 30.0,
+                     rid: Optional[str] = None) -> Dict[str, Any]:
     """The import side's ONE object-plane get: resolve the exporter's
     ref (hex form — refs ride request bodies as strings) into the
-    {"k", "v", "n"} payload of device arrays."""
+    {"k", "v", "n"} payload of device arrays. `rid` stamps a
+    ``resume_fetch`` event on the request's lifeline."""
     import ray_tpu
     from ray_tpu._private.object_ref import ObjectRef
 
-    return ray_tpu.get(ObjectRef(bytes.fromhex(ref_hex)), timeout=timeout)
+    t0 = time.perf_counter()
+    payload = ray_tpu.get(ObjectRef(bytes.fromhex(ref_hex)), timeout=timeout)
+    if rid:
+        try:
+            from ray_tpu.observability import lifeline
+
+            lifeline.record(rid, "resume_fetch", ref=ref_hex[:16],
+                            fetch_ms=round(
+                                (time.perf_counter() - t0) * 1e3, 3),
+                            a=(time.perf_counter() - t0) * 1e3)
+        except Exception:
+            pass
+    return payload
 
 
 # ---------------------------------------------------------- resume body

@@ -1,0 +1,226 @@
+"""Serve SLO plane: per-deployment objectives, attainment, burn rates.
+
+Mooncake-style serving is operated on three numbers — TTFT, TPOT and
+availability — so the deployment API takes them as a first-class
+``slo_config`` and the controller folds the telemetry the engines
+already publish (latency histograms' p99s, shed/deadline counters, the
+health loop's lost-request ledger) into an operating signal:
+
+- ATTAINMENT: is the measured p99 under the target right now, and by
+  how much (headroom, signed — negative means the target is blown).
+- AVAILABILITY + BURN RATE: availability counts a request as *bad*
+  when it was shed, expired past its deadline, or was in flight on a
+  replica that died. The burn rate is the SRE multi-window form:
+  ``(bad / total) / (1 - availability_target)`` over a FAST window
+  (default 60 s — pages) and a SLOW window (default 300 s — tickets).
+  Burn 1.0 means the error budget is being spent exactly at the rate
+  that exhausts it at the window's end; >> 1 means the deployment is
+  on fire regardless of what the lifetime average still says.
+
+Everything here is controller-side arithmetic over snapshots fetched
+ONCE per control tick — the request hot paths never see this module.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Optional, Tuple
+
+# burn-rate windows (seconds): fast (paging) and slow (ticketing)
+BURN_WINDOWS_S = (60.0, 300.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class SloConfig:
+    """Per-deployment serving objectives.
+
+    ttft_p99_ms: target 99th-percentile time-to-first-token (ms).
+    tpot_p99_ms: target 99th-percentile time-per-output-token (ms).
+    availability: target fraction of requests NOT shed/expired/lost,
+        e.g. 0.999. The error budget is ``1 - availability``.
+    """
+
+    ttft_p99_ms: Optional[float] = None
+    tpot_p99_ms: Optional[float] = None
+    availability: Optional[float] = None
+
+    def __post_init__(self):
+        for knob in ("ttft_p99_ms", "tpot_p99_ms"):
+            v = getattr(self, knob)
+            if v is not None and not v > 0:
+                raise ValueError(
+                    f"slo_config: {knob} must be > 0, got {v}")
+        if self.availability is not None and not (
+                0.0 < self.availability <= 1.0):
+            raise ValueError(
+                f"slo_config: availability must be in (0, 1], got "
+                f"{self.availability}")
+        if (self.ttft_p99_ms is None and self.tpot_p99_ms is None
+                and self.availability is None):
+            raise ValueError(
+                "slo_config: at least one objective required "
+                "(ttft_p99_ms / tpot_p99_ms / availability)")
+
+
+_SLO_KEYS = tuple(f.name for f in dataclasses.fields(SloConfig))
+
+
+def validate_slo_config(cfg: Optional[Dict[str, Any]]
+                        ) -> Optional[Dict[str, Any]]:
+    """Validate a user slo_config dict at deployment() time."""
+    if cfg is None:
+        return None
+    if not isinstance(cfg, dict):
+        raise ValueError(
+            f"slo_config must be a dict, got {type(cfg).__name__}")
+    unknown = set(cfg) - set(_SLO_KEYS)
+    if unknown:
+        raise ValueError(
+            f"slo_config: unknown key(s) {sorted(unknown)}; valid "
+            f"keys: {sorted(_SLO_KEYS)}")
+    return dataclasses.asdict(SloConfig(**cfg))
+
+
+def _worst(vals):
+    vals = [v for v in vals if v is not None]
+    return max(vals) if vals else None
+
+
+class SloState:
+    """One deployment's SLO evaluator: feed it per-tick cumulative
+    counters + current latency percentiles; read ``snapshot()``.
+
+    The availability stream rides CUMULATIVE counters (completed /
+    shed / lost since engine start), so the evaluator works from
+    samples and window deltas — a missed tick loses resolution, never
+    correctness. Replica churn can step counters backwards (a fresh
+    engine restarts at zero); deltas clamp at 0 so a restart reads as
+    "no new traffic", not negative traffic.
+    """
+
+    def __init__(self, cfg: Dict[str, Any],
+                 windows_s: Tuple[float, ...] = BURN_WINDOWS_S):
+        self.cfg = dict(cfg)
+        self.windows_s = tuple(windows_s)
+        # (t, good_cum, bad_cum) samples covering the longest window
+        self._samples: Deque[Tuple[float, float, float]] = deque()
+        self._last: Optional[Tuple[float, float]] = None  # (good, bad) cum
+        self._good = 0.0   # monotonic, restart-proof accumulation
+        self._bad = 0.0
+        self._latest: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------ feeding
+    def observe(self, good_cum: float, bad_cum: float,
+                ttft_p99_ms: Optional[float] = None,
+                tpot_p99_ms: Optional[float] = None,
+                now: Optional[float] = None) -> None:
+        """One evaluator tick. `good_cum`/`bad_cum` are the summed
+        cumulative counters across the deployment's live engines plus
+        the controller's lost-request ledger; percentiles are the worst
+        (max) across replicas — an SLO is blown if ANY replica blows
+        it."""
+        if now is None:
+            now = time.time()
+        if self._last is not None:
+            dg = max(0.0, good_cum - self._last[0])
+            db = max(0.0, bad_cum - self._last[1])
+        else:
+            dg, db = max(0.0, good_cum), max(0.0, bad_cum)
+        self._last = (good_cum, bad_cum)
+        self._good += dg
+        self._bad += db
+        self._samples.append((now, self._good, self._bad))
+        horizon = now - max(self.windows_s) - 5.0
+        while self._samples and self._samples[0][0] < horizon:
+            self._samples.popleft()
+        self._latest = {"t": now, "ttft_p99_ms": ttft_p99_ms,
+                        "tpot_p99_ms": tpot_p99_ms}
+
+    # ------------------------------------------------------------ reading
+    def _window_rate(self, window_s: float, now: float
+                     ) -> Tuple[float, float]:
+        """(good, bad) deltas over the trailing window."""
+        if not self._samples:
+            return 0.0, 0.0
+        cutoff = now - window_s
+        base = None
+        for t, g, b in self._samples:
+            if t >= cutoff:
+                break
+            base = (g, b)
+        end = self._samples[-1]
+        if base is None:
+            base = (0.0, 0.0)
+        return max(0.0, end[1] - base[0]), max(0.0, end[2] - base[1])
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The published ``slo:<app>::<dep>`` payload: per-objective
+        target / observed / attained plus multi-window burn rates."""
+        if now is None:
+            now = time.time()
+        out: Dict[str, Any] = {"config": dict(self.cfg), "time": now}
+        lat = self._latest
+        for key in ("ttft_p99_ms", "tpot_p99_ms"):
+            target = self.cfg.get(key)
+            if target is None:
+                continue
+            observed = lat.get(key)
+            entry: Dict[str, Any] = {"target": target,
+                                     "observed": observed}
+            if observed is not None:
+                entry["attained"] = bool(observed <= target)
+                # signed headroom: +40 means the p99 is running at 60%
+                # of target; negative means the target is blown by that %
+                entry["headroom_pct"] = round(
+                    100.0 * (target - observed) / target, 1)
+            out[key] = entry
+        target_av = self.cfg.get("availability")
+        if target_av is not None:
+            total = self._good + self._bad
+            observed_av = (self._good / total) if total > 0 else None
+            entry = {"target": target_av, "observed":
+                     round(observed_av, 6) if observed_av is not None
+                     else None,
+                     "good": int(self._good), "bad": int(self._bad)}
+            if observed_av is not None:
+                entry["attained"] = bool(observed_av >= target_av)
+            budget = max(1e-9, 1.0 - target_av)
+            burn: Dict[str, Any] = {}
+            for w in self.windows_s:
+                g, b = self._window_rate(w, now)
+                tot = g + b
+                burn[f"{int(w)}s"] = round(
+                    (b / tot) / budget, 3) if tot > 0 else 0.0
+            entry["burn_rate"] = burn
+            out["availability"] = entry
+        atts = [v.get("attained") for k, v in out.items()
+                if isinstance(v, dict) and "attained" in v]
+        if atts:
+            out["attained"] = bool(all(atts))
+        return out
+
+
+def fold_engine_metrics(engines: Dict[str, Dict[str, Any]],
+                        lost_requests: int = 0) -> Dict[str, Any]:
+    """Collapse the per-replica ``engine:<name>`` telemetry snapshots
+    of ONE deployment into the evaluator's inputs: summed good/bad
+    cumulative counters and worst-case p99s. `lost_requests` is the
+    controller's ledger of requests in flight on replicas declared
+    dead (the third bad-request source — engines can't count their own
+    death)."""
+    good = 0.0
+    bad = float(lost_requests)
+    ttfts, tpots = [], []
+    for m in engines.values():
+        if not isinstance(m, dict):
+            continue
+        good += float(m.get("requests_completed") or 0)
+        bad += float(m.get("shed_requests")
+                     or (m.get("shed_queue_full", 0)
+                         + m.get("shed_eta", 0)))
+        bad += float(m.get("deadline_expired") or 0)
+        ttfts.append(m.get("ttft_ms_p99"))
+        tpots.append(m.get("tpot_ms_p99"))
+    return {"good": good, "bad": bad,
+            "ttft_p99_ms": _worst(ttfts), "tpot_p99_ms": _worst(tpots)}

@@ -62,6 +62,7 @@ class Deployment:
         affinity_config: Optional[dict] = None,
         fault_config: Optional[dict] = None,
         pool_config: Optional[dict] = None,
+        slo_config: Optional[dict] = None,
     ):
         from ray_tpu.serve._internal.autoscaler import (
             validate_affinity_config,
@@ -69,6 +70,7 @@ class Deployment:
             validate_fault_config,
             validate_pool_config,
         )
+        from ray_tpu.serve._internal.slo import validate_slo_config
 
         self._callable = cls_or_fn
         self.name = name or getattr(cls_or_fn, "__name__", "deployment")
@@ -97,6 +99,12 @@ class Deployment:
         # by the KV plane (serve/_internal/kv_plane.py); replica counts
         # here REPLACE num_replicas
         self.pool_config = validate_pool_config(pool_config)
+        # {"ttft_p99_ms", "tpot_p99_ms", "availability"} — serving
+        # objectives: the controller evaluates attainment + burn rates
+        # each tick and publishes `slo:<app>::<dep>` snapshots
+        # (serve/_internal/slo.py). Validated HERE, same contract as the
+        # other configs: bad targets raise at deployment() time.
+        self.slo_config = validate_slo_config(slo_config)
         if self.pool_config is not None:
             self.num_replicas = sum(self.pool_config.values())
         if (self.autoscaling_config or {}).get("pools") and self.pool_config is None:
@@ -117,6 +125,7 @@ class Deployment:
             affinity_config=self.affinity_config,
             fault_config=self.fault_config,
             pool_config=self.pool_config,
+            slo_config=self.slo_config,
         )
         merged.update(kw)
         return Deployment(self._callable, **merged)
@@ -176,6 +185,7 @@ def _deploy_tree(controller, app_name: str, app: Application, *, is_root: bool,
             dep.affinity_config,
             dep.fault_config,
             dep.pool_config,
+            dep.slo_config,
         )
     )
     seen[id(app)] = dep.name
@@ -219,6 +229,24 @@ def delete(app_name: str = "default"):
 def status() -> Dict[str, Any]:
     controller = _get_controller()
     return ray_tpu.get(controller.status.remote())
+
+
+def request_timeline(rid: str) -> List[Dict[str, Any]]:
+    """The cluster-wide lifeline of one request id: driver-process
+    events (handle-side submit/route/redispatch) merged with the
+    controller's per-replica fan-out (engine-side admit/dispatch/
+    kv_export/resume/finish — the prefill→decode migration hop stitches
+    because the rid survives it), time-sorted."""
+    from ray_tpu.observability import lifeline
+
+    merged = [dict(e) for e in lifeline.events(rid)]
+    try:
+        controller = _get_controller()
+        merged.extend(ray_tpu.get(controller.request_timeline.remote(rid)))
+    except Exception:
+        pass
+    merged.sort(key=lambda e: e.get("t", 0.0))
+    return merged
 
 
 def shutdown():

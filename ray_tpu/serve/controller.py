@@ -71,12 +71,23 @@ def _fetch_replica_stats() -> Dict[str, Dict[str, Any]]:
     from ray_tpu.observability import fetch_snapshots
 
     out: Dict[str, Dict[str, Any]] = {}
+    engines: Dict[str, Dict[str, Any]] = {}
     for snap in fetch_snapshots("serve", timeout=2.0).values():
         if not isinstance(snap, dict):
             continue
         for key, val in snap.items():
-            if isinstance(key, str) and key.startswith("replica:") and isinstance(val, dict):
+            if not (isinstance(key, str) and isinstance(val, dict)):
+                continue
+            if key.startswith("replica:"):
                 out[key[len("replica:"):]] = val
+            elif key.startswith("engine:"):
+                # engine metric snapshots ride along for the SLO
+                # evaluator (joined to replicas by pid: engine name is
+                # `llm-<pid>`, replica payloads carry "pid") — stashed
+                # under a reserved key so replica-name lookups
+                # (`SERVE_REPLICA::...`) can never collide
+                engines[key[len("engine:"):]] = val
+    out["__engines__"] = engines
     return out
 
 
@@ -348,6 +359,14 @@ class ServeControllerActor:
         # loops: both tick at ~1s, so without the cache the controller
         # would pay two identical full-table GCS fetches per second
         self._stats_cache: tuple = (0.0, {})
+        # SLO plane: per-deployment evaluator state (burn windows +
+        # cumulative good/bad), the lost-request ledger (in-flight
+        # estimates of replicas declared dead — the bad-request source
+        # engines can't count themselves), and the flight-recorder
+        # post-mortems read off SIGKILLed replicas' /dev/shm rings
+        self._slo_states: Dict[tuple, Any] = {}
+        self._lost: Dict[tuple, int] = {}
+        self._postmortems: Dict[tuple, List[dict]] = {}
 
     # ------------------------------------------------------------ long poll
     def _bump(self, key: str):
@@ -432,6 +451,7 @@ class ServeControllerActor:
         affinity_config: Optional[dict] = None,
         fault_config: Optional[dict] = None,
         pool_config: Optional[dict] = None,
+        slo_config: Optional[dict] = None,
     ):
         import cloudpickle
 
@@ -442,6 +462,7 @@ class ServeControllerActor:
             validate_fault_config,
             validate_pool_config,
         )
+        from ray_tpu.serve._internal.slo import validate_slo_config
 
         cls = cloudpickle.loads(cls_blob)
         # normalize here too (defense in depth — serve.deployment()
@@ -450,6 +471,7 @@ class ServeControllerActor:
         affinity_config = validate_affinity_config(affinity_config)
         fault_config = validate_fault_config(fault_config)
         pool_config = validate_pool_config(pool_config)
+        slo_config = validate_slo_config(slo_config)
         app = self.apps.setdefault(app_name, {})
         old = app.get(deployment_name)
         rec = {
@@ -467,6 +489,9 @@ class ServeControllerActor:
             # replica -> role map (kv_plane; None/{} for plain deploys)
             "pools": pool_config,
             "roles": {},
+            # serving objectives (slo.SloConfig shape); the control loop
+            # runs an evaluator tick for deployments that set one
+            "slo": slo_config,
             "is_ingress": is_ingress,
             "deploy_time": time.time(),
         }
@@ -481,6 +506,10 @@ class ServeControllerActor:
         # new code, new crash history: a redeploy closes the old
         # version's crash-loop breaker
         self._breakers.pop((app_name, deployment_name), None)
+        # new objectives, fresh burn windows and lost-request ledger —
+        # the old version's error budget must not bill the new one
+        self._slo_states.pop((app_name, deployment_name), None)
+        self._lost.pop((app_name, deployment_name), None)
         if autoscaling_config and not pool_config:
             rec["num_replicas"] = AutoscalingConfig(**autoscaling_config).start_replicas
         # stage new replicas BEFORE committing the record: a failed deploy
@@ -676,16 +705,18 @@ class ServeControllerActor:
         asyncio.ensure_future(self._health_loop(period_s))
         while True:
             await asyncio.sleep(period_s)
-            targets = [
+            deps_all = [
                 (app_name, dep_name, rec)
                 for app_name, deps in list(self.apps.items())
                 for dep_name, rec in list(deps.items())
-                if rec.get("autoscaling")
             ]
-            if not targets:
+            targets = [t for t in deps_all if t[2].get("autoscaling")]
+            slo_targets = [t for t in deps_all if t[2].get("slo")]
+            if not targets and not slo_targets:
                 continue
             # ONE GCS round trip per tick (_fetch_replica_stats via the
-            # shared cache — the health loop reuses the same snapshot)
+            # shared cache — the health loop and the SLO evaluator reuse
+            # the same snapshot)
             stats = await self._fetch_replica_stats_shared()
             now = time.time()
             for app_name, dep_name, rec in targets:
@@ -696,6 +727,15 @@ class ServeControllerActor:
 
                     logging.getLogger("ray_tpu.serve").warning(
                         "autoscale cycle failed for %s::%s", app_name, dep_name, exc_info=True
+                    )
+            for app_name, dep_name, rec in slo_targets:
+                try:
+                    self._slo_one(app_name, dep_name, rec, stats, now)
+                except Exception:
+                    import logging
+
+                    logging.getLogger("ray_tpu.serve").warning(
+                        "slo cycle failed for %s::%s", app_name, dep_name, exc_info=True
                     )
 
     def _autoscale_one(self, app_name, dep_name, rec, stats, now):
@@ -827,6 +867,44 @@ class ServeControllerActor:
         if changed:
             self._bump(f"replicas::{app_name}::{dep_name}")
 
+    # ------------------------------------------------------------ SLO plane
+    def _slo_one(self, app_name, dep_name, rec, stats, now):
+        """One deployment's SLO evaluator tick — synchronous arithmetic
+        over the shared telemetry snapshot, no replica RPCs. Joins the
+        deployment's live replicas to their engine metric snapshots by
+        pid (engine reporters are named `llm-<pid>`; replica payloads
+        carry "pid"), folds them plus the lost-request ledger into the
+        SloState, and publishes the `slo:<app>::<dep>` snapshot that
+        /api/serve, serve.status() and loadgen read."""
+        from ray_tpu.serve._internal.slo import SloState, fold_engine_metrics
+
+        key = (app_name, dep_name)
+        state = self._slo_states.get(key)
+        if state is None or state.cfg != rec["slo"]:
+            state = self._slo_states[key] = SloState(rec["slo"])
+        engines_all = stats.get("__engines__") or {}
+        engines: Dict[str, Dict[str, Any]] = {}
+        for name in rec["replicas"]:
+            s = stats.get(name)
+            pid = s.get("pid") if isinstance(s, dict) else None
+            if pid is None:
+                continue
+            m = engines_all.get(f"llm-{pid}")
+            if isinstance(m, dict):
+                engines[name] = m
+        folded = fold_engine_metrics(engines, lost_requests=self._lost.get(key, 0))
+        state.observe(folded["good"], folded["bad"],
+                      ttft_p99_ms=folded["ttft_p99_ms"],
+                      tpot_p99_ms=folded["tpot_p99_ms"], now=now)
+        try:
+            from ray_tpu import observability
+
+            observability.publish_snapshot("serve", {
+                f"slo:{app_name}::{dep_name}": state.snapshot(now)
+            })
+        except Exception:
+            pass
+
     # ------------------------------------------------------ replica health
     def _breaker(self, app_name: str, dep_name: str):
         from ray_tpu.serve._internal.lifecycle import CrashLoopBreaker
@@ -907,19 +985,54 @@ class ServeControllerActor:
             for name, ok in zip(suspects, oks):
                 if not ok:
                     dead.append((name, "health check timed out (wedged)"))
+        # capture the victims' last stats BEFORE pruning telemetry: the
+        # pid keys the post-mortem flight-recorder read, and the last
+        # reported load is the in-flight estimate the SLO plane bills as
+        # lost requests (engines can't count their own death)
+        last_stats = {name: stats.get(name) for name, _ in dead
+                      if isinstance(stats.get(name), dict)}
         for name, reason in dead:
             self._on_replica_death(app_name, dep_name, rec, name, reason, now)
+            s = last_stats.get(name) or {}
+            key = (app_name, dep_name)
+            self._lost[key] = self._lost.get(key, 0) + max(
+                1, int(float(s.get("load", 0.0) or 0.0)))
         if dead:
             self._bump(f"replicas::{app_name}::{dep_name}")
+            loop = asyncio.get_running_loop()
+            # post-mortem FIRST: read each victim's crash-surviving
+            # flight-recorder ring from /dev/shm (survives SIGKILL; the
+            # dead-pid GC only sweeps it at session teardown) so the
+            # lifecycle snapshot published below carries the tail
+            for name, reason in dead:
+                pid = (last_stats.get(name) or {}).get("pid")
+                if pid:
+                    await loop.run_in_executor(
+                        None, self._read_postmortem,
+                        app_name, dep_name, name, int(pid), reason, now)
             # prune the corpses' telemetry NOW: the ≤120s GCS retention
             # window would otherwise let the autoscaler keep counting a
             # crashed replica's last-published load as live signal
-            loop = asyncio.get_running_loop()
             for name, _ in dead:
                 loop.run_in_executor(None, _prune_replica_telemetry, name)
         self._maybe_restart(app_name, dep_name, rec, now)
         if dead:
             self._publish_lifecycle(app_name, dep_name, rec, now)
+
+    def _read_postmortem(self, app_name, dep_name, name, pid, reason, now):
+        """Blocking (executor-run) read of a dead replica's flight ring;
+        stores the decoded tail for lifecycle snapshots + status()."""
+        try:
+            from ray_tpu.observability import flight_recorder
+
+            tail = flight_recorder.read_tail(pid=pid, n=64)
+        except Exception:
+            tail = []
+        key = (app_name, dep_name)
+        pms = self._postmortems.setdefault(key, [])
+        pms.append({"t": now, "replica": name, "pid": pid,
+                    "reason": reason, "events": tail})
+        del pms[:-4]  # bounded: keep the last few corpses per deployment
 
     async def _ping_replica(self, name: str) -> bool:
         """Bounded liveness ping for ONE suspect; False = wedged/dead."""
@@ -1029,13 +1142,20 @@ class ServeControllerActor:
             from ray_tpu import observability
 
             breaker = self._breaker(app_name, dep_name)
+            payload = {
+                "t": now,
+                "replicas": len(rec["replicas"]),
+                "target": rec["num_replicas"],
+                **breaker.state(now),
+            }
+            pms = self._postmortems.get((app_name, dep_name))
+            if pms:
+                # the most recent corpse's flight-recorder tail rides
+                # the lifecycle snapshot: "the replica died" comes with
+                # "and here is what it was doing"
+                payload["postmortem"] = pms[-1]
             observability.publish_snapshot("serve", {
-                f"lifecycle:{app_name}::{dep_name}": {
-                    "t": now,
-                    "replicas": len(rec["replicas"]),
-                    "target": rec["num_replicas"],
-                    **breaker.state(now),
-                }
+                f"lifecycle:{app_name}::{dep_name}": payload
             })
         except Exception:
             pass
@@ -1072,6 +1192,9 @@ class ServeControllerActor:
             self._autoscalers.pop(key, None)
         for key in [k for k in self._breakers if k[0] == app_name]:
             self._breakers.pop(key, None)
+        for d in (self._slo_states, self._lost, self._postmortems):
+            for key in [k for k in d if k[0] == app_name]:
+                d.pop(key, None)
         for dep_name, dep in app.items():
             for name in dep["replicas"]:
                 self._born.pop(name, None)
@@ -1125,5 +1248,53 @@ class ServeControllerActor:
                         "state": st["state"],
                         "recent_crashes": st["recent_crashes"],
                     }
+                slo_state = self._slo_states.get((app_name, name))
+                if slo_state is not None:
+                    entry["slo"] = slo_state.snapshot()
+                pms = self._postmortems.get((app_name, name))
+                if pms:
+                    entry["postmortem"] = pms[-1]
                 out[app_name][name] = entry
         return out
+
+    async def request_timeline(self, rid: str) -> List[Dict[str, Any]]:
+        """Cluster-wide lifeline for one request id: fan the per-replica
+        `request_timeline` out to every live replica of every deployment
+        and merge by timestamp — the prefill-side events, the KV-plane
+        hop and the decode-side resume stitch into ONE timeline because
+        the rid survives migration and redispatch end-to-end. Dead
+        replicas' contributions come from post-mortem flight-ring tails
+        (matched by rid) instead."""
+        import asyncio
+
+        names = [n for deps in self.apps.values()
+                 for rec in deps.values() for n in rec["replicas"]]
+
+        async def _one(name):
+            try:
+                h = ray_tpu.get_actor(name)
+                evs = await asyncio.wait_for(
+                    h.handle_request.remote("request_timeline", (rid,), {}),
+                    timeout=5.0)
+                for e in evs or []:
+                    e.setdefault("replica", name)
+                return evs or []
+            except Exception:
+                return []
+
+        merged: List[Dict[str, Any]] = []
+        for evs in await asyncio.gather(*(_one(n) for n in names)):
+            merged.extend(evs)
+        # dead replicas: their in-memory lifelines died with them, but
+        # the flight-ring post-mortems carry rid-stamped records
+        for pms in self._postmortems.values():
+            for pm in pms:
+                for e in pm.get("events", []):
+                    # ring records carry the rid's first 24 bytes
+                    if e.get("rid") and e["rid"] == rid[:24]:
+                        ev = dict(e)
+                        ev["replica"] = pm.get("replica")
+                        ev["postmortem"] = True
+                        merged.append(ev)
+        merged.sort(key=lambda e: e.get("t", 0.0))
+        return merged

@@ -516,7 +516,9 @@ class DeploymentHandle:
         pool — unless that pool is momentarily empty (replica death
         mid-restart), in which case any survivor serves: a paged engine
         imports/serves resumes regardless of role, so degrading beats
-        parking. Returns (name, submit_method)."""
+        parking. Returns (name, submit_method, route_kind) — route_kind
+        is the affinity decision ("hits"/"spills"/"misses"/"inv_hits")
+        or None without affinity, stamped on the request's lifeline."""
         with self._member_cv:
             if not self._replicas:
                 self._park_for_members()
@@ -527,6 +529,7 @@ class DeploymentHandle:
                 if not eligible:
                     eligible = None
             idx = None
+            kind = None
             if self._affinity is not None:
                 # keyless requests (no routable prompt/session) count as
                 # misses too, so hits+spills+misses == affinity-routed
@@ -541,7 +544,7 @@ class DeploymentHandle:
                 idx = self._pick(eligible)
             name = self._replica_names[idx]
             self._outstanding[name] = self._outstanding.get(name, 0) + 1
-            return name, self._submits[idx]
+            return name, self._submits[idx], kind
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         if not self._replicas:
@@ -564,7 +567,11 @@ class DeploymentHandle:
         rid = _next_rid()
         if args and isinstance(args[0], dict):
             req0 = args[0]
-            rid = req0.get("request_id", rid)
+            # rid continuity: a user-provided request_id wins; a KV
+            # resume body carries the ORIGINAL request's rid, and the
+            # decode hop must ride the same lifeline instead of minting
+            # a fresh id (one rid end-to-end across the migration)
+            rid = req0.get("request_id") or req0.get("rid") or rid
             if req0.get("deadline_s") is not None:
                 req0 = dict(req0)
                 ds = req0.pop("deadline_s")
@@ -597,7 +604,7 @@ class DeploymentHandle:
             role = "decode" if (isinstance(req0, dict)
                                 and req0.get("__kv_resume__")) else "prefill"
         record["pool"] = role
-        record["replica"], submit = self._reserve(akey, role)
+        record["replica"], submit, route_kind = self._reserve(akey, role)
         try:
             # the prebound method rides the shm-ring direct transport
             # when negotiated, the RPC path otherwise — same call shape
@@ -605,9 +612,29 @@ class DeploymentHandle:
         except Exception:
             done()
             self._refresh()
-            record["replica"], submit = self._reserve(akey, role)
+            record["replica"], submit, route_kind = self._reserve(akey, role)
             ref = submit.remote(self._method, args, kwargs)
+        self._record_route(record, route_kind)
         return DeploymentResponse(ref, on_done=done, handle=self, record=record)
+
+    def _record_route(self, record: Dict[str, Any],
+                      route_kind: Optional[str]) -> None:
+        """Drop the routing decision on the request's lifeline (caller
+        process store + flight ring + span plane) — once per dispatch
+        attempt, never on a reply path."""
+        try:
+            from ray_tpu.observability import lifeline
+            from ray_tpu.util import tracing
+
+            lifeline.record(
+                record["rid"], "route", ctx=tracing.current_context(),
+                app=self.app_name, deployment=self.deployment_name,
+                replica=record.get("replica"),
+                route=route_kind or "direct",
+                pool=record.get("pool"),
+                attempt=record.get("attempts", 0))
+        except Exception:
+            pass
 
     # -- failure policy -------------------------------------------------
     def _drop_replica(self, name: str) -> None:
@@ -681,8 +708,22 @@ class DeploymentHandle:
         )
         # _reserve parks under the zero-replica machinery when the dead
         # replica was the last one — the restart/scale-up push unparks
-        record["replica"], submit = self._reserve(
+        record["replica"], submit, route_kind = self._reserve(
             record.get("akey"), record.get("pool"))
+        try:
+            from ray_tpu.observability import lifeline
+
+            # the LOSER attempt is marked right on the timeline: which
+            # replica died with the request in flight, and which
+            # survivor the same rid was requeued onto
+            lifeline.record(
+                record["rid"], "redispatch",
+                app=self.app_name, deployment=self.deployment_name,
+                lost_replica=dead_name, replica=record["replica"],
+                route=route_kind or "direct",
+                attempt=record["attempts"])
+        except Exception:
+            pass
         return submit.remote(record["method"], record["args"], record["kwargs"])
 
     def routing_stats(self) -> Dict[str, Any]:
@@ -692,17 +733,21 @@ class DeploymentHandle:
         the request carried no routable key — plus the failure ledger
         (redispatches, fail-fasts, errors seen by taxonomy category)."""
         with self._lock:
+            # ONE consistent copy under the lock, then derive from the
+            # copy only: `total` computed from a second live read could
+            # tear against a concurrent _reserve (hits+spills+misses
+            # momentarily != routed)
             out = dict(self._astats)
-            out["total"] = (self._astats["hits"] + self._astats["spills"]
-                            + self._astats["misses"]
-                            + self._astats["inv_hits"])
+            fstats = dict(self._fstats)
             out["affinity_enabled"] = self._affinity is not None
             out["ring_points"] = len(self._ring_points)
             out["replicas"] = len(self._replica_names)
-            out.update(self._fstats)
             out["redispatch_enabled"] = bool(
                 (self._fault or {}).get("redispatch"))
-            return out
+        out["total"] = (out["hits"] + out["spills"] + out["misses"]
+                        + out["inv_hits"])
+        out.update(fstats)
+        return out
 
     def close(self):
         self._closed = True

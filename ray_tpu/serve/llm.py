@@ -152,11 +152,33 @@ class _LLMServer:
                 # collide last-write-wins in the merged table)
                 name=f"llm-{os.getpid()}",
             )
+            # label this process's lifeline events with the replica
+            # coordinates when serving (the engine name otherwise) —
+            # request_timeline shows WHERE each hop ran
+            try:
+                from ray_tpu.observability import lifeline
+                from ray_tpu.serve._internal import kv_plane
+
+                lifeline.set_process_label(
+                    kv_plane.current_replica_name()
+                    or f"llm-{os.getpid()}")
+            except Exception:
+                pass
 
     def metrics(self) -> Dict[str, Any]:
         """Engine serving metrics (dispatches/token, lane occupancy,
         TTFT/TPOT percentiles); empty for the static-batching path."""
         return self.engine.metrics() if self.engine is not None else {}
+
+    def request_timeline(self, rid: str) -> List[Dict[str, Any]]:
+        """This replica's slice of one request's lifeline — the
+        controller fans this RPC out across replicas and merges by rid
+        into the cluster-wide timeline (serve.request_timeline)."""
+        if self.engine is not None:
+            return self.engine.request_timeline(rid)
+        from ray_tpu.observability import lifeline
+
+        return lifeline.events(rid)
 
     def __serve_load__(self) -> int:
         """Autoscaling load signal: the engine's resident + queued
@@ -265,7 +287,8 @@ class _LLMServer:
 
         self._pump.submit(_run)
 
-    def _maybe_prefetch_prefix(self, prompt: List[int]) -> None:
+    def _maybe_prefetch_prefix(self, prompt: List[int],
+                               rid: Optional[str] = None) -> None:
         """Cluster prefix-cache read path: ONE digest + ONE inventory
         probe per request (lint-pinned). If another replica advertises
         this prompt's prefix and it is not cached locally, fetch its KV
@@ -286,6 +309,17 @@ class _LLMServer:
         if eng.has_local_prefix(dig):
             return
         owner = kv_plane.InventoryView.instance().owner_of(dig)
+        if rid:
+            # the probe's lifeline record: STILL one dict probe per
+            # request — the event is per-request bookkeeping, not a
+            # second lookup
+            try:
+                from ray_tpu.observability import lifeline
+
+                lifeline.record(rid, "inventory_probe",
+                                owner=owner or "", hit=owner is not None)
+            except Exception:
+                pass
         if owner is None or owner == kv_plane.current_replica_name():
             return
         now = _time.monotonic()
@@ -307,6 +341,11 @@ class _LLMServer:
             payload = kv_plane.fetch_kv_payload(exp["ref"], timeout=10.0)
             eng.import_prefix(exp["tokens"], payload["k"], payload["v"],
                               exp["n_data_blocks"])
+            if rid:
+                from ray_tpu.observability import lifeline
+
+                lifeline.record(rid, "prefix_import", owner=owner,
+                                blocks=int(exp["n_data_blocks"]))
         except Exception:
             pass  # cluster cache is an optimization, never a failure
 
@@ -340,7 +379,8 @@ class _LLMServer:
 
         if self.engine is None:
             raise ValueError("__kv_resume__ requires the continuous engine")
-        payload = kv_plane.fetch_kv_payload(body["ref"])
+        payload = kv_plane.fetch_kv_payload(body["ref"],
+                                            rid=body.get("rid"))
         sampling = SamplingParams.from_request(body.get("sampling"))
         kw = dict(
             prompt=[int(t) for t in body["prompt"]],
@@ -381,7 +421,7 @@ class _LLMServer:
             )
             from ray_tpu.experimental.direct_transport import maybe_defer
 
-            self._maybe_prefetch_prefix(prompt)
+            self._maybe_prefetch_prefix(prompt, rid=rid)
             deferred = maybe_defer()
             if deferred is not None:
                 # direct-transport fast path: submit() enqueues onto the

@@ -81,9 +81,16 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu.observability import flight_recorder as _flightrec
+from ray_tpu.observability import lifeline as _lifeline
 from ray_tpu.util.metrics import metric_singletons as _metric_singletons
 
 logger = logging.getLogger(__name__)
+
+# flight-recorder event id resolved once: the per-dispatch ring write
+# must be a constant-arg call (lint-pinned — no dict lookup, no
+# allocation on the dispatch path)
+_EV_DISPATCH = _flightrec.EV["dispatch"]
 
 # latency histogram boundaries (seconds): wide enough for relay-attached
 # chips (TTFT can run seconds) and fine enough near the fast end for
@@ -214,8 +221,8 @@ class _Request:
                  "_remaining", "_rounds_est", "_rounds_inflight",
                  "_t_submit", "_t_first", "_t_done",
                  "_trace_ctx", "_start", "_blocks", "_blocks_freed",
-                 "_done_lock", "rid", "_migrate", "export", "_resume",
-                 "_qtok")
+                 "_done_lock", "rid", "_rid_b", "_migrate", "export",
+                 "_resume", "_qtok")
 
     def __init__(self, prompt, max_new_tokens, on_done=None, sampling=None,
                  rid: Optional[str] = None):
@@ -224,8 +231,11 @@ class _Request:
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.sampling = sampling or SamplingParams()
-        # caller-generated request id (redispatch bookkeeping + logs)
+        # caller-generated request id (redispatch bookkeeping + logs);
+        # _rid_b is the pre-encoded flight-recorder form — encoded ONCE
+        # here so no per-event path ever pays the str→bytes conversion
         self.rid = rid
+        self._rid_b = _lifeline.rid_bytes(rid) if rid else b""
         # "length" | "stop" | "cancelled" | None (error/unfinished)
         self.finish_reason: Optional[str] = None
         self.tokens: List[int] = []
@@ -465,8 +475,18 @@ class ContinuousBatchingEngine:
         # admission ETA estimate. Written by the loop thread at
         # delivery, read by submit() — a torn float read is harmless
         self._ema_service_s = 0.0
-        # serving metrics (monotonic counters + latency histograms)
+        # serving metrics (monotonic counters + latency histograms).
+        # _m_lock makes RELATED counters a consistent snapshot: the
+        # migration/prefix-export sites bump several counters per event,
+        # and metrics() copies the dict under the same lock so a
+        # mid-burst scrape can't return torn totals (migrations_out
+        # without its migrated_blocks_out). Single-counter bumps on the
+        # loop thread stay lock-free — a lone counter can't tear.
         self.name = name
+        self._m_lock = threading.Lock()
+        # per-process crash ring: per-dispatch events land here with ONE
+        # ring write (no allocation, no pickle, no RPC — lint-pinned)
+        self._fr = _flightrec.get_recorder()
         self._m = {"dispatches": 0, "tokens_out": 0, "slot_steps": 0,
                    "useful_slot_steps": 0, "wasted_steps": 0,
                    "prefill_tokens": 0, "reused_prefix_tokens": 0,
@@ -476,7 +496,7 @@ class ContinuousBatchingEngine:
                    "draft_accepted_tokens": 0, "migrations_out": 0,
                    "migrations_in": 0, "migrated_blocks_out": 0,
                    "migrated_blocks_in": 0, "prefix_exports": 0,
-                   "prefix_imports": 0}
+                   "prefix_imports": 0, "requests_completed": 0}
         shared = _engine_metrics()
         self._tags = {"engine": name}
         self._ttft = _LatencyHist(_TTFT_BOUNDS, shared["ttft"], self._tags)
@@ -491,6 +511,7 @@ class ContinuousBatchingEngine:
             f"llm_dispatch:{name}", kind="serve")
         self._jit_cache_sizes: Dict[int, int] = {}
         self._t_snapshot = 0.0
+        self._pub_marker: Optional[tuple] = None
         self._wake = threading.Event()
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -585,7 +606,13 @@ class ContinuousBatchingEngine:
                     f"request needs {need} KV blocks, pool only has "
                     f"{self.n_blocks - 1}"
                 )
-        self._check_admission(sampling)
+        try:
+            self._check_admission(sampling)
+        except Exception as e:
+            if rid:
+                _lifeline.record(rid, "shed", engine=self.name,
+                                 reason=type(e).__name__)
+            raise
         req = _Request([int(t) for t in prompt], max_new_tokens,
                        on_done=on_done, sampling=sampling, rid=rid)
         req._migrate = will_migrate
@@ -598,6 +625,13 @@ class ContinuousBatchingEngine:
             req._trace_ctx = tracing.current_context()
         except Exception:
             pass
+        if rid:
+            _lifeline.record(rid, "submit", ctx=req._trace_ctx,
+                             rid_b=req._rid_b, engine=self.name,
+                             prompt_tokens=len(req.prompt),
+                             max_new_tokens=max_new_tokens,
+                             migrate=will_migrate,
+                             a=float(len(req.prompt)))
         self._queue.put(req)
         if self._dead is not None:
             # lost the race with the loop dying: the dead loop will never
@@ -639,6 +673,12 @@ class ContinuousBatchingEngine:
         cancel racing normal delivery loses cleanly: _finish's atomic
         test-and-set makes whoever gets there first the sole completer."""
         if _finish(req, error=msg, reason="cancelled"):
+            if req.rid:
+                _lifeline.record(req.rid, "finish", ctx=req._trace_ctx,
+                                 rid_b=req._rid_b, engine=self.name,
+                                 reason="cancelled",
+                                 tokens=len(req.tokens))
+                _lifeline.finish(req.rid)
             self._wake.set()
 
     def shutdown(self):
@@ -771,6 +811,11 @@ class ContinuousBatchingEngine:
             req._trace_ctx = tracing.current_context()
         except Exception:
             pass
+        if rid:
+            _lifeline.record(rid, "resume_submit", ctx=req._trace_ctx,
+                             rid_b=req._rid_b, engine=self.name,
+                             blocks=int(n_data_blocks),
+                             a=float(n_data_blocks))
         self._rqueue.put(req)
         if self._dead is not None:
             msg = f"engine is dead: {self._dead}"
@@ -813,7 +858,10 @@ class ContinuousBatchingEngine:
         import ray_tpu
 
         ref = ray_tpu.put({"k": k, "v": v, "n": n})
-        self._m["prefix_exports"] += 1
+        with self._m_lock:
+            # off-loop-thread increment: without the lock a concurrent
+            # metrics() copy could tear this against the loop's counters
+            self._m["prefix_exports"] += 1
         return {"tokens": tokens, "ref": ref.hex(), "n_data_blocks": n,
                 "block_size": self.block_size, "_ref": ref}
 
@@ -851,8 +899,9 @@ class ContinuousBatchingEngine:
             # already-present nodes free right here — leak-audit clean)
             self._alloc.decref(blocks)
             self._register_prefix(committed)
-            self._m["prefix_imports"] += 1
-            self._m["migrated_blocks_in"] += added
+            with self._m_lock:
+                self._m["prefix_imports"] += 1
+                self._m["migrated_blocks_in"] += added
             return added
 
         return self.call_on_loop(job)
@@ -863,8 +912,12 @@ class ContinuousBatchingEngine:
         p50/p95/p99 from the latency histograms (bucket-interpolated;
         the histogram lock makes the snapshot safe against the engine
         loop's concurrent appends). Tokens count at DELIVERY, so read
-        after requests complete for exact ratios."""
-        m = dict(self._m)
+        after requests complete for exact ratios. The copy happens under
+        _m_lock so multi-counter updates (migration, prefix export) are
+        all-or-nothing in the snapshot — a mid-burst scrape can't see
+        migrations_out without its migrated_blocks_out."""
+        with self._m_lock:
+            m = dict(self._m)
         m["queue_depth"] = self.load()  # live gauge, not a counter
         toks = max(1, m["tokens_out"])
         m["dispatches_per_token"] = round(m["dispatches"] / toks, 4)
@@ -928,8 +981,36 @@ class ContinuousBatchingEngine:
             pass
         return m
 
+    def request_timeline(self, rid: str) -> List[Dict[str, Any]]:
+        """One rid's process-local lifeline, time-sorted, with the
+        macro-step dispatches the lane rode joined in at READ time: the
+        dispatch hot path records nothing per request (one flight-ring
+        write per dispatch, total), so the join scans this process's
+        ring for dispatch records inside the request's [first, last]
+        event window. Cluster-wide stitching (prefill→decode hop,
+        redispatch attempts) happens a level up — the serve controller
+        fans this out per replica and merges by rid."""
+        evs = [dict(e) for e in _lifeline.events(rid)]
+        ts = [e["t"] for e in evs]
+        if ts:
+            lo, hi = min(ts) - 1e-3, max(ts) + 1e-3
+            try:
+                for rec in _flightrec.read_tail(path=self._fr.path,
+                                                n=self._fr.capacity):
+                    if rec["kind"] == "dispatch" and lo <= rec["t"] <= hi:
+                        evs.append({"t": rec["t"], "kind": "dispatch",
+                                    "pid": rec["pid"],
+                                    "engine": self.name,
+                                    "step": rec["step"],
+                                    "dispatch_ms": round(rec["a"], 3)})
+            except Exception:
+                pass
+        evs.sort(key=lambda e: e["t"])
+        return evs
+
     def reset_metrics(self) -> None:
-        self._m = {k: 0 for k in self._m}
+        with self._m_lock:
+            self._m = {k: 0 for k in self._m}
         self._ttft.reset()
         self._tpot.reset()
         self._mig.reset()
@@ -1000,11 +1081,18 @@ class ContinuousBatchingEngine:
         req._blocks_freed = False
         if self._prefix is not None:
             self._prefix.record_lookup(len(req.prompt), len(shared))
-        self._m["reused_prefix_tokens"] += matched
-        self._m["prefill_tokens"] += len(req.prompt) - matched
-        self._m["kv_blocks_peak_in_use"] = max(
-            self._m["kv_blocks_peak_in_use"], self._alloc.used_blocks
-        )
+        with self._m_lock:
+            self._m["reused_prefix_tokens"] += matched
+            self._m["prefill_tokens"] += len(req.prompt) - matched
+            self._m["kv_blocks_peak_in_use"] = max(
+                self._m["kv_blocks_peak_in_use"], self._alloc.used_blocks
+            )
+        if req.rid:
+            _lifeline.record(req.rid, "admit", ctx=req._trace_ctx,
+                             rid_b=req._rid_b, engine=self.name,
+                             matched_prefix=matched,
+                             blocks=len(req._blocks),
+                             a=float(matched), b=float(len(req._blocks)))
         self._dec_qtok(req)
         if self._prefix is not None:
             # commit the full prompt blocks NOW: the prefill that fills
@@ -1119,10 +1207,16 @@ class ContinuousBatchingEngine:
             if self._prefix is not None:
                 self._prefix.insert(req.prompt, req._blocks)
                 self._register_prefix(req.prompt)
-            self._m["migrations_in"] += 1
-            self._m["migrated_blocks_in"] += n_data
-            self._m["kv_blocks_peak_in_use"] = max(
-                self._m["kv_blocks_peak_in_use"], self._alloc.used_blocks)
+            with self._m_lock:
+                self._m["migrations_in"] += 1
+                self._m["migrated_blocks_in"] += n_data
+                self._m["kv_blocks_peak_in_use"] = max(
+                    self._m["kv_blocks_peak_in_use"],
+                    self._alloc.used_blocks)
+            if req.rid:
+                _lifeline.record(req.rid, "kv_import", ctx=req._trace_ctx,
+                                 rid_b=req._rid_b, engine=self.name,
+                                 blocks=n_data, a=float(n_data))
             if payload.get("t_export") is not None:
                 # end-to-end handoff latency (cross-process wall clock)
                 self._mig.observe(max(0.0, time.time() - payload["t_export"]))
@@ -1132,7 +1226,8 @@ class ContinuousBatchingEngine:
                 # a redispatched resume can land here)
                 self._slots[slot] = None
                 self._free_request_blocks(req)
-                _finish(req, reason="length")
+                if _finish(req, reason="length"):
+                    self._m["requests_completed"] += 1
 
     def _migrate_out(self, req: _Request) -> None:
         """Export a prefill-pool request's KV at its first token: ONE
@@ -1151,10 +1246,15 @@ class ContinuousBatchingEngine:
         try:
             n_data = self._alloc.blocks_for_tokens(len(req.prompt))
             ref, _w = kv_plane.export_kv_blocks(
-                self.cache, req._blocks[:n_data])
+                self.cache, req._blocks[:n_data], rid=req.rid)
         except Exception as e:  # noqa: BLE001 — device/object-plane errors
             from ray_tpu.serve.errors import ReplicaDiedError
 
+            if req.rid:
+                _lifeline.record(req.rid, "error", ctx=req._trace_ctx,
+                                 rid_b=req._rid_b, engine=self.name,
+                                 error=f"kv export failed: "
+                                       f"{type(e).__name__}")
             self._free_request_blocks(req)
             _finish(req, exc=ReplicaDiedError(
                 f"kv export failed: {type(e).__name__}: {e}", started=False))
@@ -1164,14 +1264,27 @@ class ContinuousBatchingEngine:
             "ref": ref, "ref_hex": ref.hex(), "n_data_blocks": n_data,
             "block_size": self.block_size, "t_export": time.time(),
         }
-        self._m["migrations_out"] += 1
-        self._m["migrated_blocks_out"] += n_data
+        with self._m_lock:
+            self._m["migrations_out"] += 1
+            self._m["migrated_blocks_out"] += n_data
         self._mig.observe(time.perf_counter() - t0)
         req._t_done = time.perf_counter()
+        if req.rid:
+            _lifeline.record(req.rid, "kv_export", ctx=req._trace_ctx,
+                             rid_b=req._rid_b, engine=self.name,
+                             blocks=n_data, a=float(n_data),
+                             b=(time.perf_counter() - t0) * 1e3)
         if _finish(req, reason="migrated"):
             dur = req._t_done - req._t_submit
             ema = self._ema_service_s
             self._ema_service_s = dur if ema <= 0.0 else 0.8 * ema + 0.2 * dur
+            if req.rid:
+                _lifeline.record(req.rid, "migrate", ctx=req._trace_ctx,
+                                 rid_b=req._rid_b, engine=self.name,
+                                 blocks=n_data)
+                # terminal on THIS engine (the request lives on at the
+                # decode pool, in that process's store) — age the buffer
+                _lifeline.finish(req.rid)
         self._free_request_blocks(req)
         self._wake.set()
 
@@ -1470,6 +1583,12 @@ class ContinuousBatchingEngine:
 
             for r, late in shed:
                 self._m["deadline_expired"] += 1
+                if r.rid:
+                    _lifeline.record(r.rid, "shed", ctx=r._trace_ctx,
+                                     rid_b=r._rid_b, engine=self.name,
+                                     reason="DeadlineExceededError",
+                                     a=late)
+                    _lifeline.finish(r.rid)
                 _finish(r, exc=DeadlineExceededError(
                     f"deadline passed {late:.2f}s into the queue"))
 
@@ -1503,6 +1622,7 @@ class ContinuousBatchingEngine:
                 while self._pending:
                     self._resolve(self._pending.popleft())
                 self._repair()
+                self._maybe_publish(time.perf_counter())
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
@@ -1585,6 +1705,7 @@ class ContinuousBatchingEngine:
             if not active:
                 while self._pending:
                     self._resolve(self._pending.popleft())
+                self._maybe_publish(time.perf_counter())
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
@@ -1647,16 +1768,40 @@ class ContinuousBatchingEngine:
                 ctx=ctxs[0] if ctxs else None,
                 links=ctxs[1:] or None,
             )
+            # per-dispatch flight-recorder record: ONE ring write (the
+            # dispatch window in ms rides `a`) — no allocation, no
+            # pickle, no RPC on this path (lint-pinned)
+            self._fr.write(_EV_DISPATCH, step=self._m["dispatches"],
+                           a=(t1 - t0) * 1e3)
             _engine_metrics()["dispatches"].inc(1, tags=self._tags)
-            # throttled /api/serve snapshot push (queued — the GCS RPC
-            # runs on the telemetry flusher thread, never this loop)
-            if t1 - self._t_snapshot >= 2.0:
-                self._t_snapshot = t1
-                from ray_tpu import observability
+            self._maybe_publish(t1)
+        except Exception:
+            pass
 
-                observability.publish_snapshot(
-                    "serve", {f"engine:{self.name}": self.metrics()}
-                )
+    def _maybe_publish(self, now: float) -> None:
+        """Throttled /api/serve snapshot push (queued — the GCS RPC runs
+        on the telemetry flusher thread, never the engine loop). Also
+        called from the loop's idle branch: dispatch-time publishes
+        snapshot counters BEFORE that macro's deliveries land, so
+        without a final idle-time push a short burst would leave
+        `requests_completed` (the SLO evaluator's good-count feed)
+        permanently stale at its pre-finish value."""
+        if now - self._t_snapshot < 2.0:
+            return
+        m = self._m
+        marker = (m["dispatches"], m["requests_completed"],
+                  m["shed_queue_full"] + m["shed_eta"]
+                  + m["deadline_expired"])
+        if marker == self._pub_marker:
+            return  # idle and already published these exact counters
+        self._t_snapshot = now
+        self._pub_marker = marker
+        try:
+            from ray_tpu import observability
+
+            observability.publish_snapshot(
+                "serve", {f"engine:{self.name}": self.metrics()}
+            )
         except Exception:
             pass
 
@@ -1694,6 +1839,16 @@ class ContinuousBatchingEngine:
         if req._t_first is None and (req.tokens or toks or stopped):
             req._t_first = time.perf_counter()
             self._ttft.observe(req._t_first - req._t_submit)
+            if req.rid:
+                # once per request, not per token — the per-token path
+                # below stays counters-only (lint-pinned)
+                _lifeline.record(req.rid, "first_token",
+                                 ctx=req._trace_ctx, rid_b=req._rid_b,
+                                 engine=self.name,
+                                 ttft_ms=round(
+                                     (req._t_first - req._t_submit) * 1e3,
+                                     3),
+                                 a=(req._t_first - req._t_submit) * 1e3)
         req.tokens.extend(toks)
         self._m["tokens_out"] += len(toks)
         try:
@@ -1707,10 +1862,21 @@ class ContinuousBatchingEngine:
                     self._tpot.observe(
                         (req._t_done - req._t_first) / (len(req.tokens) - 1)
                     )
+                # SLO availability numerator: requests DELIVERED here
+                # (migrated finishes count on the decode side instead)
+                self._m["requests_completed"] += 1
                 # service-time EMA feeding the admission ETA estimate
                 dur = req._t_done - req._t_submit
                 ema = self._ema_service_s
                 self._ema_service_s = dur if ema <= 0.0 else 0.8 * ema + 0.2 * dur
+                if req.rid:
+                    _lifeline.record(req.rid, "finish",
+                                     ctx=req._trace_ctx, rid_b=req._rid_b,
+                                     engine=self.name,
+                                     reason=req.finish_reason,
+                                     tokens=len(req.tokens),
+                                     a=float(len(req.tokens)), b=dur * 1e3)
+                    _lifeline.finish(req.rid)
                 self._wake.set()  # repair promptly: slot + blocks are free
             if req._migrate:
                 # stopped AT its first token: finished here, no export —
@@ -1848,6 +2014,11 @@ class ContinuousBatchingEngine:
         for req in doomed:
             self._dec_qtok(req)
             self._free_request_blocks(req)
+            if req.rid:
+                _lifeline.record(req.rid, "error", ctx=req._trace_ctx,
+                                 rid_b=req._rid_b, engine=self.name,
+                                 error=f"engine died: {msg}"[:200])
+                _lifeline.finish(req.rid)
             _finish(req, error=msg, exc=ReplicaDiedError(
                 f"engine died: {msg}", started=len(req.tokens) > 0))
 

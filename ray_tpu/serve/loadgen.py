@@ -220,7 +220,8 @@ def replica_metrics(app_name: str, deployment_name: str) -> Dict[str, Dict[str, 
 async def _run_async(handle, workload: Workload, phases: List[Phase],
                      request_timeout_s: float, track: Optional[Tuple[str, str]],
                      drain_timeout_s: float, retries: int = 0,
-                     chaos=None, chaos_target: Optional[Tuple[str, str]] = None
+                     chaos=None, chaos_target: Optional[Tuple[str, str]] = None,
+                     slo: Optional[Dict[str, Any]] = None
                      ) -> Dict[str, Any]:
     rng = random.Random(workload.seed)
     records: List[Dict[str, Any]] = []
@@ -329,7 +330,8 @@ async def _run_async(handle, workload: Workload, phases: List[Phase],
     if sampler is not None:
         stop_sampler.set()
         await sampler
-    report = _build_report(records, replica_timeline, time.monotonic() - t_start)
+    report = _build_report(records, replica_timeline,
+                           time.monotonic() - t_start, slo=slo)
     if injector is not None:
         injector.stop()
         injector.join(timeout=5.0)
@@ -340,7 +342,8 @@ async def _run_async(handle, workload: Workload, phases: List[Phase],
     return report
 
 
-def _phase_stats(recs: List[Dict[str, Any]], wall_s: float) -> Dict[str, Any]:
+def _phase_stats(recs: List[Dict[str, Any]], wall_s: float,
+                 slo: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     lat = sorted(
         (r["t_done"] - r["t_submit"]) * 1e3 for r in recs if r.get("ok")
     )
@@ -383,10 +386,26 @@ def _phase_stats(recs: List[Dict[str, Any]], wall_s: float) -> Dict[str, Any]:
         # how fast overload turns into a typed rejection — the overload
         # gate wants this ≪ the request deadline
         out["rejection_ms_p99"] = round(_percentile(rej, 0.99), 2)
+    target_av = (slo or {}).get("availability")
+    if target_av and out["sent"]:
+        # per-phase availability attainment + burn from the harness's
+        # OWN request ledger (every drop — shed, deadline, lost — spends
+        # error budget; burn 1.0 = spending exactly at the exhaustion
+        # rate). TTFT/TPOT attainment is engine-measured: see the
+        # report-level "slo" snapshots.
+        observed = out["completed"] / out["sent"]
+        out["slo"] = {"availability": {
+            "target": target_av,
+            "observed": round(observed, 6),
+            "attained": bool(observed >= target_av),
+            "burn_rate": round((out["dropped"] / out["sent"])
+                               / max(1e-9, 1.0 - target_av), 3),
+        }}
     return out
 
 
-def _build_report(records, replica_timeline, wall_s) -> Dict[str, Any]:
+def _build_report(records, replica_timeline, wall_s,
+                  slo: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     by_phase: Dict[str, List[Dict[str, Any]]] = {}
     for r in records:
         if "t_done" not in r:  # cancelled straggler past drain timeout
@@ -401,9 +420,9 @@ def _build_report(records, replica_timeline, wall_s) -> Dict[str, Any]:
         t1 = max(r["t_done"] for r in recs)
         phase_wall[name] = max(1e-9, t1 - t0)
     report = {
-        "total": _phase_stats(records, wall_s),
+        "total": _phase_stats(records, wall_s, slo=slo),
         "phases": {
-            name: _phase_stats(recs, phase_wall[name])
+            name: _phase_stats(recs, phase_wall[name], slo=slo)
             for name, recs in by_phase.items()
         },
         "errors": sorted({r["error"] for r in records if r.get("error")})[:8],
@@ -425,7 +444,8 @@ def run_load(handle, workload: Workload, phases: Optional[List[Phase]] = None,
              collect_serve_metrics: bool = True,
              retries: int = 0,
              chaos=None,
-             chaos_target: Optional[Tuple[str, str]] = None) -> Dict[str, Any]:
+             chaos_target: Optional[Tuple[str, str]] = None,
+             slo: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Drive `handle` with the workload through the phases (default: one
     steady phase of 5s) and return the report dict. `track=(app, dep)`
     samples that deployment's replica count through the run (the
@@ -439,32 +459,57 @@ def run_load(handle, workload: Workload, phases: Optional[List[Phase]] = None,
     ray_tpu.chaos.ChaosSchedule fired against `chaos_target` (defaults
     to `track`, then the handle's own deployment) while the load runs;
     the report's `chaos` section records what actually fired, and every
-    drop is classified shed / replica-death / deadline / other."""
+    drop is classified shed / replica-death / deadline / other.
+
+    `slo` passes per-phase availability targets explicitly; when None,
+    the tracked/handle deployment's deployed `slo_config` is discovered
+    from serve.status() — each phase then reports its own attainment
+    and burn rate alongside the cluster-wide `slo:` snapshots."""
     phases = phases or [Phase("steady", 5.0)]
+    # epoch fence: stamp the serve telemetry table NOW so every snapshot
+    # this run reads comes from a reporter that published during/after
+    # it — a deleted deployment's engines (GCS keeps a dead reporter's
+    # last write ≤120s) can no longer contaminate an A/B rerun
+    try:
+        from ray_tpu import observability as _obs
+
+        _obs.reset_epoch("serve")
+    except Exception:
+        pass
+    if slo is None:
+        # discover the deployment's deployed objectives (status() carries
+        # the evaluator's config once the control loop has ticked)
+        try:
+            from ray_tpu.serve import api as _api
+
+            app, dep = track or (handle.app_name, handle.deployment_name)
+            st = _api.status().get(app, {}).get(dep, {})
+            slo = (st.get("slo") or {}).get("config")
+        except Exception:
+            slo = None
     report = asyncio.run(
         _run_async(handle, workload, phases, request_timeout_s, track,
                    drain_timeout_s, retries=retries, chaos=chaos,
-                   chaos_target=chaos_target)
+                   chaos_target=chaos_target, slo=slo)
     )
     if collect_serve_metrics:
         time.sleep(0.5)  # let the last engine/replica publishes land
         snap = serve_snapshot()
-        # prefix-cache headline from an EXACT live-replica scrape when
-        # the handle names the deployment: the GCS telemetry table keeps
-        # a dead reporter's last snapshot for up to 120s, so a deleted
-        # deployment's engines would otherwise contaminate an A/B rerun.
-        # Custom request_fn workloads (non-LLM deployments) skip the
-        # scrape — probing `metrics` on a deployment without one spews
-        # remote AttributeErrors into the worker logs.
-        if workload.request_fn is None:
+        # prefix-cache headline straight from the (now epoch-fenced)
+        # snapshot — the round-8 live-replica scrape survives only as a
+        # fallback for the window where fenced reporters haven't
+        # republished yet. Custom request_fn workloads (non-LLM
+        # deployments) never scrape — probing `metrics` on a deployment
+        # without one spews remote AttributeErrors into the worker logs.
+        pc = aggregate_prefix_cache(snap)
+        if not pc["per_replica"] and workload.request_fn is None:
             try:
-                report["prefix_cache"] = aggregate_prefix_cache(
+                pc = aggregate_prefix_cache(
                     replica_metrics(handle.app_name, handle.deployment_name)
                 )
             except Exception:
-                report["prefix_cache"] = aggregate_prefix_cache(snap)
-        else:
-            report["prefix_cache"] = aggregate_prefix_cache(snap)
+                pass
+        report["prefix_cache"] = pc
         report["engines"] = {
             k: {
                 m: v[m]
@@ -479,4 +524,10 @@ def run_load(handle, workload: Workload, phases: Optional[List[Phase]] = None,
         report["autoscaler"] = {
             k: v for k, v in snap.items() if k.startswith("autoscaler:")
         }
+        # the controller-evaluated SLO plane: attainment + multi-window
+        # burn rates per deployment (engine-measured TTFT/TPOT p99s —
+        # the per-phase blocks above cover availability only)
+        slo_snaps = {k: v for k, v in snap.items() if k.startswith("slo:")}
+        if slo_snaps:
+            report["slo"] = slo_snaps
     return report

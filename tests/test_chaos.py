@@ -37,6 +37,7 @@ def _worker_pids(session_dir: str):
     return pids
 
 
+@pytest.mark.chaos
 def test_kill_loop_under_sustained_load():
     c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
     extra = c.add_node(num_cpus=2, resources={"extra": 1.0})

@@ -53,6 +53,7 @@ print("UNREACHABLE")  # the writer SIGKILLs this process mid-protocol
 """
 
 
+@pytest.mark.chaos
 @pytest.mark.parametrize("crash_point", ["after_payload", "after_marker"])
 def test_kill_mid_save_keeps_previous_good(tmp_path, crash_point):
     """SIGKILL the writer between tmp-write and commit (and between

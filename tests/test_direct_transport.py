@@ -288,6 +288,7 @@ def test_direct_fallback_ref_args(ray_start_regular):
     assert client.stats["direct_calls"] == before  # never touched the ring
 
 
+@pytest.mark.chaos
 def test_direct_actor_death_mid_stream(ray_start_regular):
     """A SIGKILLed actor cannot send a stream-fatal record: the client's
     liveness poll must fail the in-flight direct calls instead of

@@ -275,11 +275,11 @@ def test_reserve_restricts_to_pool_role(monkeypatch):
     roles = {"p1": "prefill", "p2": "prefill", "d1": "decode"}
     h, _ = _pool_handle(monkeypatch, roles)
     for _ in range(8):
-        name, _sub = h._reserve(role="prefill")
+        name, _sub, _kind = h._reserve(role="prefill")
         assert roles[name] == "prefill"
         h._outstanding[name] = 0
     for _ in range(8):
-        name, _sub = h._reserve(role="decode")
+        name, _sub, _kind = h._reserve(role="decode")
         assert name == "d1"
         h._outstanding[name] = 0
 
@@ -289,7 +289,7 @@ def test_reserve_degrades_when_pool_empty(monkeypatch):
     any survivor instead of parking: paged engines serve resumes
     role-agnostically, so degrading beats losing the request."""
     h, _ = _pool_handle(monkeypatch, {"p1": "prefill"})
-    name, _sub = h._reserve(role="decode")
+    name, _sub, _kind = h._reserve(role="decode")
     assert name == "p1"
 
 

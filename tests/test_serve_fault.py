@@ -487,6 +487,7 @@ def test_telemetry_prune_removes_dead_reporter_key(ray_start_regular):
     assert not _present()
 
 
+@pytest.mark.chaos
 def test_chaos_smoke_kill_and_wedge_recovery(_cleanup_serve):
     """The tier-1 chaos smoke: a seeded kill and a wedge against a live
     2-replica deployment. Every accepted request completes (redispatch)
